@@ -1,0 +1,22 @@
+"""cruise_control_tpu — a TPU-native cluster-rebalancing framework.
+
+A ground-up, JAX/XLA-first rebuild of the capability surface of LinkedIn
+Cruise Control (reference: /root/reference): resource-load monitoring with
+windowed metric aggregation, an array-encoded workload cluster model,
+multi-goal rebalance proposal generation, throttled proposal execution with
+progress tracking, anomaly detection and self-healing, a REST API with async
+user tasks, and a CLI client.
+
+Unlike the reference's single-threaded goal-by-goal greedy search
+(reference: analyzer/GoalOptimizer.java), the analyzer core here is a
+batched combinatorial optimizer: cluster state is flattened into device
+arrays and thousands of candidate replica-move plans are scored in parallel
+with vmap'd goal functions under a simulated-annealing/beam acceptance loop,
+sharded across TPU devices with jax.sharding.
+"""
+
+__version__ = "0.1.0"
+
+from cruise_control_tpu.common.resources import Resource, NUM_RESOURCES
+
+__all__ = ["Resource", "NUM_RESOURCES", "__version__"]

@@ -1,0 +1,103 @@
+"""Goal registry and default priority order.
+
+Order mirrors the reference default.goals list
+(reference config/constants/AnalyzerConfig.java:211-228); hard-goal set
+mirrors AnalyzerConfig.java:246.  OfflineReplicaGoal is the implicit
+dead-broker/dead-disk relocation requirement the reference bakes into every
+goal's initGoalState — modeled here as an explicit top-priority hard goal.
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.capacity import (
+    CapacityGoal,
+    OfflineReplicaGoal,
+    PotentialNwOutGoal,
+    ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.distribution import (
+    LeaderBytesInDistributionGoal,
+    LeaderReplicaDistributionGoal,
+    ReplicaDistributionGoal,
+    ResourceDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.goals.election import PreferredLeaderElectionGoal
+from cruise_control_tpu.analyzer.goals.topology import (
+    IntraBrokerDiskCapacityGoal,
+    IntraBrokerDiskUsageDistributionGoal,
+    RackAwareGoal,
+)
+
+_ALL_GOALS: list[Goal] = [
+    OfflineReplicaGoal(),
+    RackAwareGoal(),
+    ReplicaCapacityGoal(),
+    CapacityGoal(Resource.DISK),
+    CapacityGoal(Resource.NW_IN),
+    CapacityGoal(Resource.NW_OUT),
+    CapacityGoal(Resource.CPU),
+    ReplicaDistributionGoal(),
+    PotentialNwOutGoal(),
+    ResourceDistributionGoal(Resource.DISK),
+    ResourceDistributionGoal(Resource.NW_IN),
+    ResourceDistributionGoal(Resource.NW_OUT),
+    ResourceDistributionGoal(Resource.CPU),
+    TopicReplicaDistributionGoal(),
+    LeaderReplicaDistributionGoal(),
+    LeaderBytesInDistributionGoal(),
+    PreferredLeaderElectionGoal(),
+    IntraBrokerDiskCapacityGoal(),
+    IntraBrokerDiskUsageDistributionGoal(),
+]
+
+GOALS_BY_NAME: dict[str, Goal] = {g.name: g for g in _ALL_GOALS}
+
+#: default optimization order (priority high -> low), reference AnalyzerConfig.java:211-228
+DEFAULT_GOAL_ORDER: list[str] = [
+    "OfflineReplicaGoal",
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+#: reference default.intra.broker.goals (AnalyzerConfig.java:236)
+DEFAULT_INTRA_BROKER_GOAL_ORDER: list[str] = [
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+]
+
+HARD_GOAL_NAMES: frozenset[str] = frozenset(g.name for g in _ALL_GOALS if g.hard)
+
+
+def get_goals(names: list[str] | None = None) -> list[Goal]:
+    if names is None:
+        names = DEFAULT_GOAL_ORDER
+    unknown = [n for n in names if n not in GOALS_BY_NAME]
+    if unknown:
+        raise ValueError(f"unknown goals: {unknown}; known: {sorted(GOALS_BY_NAME)}")
+    return [GOALS_BY_NAME[n] for n in names]
+
+
+__all__ = [
+    "DEFAULT_GOAL_ORDER",
+    "DEFAULT_INTRA_BROKER_GOAL_ORDER",
+    "GOALS_BY_NAME",
+    "HARD_GOAL_NAMES",
+    "Goal",
+    "get_goals",
+]

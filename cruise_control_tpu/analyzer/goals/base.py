@@ -1,0 +1,59 @@
+"""Goal SPI — the TPU-native replacement for reference analyzer/goals/Goal.java:38.
+
+The reference Goal is an imperative `optimize(clusterModel)` that mutates the
+model and vetoes later goals' moves (actionAcceptance).  Here a goal is two
+pure functions over array state (SURVEY §7):
+
+  violation(state, agg, constraint) -> f32 scalar
+      Total amount by which the goal is violated; 0.0 means satisfied.
+      For hard goals this is a feasibility constraint the optimizer must
+      drive to (and keep at) zero; for soft goals it is the primary
+      objective term.
+
+  score(state, agg, constraint) -> f32 scalar
+      Continuous badness (e.g. utilization dispersion) minimized as a
+      tiebreaker once violations are gone, so optimization keeps improving
+      balance beyond the thresholds.
+
+Both must be jit/vmap-compatible.  Goals are stateless and registered by the
+same names the reference uses (e.g. "RackAwareGoal") so config files remain
+familiar.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.models.aggregates import BrokerAggregates
+from cruise_control_tpu.models.state import ClusterState
+
+
+class Goal:
+    """Base goal: zero violation, zero score."""
+
+    #: registry name; matches the reference's class name where one exists
+    name: str = "Goal"
+    #: hard goals gate feasibility (reference Goal.isHardGoal)
+    hard: bool = False
+
+    def violation(
+        self, state: ClusterState, agg: BrokerAggregates, constraint: BalancingConstraint
+    ):
+        return jnp.float32(0.0)
+
+    def score(
+        self, state: ClusterState, agg: BrokerAggregates, constraint: BalancingConstraint
+    ):
+        return jnp.float32(0.0)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{type(self).__name__}(name={self.name!r}, hard={self.hard})"
+
+
+def alive_mask(state: ClusterState):
+    return state.broker_valid & state.broker_alive
+
+
+def relu(x):
+    return jnp.maximum(x, 0.0)

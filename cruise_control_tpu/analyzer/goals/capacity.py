@@ -1,0 +1,118 @@
+"""Hard capacity goals.
+
+Reference: analyzer/goals/CapacityGoal.java:42 and its four thin subclasses
+(DiskCapacityGoal, NetworkInbound/OutboundCapacityGoal, CpuCapacityGoal),
+ReplicaCapacityGoal.java, PotentialNwOutGoal.java.
+
+Violations are dimensionless: excess utilization divided by total alive
+capacity for that resource, so resources and goals are comparable inside one
+scalar objective.  Host-level checking mirrors the reference: host resources
+(CPU, NW) are checked at host granularity when a host has >1 broker,
+broker granularity otherwise (reference CapacityGoal host/broker split).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.aggregates import BrokerAggregates, host_load
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.analyzer.goals.base import Goal, alive_mask, relu
+
+
+class CapacityGoal(Goal):
+    """Broker/host utilization below capacity * capacity_threshold for one resource."""
+
+    hard = True
+
+    def __init__(self, resource: Resource):
+        self.resource = resource
+        self.name = {
+            Resource.CPU: "CpuCapacityGoal",
+            Resource.NW_IN: "NetworkInboundCapacityGoal",
+            Resource.NW_OUT: "NetworkOutboundCapacityGoal",
+            Resource.DISK: "DiskCapacityGoal",
+        }[resource]
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        r = int(self.resource)
+        thresh = constraint.capacity_threshold[r]
+        mask = alive_mask(state)
+        cap = jnp.where(mask, state.broker_capacity[:, r], 0.0)
+        load = jnp.where(mask, agg.broker_load[:, r], 0.0)
+        scale = cap.sum() + 1e-12
+
+        broker_excess = relu(load - thresh * cap)
+        if self.resource.is_host_resource:
+            H = state.shape.num_hosts
+            hseg = jnp.where(state.broker_valid, state.broker_host, H)
+            brokers_per_host = jax.ops.segment_sum(
+                mask.astype(jnp.int32), hseg, num_segments=H + 1
+            )[:H]
+            h_load = jax.ops.segment_sum(load, hseg, num_segments=H + 1)[:H]
+            h_cap = jax.ops.segment_sum(cap, hseg, num_segments=H + 1)[:H]
+            host_excess = relu(h_load - thresh * h_cap)
+            multi = brokers_per_host > 1
+            # host granularity where hosts aggregate several brokers,
+            # broker granularity otherwise (single-broker hosts coincide).
+            host_term = jnp.where(multi, host_excess, 0.0).sum()
+            per_host_single = jax.ops.segment_sum(
+                broker_excess, hseg, num_segments=H + 1
+            )[:H]
+            broker_term = jnp.where(~multi, per_host_single, 0.0).sum()
+            return (host_term + broker_term) / scale
+        return broker_excess.sum() / scale
+
+
+class ReplicaCapacityGoal(Goal):
+    """<= max.replicas.per.broker on every alive broker
+    (reference analyzer/goals/ReplicaCapacityGoal.java)."""
+
+    name = "ReplicaCapacityGoal"
+    hard = True
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        mask = alive_mask(state)
+        count = jnp.where(mask, agg.broker_replica_count, 0)
+        excess = relu((count - constraint.max_replicas_per_broker).astype(jnp.float32))
+        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
+        return excess.sum() / n_valid
+
+
+class PotentialNwOutGoal(Goal):
+    """Potential (all-leader) NW-out under capacity threshold
+    (reference analyzer/goals/PotentialNwOutGoal.java)."""
+
+    name = "PotentialNwOutGoal"
+    hard = False
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        r = int(Resource.NW_OUT)
+        thresh = constraint.capacity_threshold[r]
+        mask = alive_mask(state)
+        cap = jnp.where(mask, state.broker_capacity[:, r], 0.0)
+        pot = jnp.where(mask, agg.broker_potential_nw_out, 0.0)
+        scale = cap.sum() + 1e-12
+        return relu(pot - thresh * cap).sum() / scale
+
+
+class OfflineReplicaGoal(Goal):
+    """No replica may remain on a dead broker or dead logdir.
+
+    Implicit hard requirement in the reference (dead-broker replicas are
+    offline and every goal's initGoalState forces their relocation; verifier
+    check BROKEN_BROKERS, reference analyzer/OptimizationVerifier.java).
+    Normalized by total replica count.
+    """
+
+    name = "OfflineReplicaGoal"
+    hard = True
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        dead_broker = ~state.broker_alive[state.replica_broker]
+        dead_disk = ~state.disk_alive[state.replica_broker, state.replica_disk]
+        bad = state.replica_valid & (dead_broker | dead_disk)
+        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
+        return bad.sum().astype(jnp.float32) / n_valid

@@ -1,0 +1,159 @@
+"""Soft distribution goals.
+
+Reference: analyzer/goals/ResourceDistributionGoal.java:50 (+4 subclasses),
+ReplicaDistributionGoal.java, LeaderReplicaDistributionGoal.java,
+LeaderBytesInDistributionGoal.java, TopicReplicaDistributionGoal.java.
+
+Balance semantics follow the reference: the per-broker target band is
+capacity-proportional for resources (avg utilization percentage x balance
+threshold x broker capacity) and count-proportional for replica counts
+(cluster average +/- threshold).  `score` adds the coefficient of variation
+as a continuous tiebreaker so optimization keeps tightening balance inside
+the band.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.aggregates import BrokerAggregates
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.analyzer.goals.base import Goal, alive_mask, relu
+
+
+def _band_violation(values, mask, upper, lower, scale):
+    """Sum of band excursions over masked entries, normalized by scale."""
+    over = relu(jnp.where(mask, values - upper, 0.0))
+    under = relu(jnp.where(mask, lower - values, 0.0))
+    return (over + under).sum() / (scale + 1e-12)
+
+
+def _cv(values, mask):
+    """Coefficient of variation over masked entries."""
+    n = jnp.maximum(mask.sum(), 1)
+    mean = jnp.where(mask, values, 0.0).sum() / n
+    var = jnp.where(mask, (values - mean) ** 2, 0.0).sum() / n
+    return jnp.sqrt(var) / (mean + 1e-12)
+
+
+class ResourceDistributionGoal(Goal):
+    """Per-broker utilization within avg% * (2-t, t) * capacity for one resource."""
+
+    hard = False
+
+    def __init__(self, resource: Resource):
+        self.resource = resource
+        self.name = {
+            Resource.CPU: "CpuUsageDistributionGoal",
+            Resource.NW_IN: "NetworkInboundUsageDistributionGoal",
+            Resource.NW_OUT: "NetworkOutboundUsageDistributionGoal",
+            Resource.DISK: "DiskUsageDistributionGoal",
+        }[resource]
+
+    def _bands(self, state, agg, constraint):
+        r = int(self.resource)
+        t = constraint.balance_threshold[r]
+        mask = alive_mask(state)
+        cap = jnp.where(mask, state.broker_capacity[:, r], 0.0)
+        load = jnp.where(mask, agg.broker_load[:, r], 0.0)
+        avg_pct = load.sum() / (cap.sum() + 1e-12)
+        upper = avg_pct * t * cap
+        lower = avg_pct * max(0.0, 2.0 - t) * cap
+        return load, mask, upper, lower
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        load, mask, upper, lower = self._bands(state, agg, constraint)
+        return _band_violation(load, mask, upper, lower, load.sum())
+
+    def score(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        r = int(self.resource)
+        mask = alive_mask(state)
+        # dispersion of utilization *percentage* so heterogeneous capacities
+        # aren't penalized
+        pct = agg.broker_load[:, r] / (state.broker_capacity[:, r] + 1e-12)
+        return _cv(jnp.where(mask, pct, 0.0), mask)
+
+
+class _CountDistributionGoal(Goal):
+    """Shared count-balance logic for replica/leader count goals."""
+
+    def _counts(self, state: ClusterState, agg: BrokerAggregates):
+        raise NotImplementedError
+
+    def _threshold(self, constraint) -> float:
+        raise NotImplementedError
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        mask = alive_mask(state)
+        counts = jnp.where(mask, self._counts(state, agg), 0).astype(jnp.float32)
+        n = jnp.maximum(mask.sum(), 1)
+        avg = counts.sum() / n
+        t = self._threshold(constraint)
+        # reference uses ceil/floor of avg*t (ReplicaDistributionAbstractGoal)
+        upper = jnp.ceil(avg * t)
+        lower = jnp.floor(avg * max(0.0, 2.0 - t))
+        return _band_violation(counts, mask, upper, lower, counts.sum())
+
+    def score(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        mask = alive_mask(state)
+        return _cv(jnp.where(mask, self._counts(state, agg), 0).astype(jnp.float32), mask)
+
+
+class ReplicaDistributionGoal(_CountDistributionGoal):
+    name = "ReplicaDistributionGoal"
+
+    def _counts(self, state, agg):
+        return agg.broker_replica_count
+
+    def _threshold(self, constraint):
+        return constraint.replica_count_balance_threshold
+
+
+class LeaderReplicaDistributionGoal(_CountDistributionGoal):
+    name = "LeaderReplicaDistributionGoal"
+
+    def _counts(self, state, agg):
+        return agg.broker_leader_count
+
+    def _threshold(self, constraint):
+        return constraint.leader_replica_count_balance_threshold
+
+
+class LeaderBytesInDistributionGoal(Goal):
+    """Leader-served NW_IN balanced across brokers
+    (reference analyzer/goals/LeaderBytesInDistributionGoal.java)."""
+
+    name = "LeaderBytesInDistributionGoal"
+    hard = False
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        t = constraint.balance_threshold[int(Resource.NW_IN)]
+        mask = alive_mask(state)
+        lbin = jnp.where(mask, agg.broker_leader_bytes_in, 0.0)
+        n = jnp.maximum(mask.sum(), 1)
+        avg = lbin.sum() / n
+        # reference only caps the upper side (moves leadership off hot brokers)
+        return _band_violation(lbin, mask, avg * t, 0.0, lbin.sum())
+
+    def score(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        mask = alive_mask(state)
+        return _cv(jnp.where(mask, agg.broker_leader_bytes_in, 0.0), mask)
+
+
+class TopicReplicaDistributionGoal(Goal):
+    """Per-topic replica spread balanced across brokers
+    (reference analyzer/goals/TopicReplicaDistributionGoal.java)."""
+
+    name = "TopicReplicaDistributionGoal"
+    hard = False
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        mask = alive_mask(state)  # [B]
+        counts = jnp.where(mask[None, :], agg.broker_topic_count, 0).astype(jnp.float32)
+        n = jnp.maximum(mask.sum(), 1)
+        avg = counts.sum(axis=1, keepdims=True) / n  # [T, 1]
+        t = constraint.topic_replica_count_balance_threshold
+        upper = jnp.ceil(avg * t)
+        lower = jnp.floor(avg * max(0.0, 2.0 - t))
+        return _band_violation(counts, mask[None, :], upper, lower, counts.sum())

@@ -1,0 +1,28 @@
+"""PreferredLeaderElectionGoal (reference analyzer/goals/PreferredLeaderElectionGoal.java).
+
+A utility goal: leadership should sit on the first (preferred, pos == 0)
+replica of each partition whenever that replica is on a healthy broker.
+Violation = fraction of partitions led by a non-preferred replica while the
+preferred one is eligible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.models.aggregates import BrokerAggregates
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.analyzer.goals.base import Goal
+
+
+class PreferredLeaderElectionGoal(Goal):
+    name = "PreferredLeaderElectionGoal"
+    hard = False
+
+    def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        preferred = state.replica_pos == 0
+        eligible = state.broker_alive[state.replica_broker] & ~state.replica_offline
+        # partition is violated if its preferred replica is eligible but not leader
+        bad = state.replica_valid & preferred & eligible & ~state.replica_is_leader
+        P = jnp.maximum(state.shape.P, 1)
+        return bad.sum().astype(jnp.float32) / P

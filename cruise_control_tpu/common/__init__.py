@@ -1,0 +1,8 @@
+from cruise_control_tpu.common.resources import (
+    NUM_RESOURCES,
+    RESOURCE_NAMES,
+    Resource,
+    epsilon_array,
+)
+
+__all__ = ["NUM_RESOURCES", "RESOURCE_NAMES", "Resource", "epsilon_array"]

@@ -1,0 +1,71 @@
+"""Resource taxonomy for the cluster workload model.
+
+The four balanced/capacity-checked resources, in the same canonical order the
+reference uses (reference: common/Resource.java:19-26).  The order is load-
+bearing: every `[..., NUM_RESOURCES]` array axis in the framework is indexed
+by these constants.
+
+Epsilon semantics mirror reference common/Resource.java:28-35: utilization
+comparisons tolerate `max(epsilon_abs, EPSILON_PERCENT * (a + b))` — float
+accumulation over hundreds of thousands of replicas must not flip balance
+decisions.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+NUM_RESOURCES = 4
+
+# Relative epsilon applied to the sum of the two compared values
+# (reference: common/Resource.java:32).
+EPSILON_PERCENT = 0.0008
+
+
+class Resource(enum.IntEnum):
+    """Balanced resources; int value is the array axis index."""
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        # CPU and network are host-level resources (a host's brokers share
+        # NICs/cores); disk is broker-level (reference: common/Resource.java:19-26).
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return True  # all four are tracked per broker
+
+    @property
+    def epsilon_abs(self) -> float:
+        # Absolute epsilon floor per resource (reference: common/Resource.java:19-26
+        # passes a per-resource epsilon into the enum ctor).
+        return _EPSILON_ABS[int(self)]
+
+    def epsilon(self, value1: float, value2: float) -> float:
+        """Comparison tolerance for two utilization values.
+
+        Mirrors reference common/Resource.java:92-94.
+        """
+        return max(self.epsilon_abs, EPSILON_PERCENT * (value1 + value2))
+
+
+# Per-resource absolute epsilon floors, indexed by Resource value.
+_EPSILON_ABS = np.array([1e-5, 1e-5, 1e-5, 1e-5], dtype=np.float64)
+
+# Convenience: names in canonical order, e.g. for reports / JSON responses.
+RESOURCE_NAMES = tuple(r.name for r in sorted(Resource, key=int))
+
+
+def epsilon_array(values1, values2):
+    """Vectorized epsilon for arrays shaped [..., NUM_RESOURCES]."""
+    import jax.numpy as jnp
+
+    eps_abs = jnp.asarray(_EPSILON_ABS, dtype=values1.dtype)
+    return jnp.maximum(eps_abs, EPSILON_PERCENT * (values1 + values2))

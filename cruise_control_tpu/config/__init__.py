@@ -1,0 +1,3 @@
+from cruise_control_tpu.config.balancing import DEFAULT_CONSTRAINT, BalancingConstraint
+
+__all__ = ["DEFAULT_CONSTRAINT", "BalancingConstraint"]

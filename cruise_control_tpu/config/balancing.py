@@ -1,0 +1,48 @@
+"""Balancing thresholds (reference: analyzer/BalancingConstraint.java:22-54).
+
+Defaults mirror reference config/constants/AnalyzerConfig.java:
+  {cpu,disk,nw-in,nw-out}.balance.threshold        = 1.10   (:47,:56,:65,:74)
+  replica.count.balance.threshold                  = 1.10   (:83)
+  leader.replica.count.balance.threshold           = 1.10   (:92)
+  topic.replica.count.balance.threshold            = 3.00   (:101)
+  {cpu,disk,nw-in,nw-out}.capacity.threshold       = 0.8    (:110,:119,:128,:138)
+  {*}.low.utilization.threshold                    = 0.0    (:148-175)
+  max.replicas.per.broker                          = 10000  (:194)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingConstraint:
+    # per-resource, indexed by Resource (CPU, NW_IN, NW_OUT, DISK)
+    balance_threshold: tuple[float, ...] = (1.10, 1.10, 1.10, 1.10)
+    capacity_threshold: tuple[float, ...] = (0.8, 0.8, 0.8, 0.8)
+    low_utilization_threshold: tuple[float, ...] = (0.0, 0.0, 0.0, 0.0)
+    replica_count_balance_threshold: float = 1.10
+    leader_replica_count_balance_threshold: float = 1.10
+    topic_replica_count_balance_threshold: float = 3.00
+    max_replicas_per_broker: int = 10_000
+    # goal-violation detection uses a slacker multiplier on distribution goals
+    # (reference AnalyzerConfig.java:316)
+    goal_violation_distribution_threshold_multiplier: float = 1.0
+
+    def balance_upper(self) -> np.ndarray:
+        return np.asarray(self.balance_threshold, np.float32)
+
+    def balance_lower(self) -> np.ndarray:
+        # reference uses avg * max(0, 2 - threshold) as the lower bound
+        # (ResourceDistributionGoal balanceLowerThreshold semantics)
+        return np.maximum(0.0, 2.0 - np.asarray(self.balance_threshold, np.float32))
+
+    def capacity(self) -> np.ndarray:
+        return np.asarray(self.capacity_threshold, np.float32)
+
+
+DEFAULT_CONSTRAINT = BalancingConstraint()

@@ -1,0 +1,24 @@
+from cruise_control_tpu.models.aggregates import BrokerAggregates, compute_aggregates, host_load
+from cruise_control_tpu.models.builder import (
+    BrokerSpec,
+    ClusterModelBuilder,
+    PartitionSpec,
+    default_follower_load,
+)
+from cruise_control_tpu.models.state import ClusterShape, ClusterState, validate
+from cruise_control_tpu.models.stats import ClusterStats, compute_stats
+
+__all__ = [
+    "BrokerAggregates",
+    "BrokerSpec",
+    "ClusterModelBuilder",
+    "ClusterShape",
+    "ClusterState",
+    "ClusterStats",
+    "PartitionSpec",
+    "compute_aggregates",
+    "compute_stats",
+    "default_follower_load",
+    "host_load",
+    "validate",
+]

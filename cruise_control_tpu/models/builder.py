@@ -1,0 +1,188 @@
+"""Host-side builder: topology + per-partition loads → padded ClusterState.
+
+Plays the role of reference model/ClusterModel.java's mutating creation API
+(createRack:892, createBroker:867, createReplica:768, setReplicaLoad:684):
+the monitor layer feeds it brokers/partitions, it emits immutable device
+arrays.  Padding to a static replica capacity keeps jit shapes stable across
+model generations (pad-and-mask, SURVEY §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.models.state import ClusterShape, ClusterState
+
+
+@dataclasses.dataclass
+class BrokerSpec:
+    broker_id: int
+    rack: str
+    host: str | None = None  # defaults to one host per broker
+    capacity: np.ndarray | None = None  # [4]; DISK overridden by disk sum if disks given
+    disk_capacities: list[float] | None = None  # JBOD logdir capacities
+    alive: bool = True
+    new_broker: bool = False
+    bad_disks: list[int] | None = None
+
+
+@dataclasses.dataclass
+class PartitionSpec:
+    topic: str
+    partition: int
+    replica_brokers: list[int]  # first entry = current leader
+    leader_load: np.ndarray  # [4] utilization when leading
+    follower_load: np.ndarray | None = None  # [4]; default derives from leader_load
+    replica_disks: list[int] | None = None
+    leader_pos: int = 0  # index into replica_brokers of the current leader
+
+
+def default_follower_load(leader_load: np.ndarray, follower_cpu_fraction: float = 0.3) -> np.ndarray:
+    """Follower load derived from leader load.
+
+    NW_OUT drops to 0 (only leaders serve consumer fetch), CPU drops to the
+    follower share (reference model/ModelUtils.getFollowerCpuUtilFromLeaderLoad:53-67
+    derives follower CPU from leader byte rates; we model it as a configured
+    fraction until the linear-regression estimator lands in the monitor layer),
+    NW_IN and DISK are identical (replication traffic and storage).
+    """
+    f = np.array(leader_load, dtype=np.float32).copy()
+    f[Resource.NW_OUT] = 0.0
+    f[Resource.CPU] = leader_load[Resource.CPU] * follower_cpu_fraction
+    return f
+
+
+class ClusterModelBuilder:
+    def __init__(self, *, replica_capacity: int | None = None, follower_cpu_fraction: float = 0.3):
+        self._brokers: list[BrokerSpec] = []
+        self._partitions: list[PartitionSpec] = []
+        self._replica_capacity = replica_capacity
+        self._follower_cpu_fraction = follower_cpu_fraction
+
+    def add_broker(self, spec: BrokerSpec) -> "ClusterModelBuilder":
+        self._brokers.append(spec)
+        return self
+
+    def add_partition(self, spec: PartitionSpec) -> "ClusterModelBuilder":
+        self._partitions.append(spec)
+        return self
+
+    def build(self) -> ClusterState:
+        brokers = sorted(self._brokers, key=lambda b: b.broker_id)
+        ids = [b.broker_id for b in brokers]
+        if ids != list(range(len(ids))):
+            raise ValueError(f"broker ids must be dense 0..B-1, got {ids}")
+        B = len(brokers)
+        racks = sorted({b.rack for b in brokers})
+        rack_idx = {r: i for i, r in enumerate(racks)}
+        hosts = sorted({b.host if b.host is not None else f"__host_{b.broker_id}" for b in brokers})
+        host_idx = {h: i for i, h in enumerate(hosts)}
+        topics = sorted({p.topic for p in self._partitions})
+        topic_idx = {t: i for i, t in enumerate(topics)}
+
+        D = max((len(b.disk_capacities) for b in brokers if b.disk_capacities), default=1)
+
+        broker_capacity = np.zeros((B, NUM_RESOURCES), np.float32)
+        broker_rack = np.zeros(B, np.int32)
+        broker_host = np.zeros(B, np.int32)
+        broker_alive = np.zeros(B, bool)
+        broker_new = np.zeros(B, bool)
+        disk_capacity = np.zeros((B, D), np.float32)
+        disk_alive = np.zeros((B, D), bool)
+        for i, b in enumerate(brokers):
+            cap = np.asarray(
+                b.capacity if b.capacity is not None else [100.0, 1e5, 1e5, 1e6], np.float32
+            )
+            if b.disk_capacities:
+                dc = np.asarray(b.disk_capacities, np.float32)
+                disk_capacity[i, : len(dc)] = dc
+                disk_alive[i, : len(dc)] = True
+                cap = cap.copy()
+                cap[Resource.DISK] = dc.sum()
+            else:
+                disk_capacity[i, 0] = cap[Resource.DISK]
+                disk_alive[i, 0] = True
+            for bad in b.bad_disks or []:
+                disk_alive[i, bad] = False
+            broker_capacity[i] = cap
+            broker_rack[i] = rack_idx[b.rack]
+            broker_host[i] = host_idx[b.host if b.host is not None else f"__host_{b.broker_id}"]
+            broker_alive[i] = b.alive
+            broker_new[i] = b.new_broker
+
+        parts = sorted(self._partitions, key=lambda p: (p.topic, p.partition))
+        P = len(parts)
+        n_replicas = sum(len(p.replica_brokers) for p in parts)
+        R = self._replica_capacity or n_replicas
+        if R < n_replicas:
+            raise ValueError(f"replica_capacity {R} < actual replicas {n_replicas}")
+
+        r_broker = np.zeros(R, np.int32)
+        r_part = np.zeros(R, np.int32)
+        r_topic = np.zeros(R, np.int32)
+        r_pos = np.zeros(R, np.int32)
+        r_leader = np.zeros(R, bool)
+        r_valid = np.zeros(R, bool)
+        r_offline = np.zeros(R, bool)
+        r_disk = np.zeros(R, np.int32)
+        r_ll = np.zeros((R, NUM_RESOURCES), np.float32)
+        r_fl = np.zeros((R, NUM_RESOURCES), np.float32)
+
+        k = 0
+        for pid, p in enumerate(parts):
+            ll = np.asarray(p.leader_load, np.float32)
+            fl = (
+                np.asarray(p.follower_load, np.float32)
+                if p.follower_load is not None
+                else default_follower_load(ll, self._follower_cpu_fraction)
+            )
+            for pos, bid in enumerate(p.replica_brokers):
+                r_broker[k] = bid
+                r_part[k] = pid
+                r_topic[k] = topic_idx[p.topic]
+                r_pos[k] = pos
+                r_leader[k] = pos == p.leader_pos
+                r_valid[k] = True
+                disk = (p.replica_disks or [0] * len(p.replica_brokers))[pos]
+                r_disk[k] = disk
+                r_offline[k] = (not brokers[bid].alive) or (not disk_alive[bid, disk])
+                r_ll[k] = ll
+                r_fl[k] = fl
+                k += 1
+
+        shape = ClusterShape(
+            num_replicas=R,
+            num_brokers=B,
+            num_partitions=P,
+            num_topics=max(len(topics), 1),
+            num_racks=max(len(racks), 1),
+            num_hosts=max(len(hosts), 1),
+            max_disks_per_broker=D,
+        )
+        import jax.numpy as jnp
+
+        return ClusterState(
+            replica_broker=jnp.asarray(r_broker),
+            replica_partition=jnp.asarray(r_part),
+            replica_topic=jnp.asarray(r_topic),
+            replica_pos=jnp.asarray(r_pos),
+            replica_is_leader=jnp.asarray(r_leader),
+            replica_valid=jnp.asarray(r_valid),
+            replica_orig_broker=jnp.asarray(r_broker.copy()),
+            replica_offline=jnp.asarray(r_offline),
+            replica_disk=jnp.asarray(r_disk),
+            replica_load_leader=jnp.asarray(r_ll),
+            replica_load_follower=jnp.asarray(r_fl),
+            broker_capacity=jnp.asarray(broker_capacity),
+            broker_rack=jnp.asarray(broker_rack),
+            broker_host=jnp.asarray(broker_host),
+            broker_alive=jnp.asarray(broker_alive),
+            broker_new=jnp.asarray(broker_new),
+            broker_valid=jnp.ones(B, bool),
+            disk_capacity=jnp.asarray(disk_capacity),
+            disk_alive=jnp.asarray(disk_alive),
+            shape=shape,
+        )

@@ -1,0 +1,224 @@
+"""Array-encoded cluster workload state — the TPU-native ClusterModel.
+
+The reference models a cluster as a mutable object graph
+Rack -> Host -> Broker -> Disk -> Replica with windowed Load objects
+(reference: model/ClusterModel.java:48, model/Replica.java, model/Load.java).
+Goals then pointer-chase that graph in a single-threaded greedy loop.
+
+Here the same information is flattened into fixed-shape device arrays so that
+goal scores are segment-reductions and candidate moves are gather/scatter
+deltas — evaluable for thousands of plans in parallel under vmap/jit.
+
+Encoding (R = padded replica count, B = broker count, D = max disks/broker):
+
+  replica axis [R]:
+    replica_broker     i32  current broker id (padding rows point at broker 0
+                            but are masked out by replica_valid everywhere)
+    replica_partition  i32  global partition id
+    replica_topic      i32  topic id of the partition
+    replica_pos        i32  position in the partition's replica list (0 =
+                            preferred leader; reference model/Partition.java)
+    replica_is_leader  bool currently the partition leader
+    replica_valid      bool padding mask
+    replica_orig_broker i32 broker at model-build time (immigrant tracking,
+                            reference model/Replica.java originalBroker)
+    replica_offline    bool on a dead broker / bad disk; must be relocated
+    replica_disk       i32  disk index within broker (JBOD), 0 if single-disk
+    replica_load_leader   f32[R, 4]  expected utilization if this replica
+                                     leads its partition
+    replica_load_follower f32[R, 4]  expected utilization as a follower
+                                     (NW_OUT = 0; CPU = follower share —
+                                     reference model/ModelUtils.java:53-67)
+
+  broker axis [B]:
+    broker_capacity    f32[B, 4]  per-resource capacity (DISK = sum of disks)
+    broker_rack        i32        rack id
+    broker_host        i32        host id
+    broker_alive       bool       live broker (dead => replicas offline)
+    broker_new         bool       newly-added broker (only immigrant replicas
+                                  allowed — reference analyzer semantics)
+    broker_valid       bool       padding mask
+    disk_capacity      f32[B, D]  per-logdir capacity (JBOD)
+    disk_alive         bool[B, D] logdir health
+
+Static (non-array) metadata lives in the companion `ClusterShape` so the
+pytree leaves are all arrays and jit retraces only when shapes change.
+
+Leadership semantics: the effective load of a replica is
+`where(is_leader, load_leader, load_follower)`; relocating leadership between
+two replicas of a partition therefore shifts CPU/NW_OUT between their brokers
+exactly like reference model/ClusterModel.java:374 (relocateLeadership).
+Potential-NW-out (reference model/ClusterModel.java:70,205) is the sum of
+`replica_load_leader[:, NW_OUT]` over a broker's replicas — what the broker
+would serve if it led everything it hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterShape:
+    """Static shape/topology metadata for a ClusterState.
+
+    Kept out of the pytree so it can gate jit specialization explicitly.
+    """
+
+    num_replicas: int  # padded R
+    num_brokers: int  # B
+    num_partitions: int  # P
+    num_topics: int
+    num_racks: int
+    num_hosts: int
+    max_disks_per_broker: int  # D
+
+    @property
+    def R(self) -> int:  # noqa: N802 — math-style aliases
+        return self.num_replicas
+
+    @property
+    def B(self) -> int:  # noqa: N802
+        return self.num_brokers
+
+    @property
+    def P(self) -> int:  # noqa: N802
+        return self.num_partitions
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "replica_broker",
+        "replica_partition",
+        "replica_topic",
+        "replica_pos",
+        "replica_is_leader",
+        "replica_valid",
+        "replica_orig_broker",
+        "replica_offline",
+        "replica_disk",
+        "replica_load_leader",
+        "replica_load_follower",
+        "broker_capacity",
+        "broker_rack",
+        "broker_host",
+        "broker_alive",
+        "broker_new",
+        "broker_valid",
+        "disk_capacity",
+        "disk_alive",
+    ],
+    meta_fields=["shape"],
+)
+@dataclasses.dataclass(frozen=True)
+class ClusterState:
+    # --- replica axis [R] ---
+    replica_broker: jax.Array
+    replica_partition: jax.Array
+    replica_topic: jax.Array
+    replica_pos: jax.Array
+    replica_is_leader: jax.Array
+    replica_valid: jax.Array
+    replica_orig_broker: jax.Array
+    replica_offline: jax.Array
+    replica_disk: jax.Array
+    replica_load_leader: jax.Array  # [R, NUM_RESOURCES]
+    replica_load_follower: jax.Array  # [R, NUM_RESOURCES]
+    # --- broker axis [B] ---
+    broker_capacity: jax.Array  # [B, NUM_RESOURCES]
+    broker_rack: jax.Array
+    broker_host: jax.Array
+    broker_alive: jax.Array
+    broker_new: jax.Array
+    broker_valid: jax.Array
+    disk_capacity: jax.Array  # [B, D]
+    disk_alive: jax.Array  # [B, D]
+    # --- static metadata ---
+    shape: ClusterShape
+
+    # ---- derived quantities (cheap, jit-friendly) ----
+
+    @property
+    def replica_load(self) -> jax.Array:
+        """Effective [R, 4] utilization given current leadership."""
+        lead = self.replica_is_leader[:, None]
+        load = jnp.where(lead, self.replica_load_leader, self.replica_load_follower)
+        return jnp.where(self.replica_valid[:, None], load, 0.0)
+
+    def broker_segment_ids(self) -> jax.Array:
+        """Replica→broker ids with padding routed to an overflow bucket B."""
+        return jnp.where(self.replica_broker >= 0, self.replica_broker, self.shape.B)
+
+    def with_replicas_moved(
+        self, replica_idx: jax.Array, new_broker: jax.Array, new_disk: jax.Array | None = None
+    ) -> "ClusterState":
+        """Scatter-update replica placement (reference ClusterModel.relocateReplica:347)."""
+        rb = self.replica_broker.at[replica_idx].set(new_broker)
+        disk = (
+            self.replica_disk.at[replica_idx].set(new_disk)
+            if new_disk is not None
+            else self.replica_disk.at[replica_idx].set(0)
+        )
+        # offline tracks destination health, not a blanket clear: landing on a
+        # dead broker/logdir keeps the replica offline
+        dest_ok = self.broker_alive[new_broker] & self.disk_alive[new_broker, disk[replica_idx]]
+        off = self.replica_offline.at[replica_idx].set(~dest_ok)
+        return dataclasses.replace(self, replica_broker=rb, replica_offline=off, replica_disk=disk)
+
+    def with_leadership_moved(self, from_replica: jax.Array, to_replica: jax.Array) -> "ClusterState":
+        """Transfer leadership between two replicas of the same partition
+        (reference ClusterModel.relocateLeadership:374)."""
+        lead = self.replica_is_leader.at[from_replica].set(False).at[to_replica].set(True)
+        return dataclasses.replace(self, replica_is_leader=lead)
+
+
+def validate(state: ClusterState, *, strict: bool = True) -> list[str]:
+    """Host-side structural sanity check (reference ClusterModel.sanityCheck:1081).
+
+    Checks (on materialized numpy copies — not for use inside jit):
+      * exactly one leader per partition (over valid replicas)
+      * replica broker ids within range and pointing at valid brokers
+      * no duplicate (partition, broker) placement
+      * loads are non-negative and finite
+    Returns a list of human-readable problems; raises if strict and non-empty.
+    """
+    problems: list[str] = []
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)[valid]
+    brk = np.asarray(state.replica_broker)[valid]
+    lead = np.asarray(state.replica_is_leader)[valid]
+    B, P = state.shape.B, state.shape.P
+
+    if brk.size:
+        in_range = (brk >= 0) & (brk < B)
+        if not in_range.all():
+            problems.append(f"replica broker ids out of range [0,{B}): {brk.min()}..{brk.max()}")
+        bvalid = np.asarray(state.broker_valid)
+        if not bvalid[brk[in_range]].all():
+            problems.append("replica placed on invalid (padding) broker")
+
+    leaders_per_part = np.bincount(part[lead], minlength=P)
+    present = np.bincount(part, minlength=P) > 0
+    bad = present & (leaders_per_part != 1)
+    if bad.any():
+        problems.append(f"{int(bad.sum())} partitions without exactly one leader")
+
+    pb = part.astype(np.int64) * B + brk.astype(np.int64)
+    if np.unique(pb).size != pb.size:
+        problems.append("duplicate replica of a partition on one broker")
+
+    loads = np.asarray(state.replica_load_leader)[valid]
+    if not np.isfinite(loads).all() or (loads < 0).any():
+        problems.append("non-finite or negative leader loads")
+
+    if problems and strict:
+        raise ValueError("ClusterState sanity check failed: " + "; ".join(problems))
+    return problems

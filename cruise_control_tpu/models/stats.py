@@ -1,0 +1,61 @@
+"""Cluster-level statistics (reference: model/ClusterModelStats.java:26).
+
+AVG / MAX / MIN / ST_DEV per resource over alive brokers (reference
+common/Statistic.java), replica- and leader-count dispersion, and potential
+NW-out — the numbers goals compare before/after optimization
+(reference analyzer/goals/AbstractGoal.java:92-101 regression check).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.models.aggregates import BrokerAggregates, compute_aggregates
+from cruise_control_tpu.models.state import ClusterState
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["avg", "max", "min", "std", "replica_count_std", "leader_count_std", "potential_nw_out_std"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class ClusterStats:
+    avg: jax.Array  # f32[4] mean utilization over alive brokers
+    max: jax.Array  # f32[4]
+    min: jax.Array  # f32[4]
+    std: jax.Array  # f32[4]
+    replica_count_std: jax.Array  # f32 scalar
+    leader_count_std: jax.Array  # f32 scalar
+    potential_nw_out_std: jax.Array  # f32 scalar
+
+
+def _masked_stats(x: jax.Array, mask: jax.Array):
+    """Column stats of x[B, K] over rows where mask[B] (at least 1 assumed)."""
+    n = jnp.maximum(mask.sum(), 1)
+    m = mask[:, None] if x.ndim == 2 else mask
+    xm = jnp.where(m, x, 0.0)
+    mean = xm.sum(axis=0) / n
+    var = (jnp.where(m, (x - mean) ** 2, 0.0)).sum(axis=0) / n
+    big = jnp.asarray(jnp.inf, x.dtype)
+    mx = jnp.where(m, x, -big).max(axis=0)
+    mn = jnp.where(m, x, big).min(axis=0)
+    return mean, mx, mn, jnp.sqrt(var)
+
+
+def compute_stats(state: ClusterState, agg: BrokerAggregates | None = None) -> ClusterStats:
+    if agg is None:
+        agg = compute_aggregates(state)
+    mask = state.broker_valid & state.broker_alive
+    avg, mx, mn, std = _masked_stats(agg.broker_load, mask)
+    _, _, _, rc_std = _masked_stats(agg.broker_replica_count.astype(jnp.float32), mask)
+    _, _, _, lc_std = _masked_stats(agg.broker_leader_count.astype(jnp.float32), mask)
+    _, _, _, pn_std = _masked_stats(agg.broker_potential_nw_out, mask)
+    return ClusterStats(
+        avg=avg, max=mx, min=mn, std=std,
+        replica_count_std=rc_std, leader_count_std=lc_std, potential_nw_out_std=pn_std,
+    )

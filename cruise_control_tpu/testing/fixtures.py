@@ -1,0 +1,130 @@
+"""Synthetic cluster fixtures for tests and benchmarks.
+
+Semantics modeled on the reference's test generators:
+  * deterministic fixtures — reference
+    cruise-control/src/test/java/.../common/DeterministicCluster.java
+  * randomized generator — reference
+    cruise-control/src/test/java/.../model/RandomCluster.java:36-100
+These are re-designed (not ported): they emit array-encoded ClusterState
+directly via ClusterModelBuilder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.models.builder import BrokerSpec, ClusterModelBuilder, PartitionSpec
+from cruise_control_tpu.models.state import ClusterState
+
+
+def small_cluster() -> ClusterState:
+    """3 brokers on 3 racks, 2 topics, deliberately unbalanced.
+
+    Loose analog of DeterministicCluster.smallClusterModel (reference
+    common/DeterministicCluster.java:52-149): broker 0 overloaded, broker 2
+    nearly empty.
+    """
+    b = ClusterModelBuilder()
+    cap = np.array([100.0, 1000.0, 1000.0, 10000.0], np.float32)
+    b.add_broker(BrokerSpec(0, rack="r0", capacity=cap))
+    b.add_broker(BrokerSpec(1, rack="r1", capacity=cap))
+    b.add_broker(BrokerSpec(2, rack="r2", capacity=cap))
+    loads = {
+        ("T1", 0): [18.0, 90.0, 100.0, 750.0],
+        ("T1", 1): [15.0, 80.0, 90.0, 650.0],
+        ("T2", 0): [12.0, 70.0, 80.0, 550.0],
+        ("T2", 1): [10.0, 60.0, 70.0, 450.0],
+    }
+    # all leaders and most replicas piled on broker 0
+    b.add_partition(PartitionSpec("T1", 0, [0, 1], np.array(loads[("T1", 0)], np.float32)))
+    b.add_partition(PartitionSpec("T1", 1, [0, 1], np.array(loads[("T1", 1)], np.float32)))
+    b.add_partition(PartitionSpec("T2", 0, [0, 2], np.array(loads[("T2", 0)], np.float32)))
+    b.add_partition(PartitionSpec("T2", 1, [0, 1], np.array(loads[("T2", 1)], np.float32)))
+    return b.build()
+
+
+def rack_violated_cluster() -> ClusterState:
+    """Both replicas of each partition on the same rack — RackAwareGoal must fix.
+
+    Analog of DeterministicCluster.rackAwareSatisfiable semantics
+    (reference common/DeterministicCluster.java:178-206).
+    """
+    b = ClusterModelBuilder()
+    cap = np.array([100.0, 1000.0, 1000.0, 10000.0], np.float32)
+    b.add_broker(BrokerSpec(0, rack="r0", capacity=cap))
+    b.add_broker(BrokerSpec(1, rack="r0", capacity=cap))
+    b.add_broker(BrokerSpec(2, rack="r1", capacity=cap))
+    b.add_broker(BrokerSpec(3, rack="r1", capacity=cap))
+    load = np.array([5.0, 20.0, 25.0, 100.0], np.float32)
+    b.add_partition(PartitionSpec("T1", 0, [0, 1], load))  # same rack r0
+    b.add_partition(PartitionSpec("T1", 1, [2, 3], load))  # same rack r1
+    b.add_partition(PartitionSpec("T1", 2, [0, 2], load))  # ok
+    return b.build()
+
+
+def dead_broker_cluster() -> ClusterState:
+    """4 brokers, one dead — self-healing must evacuate it.
+
+    Analog of DeterministicCluster dead-broker fixtures (reference
+    common/DeterministicCluster.java:356)."""
+    b = ClusterModelBuilder()
+    cap = np.array([100.0, 1000.0, 1000.0, 10000.0], np.float32)
+    for i in range(4):
+        b.add_broker(BrokerSpec(i, rack=f"r{i % 2}", capacity=cap, alive=(i != 3)))
+    load = np.array([4.0, 15.0, 20.0, 80.0], np.float32)
+    for p in range(6):
+        brokers = [(p + i) % 4 for i in range(2)]
+        b.add_partition(PartitionSpec("T1", p, brokers, load))
+    return b.build()
+
+
+@dataclasses.dataclass
+class RandomClusterSpec:
+    """Knobs of the random generator (reference common/ClusterProperty.java)."""
+
+    num_brokers: int = 50
+    num_racks: int = 5
+    num_topics: int = 20
+    num_partitions: int = 1000
+    min_replication: int = 2
+    max_replication: int = 3
+    mean_cpu: float = 2.0  # per-partition leader CPU %
+    mean_nw_in: float = 100.0
+    mean_nw_out: float = 120.0
+    mean_disk: float = 500.0
+    deviation: float = 0.5  # lognormal-ish spread
+    broker_capacity: tuple[float, float, float, float] = (100.0, 20_000.0, 20_000.0, 500_000.0)
+    num_dead_brokers: int = 0
+    num_new_brokers: int = 0
+    skew: float = 0.0  # 0 = uniform placement; >0 biases placement to low-id brokers
+    replica_capacity: int | None = None  # pad replica axis to this
+
+
+def random_cluster(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
+    rng = np.random.default_rng(seed)
+    b = ClusterModelBuilder(replica_capacity=spec.replica_capacity)
+    cap = np.asarray(spec.broker_capacity, np.float32)
+    for i in range(spec.num_brokers):
+        alive = i < spec.num_brokers - spec.num_dead_brokers
+        new = i >= spec.num_brokers - spec.num_new_brokers if alive else False
+        b.add_broker(
+            BrokerSpec(i, rack=f"r{i % spec.num_racks}", capacity=cap, alive=alive, new_broker=new)
+        )
+    means = np.array(
+        [spec.mean_cpu, spec.mean_nw_in, spec.mean_nw_out, spec.mean_disk], np.float64
+    )
+    # placement weights: optionally skewed so the cluster starts unbalanced
+    w = np.exp(-spec.skew * np.arange(spec.num_brokers) / max(1, spec.num_brokers - 1))
+    # round-robin topic assignment so exactly num_partitions are generated
+    for pid in range(spec.num_partitions):
+        t = pid % spec.num_topics
+        p = pid // spec.num_topics
+        rf = int(rng.integers(spec.min_replication, spec.max_replication + 1))
+        rf = min(rf, spec.num_brokers)
+        brokers = rng.choice(spec.num_brokers, size=rf, replace=False, p=w / w.sum()).tolist()
+        load = (means * np.exp(rng.normal(0.0, spec.deviation, NUM_RESOURCES))).astype(np.float32)
+        b.add_partition(PartitionSpec(f"T{t}", p, [int(x) for x in brokers], load))
+    return b.build()

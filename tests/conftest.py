@@ -1,0 +1,13 @@
+"""Test config: force an 8-device virtual CPU platform before jax imports.
+
+Multi-chip sharding paths are exercised on a virtual device mesh (real TPU
+hardware in CI is single-chip; the driver separately dry-runs
+__graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

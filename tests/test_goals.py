@@ -1,0 +1,126 @@
+"""Goal function unit tests (M0) — semantics checks on deterministic fixtures."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config import DEFAULT_CONSTRAINT, BalancingConstraint
+from cruise_control_tpu.models import compute_aggregates
+from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER, GOALS_BY_NAME, get_goals
+from cruise_control_tpu.testing.fixtures import (
+    RandomClusterSpec,
+    dead_broker_cluster,
+    rack_violated_cluster,
+    random_cluster,
+    small_cluster,
+)
+
+
+def v(goal_name, state, constraint=DEFAULT_CONSTRAINT):
+    agg = compute_aggregates(state)
+    return float(GOALS_BY_NAME[goal_name].violation(state, agg, constraint))
+
+
+def test_registry_resolves_default_order():
+    goals = get_goals()
+    assert [g.name for g in goals] == DEFAULT_GOAL_ORDER
+
+
+def test_rack_aware_violation():
+    assert v("RackAwareGoal", rack_violated_cluster()) > 0
+    assert v("RackAwareGoal", small_cluster()) == 0.0
+
+
+def test_offline_replica_goal():
+    assert v("OfflineReplicaGoal", dead_broker_cluster()) > 0
+    assert v("OfflineReplicaGoal", small_cluster()) == 0.0
+
+
+def test_replica_capacity_goal():
+    s = small_cluster()
+    assert v("ReplicaCapacityGoal", s) == 0.0
+    tight = dataclasses.replace(DEFAULT_CONSTRAINT, max_replicas_per_broker=3)
+    # broker 0 has 4 replicas -> violation under cap of 3
+    assert v("ReplicaCapacityGoal", s, tight) > 0
+
+
+def test_capacity_goals_fire_on_overload():
+    s = small_cluster()
+    # broker 0: NW_OUT load = 100+90+80+70 = 340 > 0.8 * 1000? no (800) -> 0
+    assert v("NetworkOutboundCapacityGoal", s) == 0.0
+    tight = dataclasses.replace(DEFAULT_CONSTRAINT, capacity_threshold=(0.8, 0.8, 0.3, 0.8))
+    # threshold 0.3 -> 300 < 340 on broker 0
+    assert v("NetworkOutboundCapacityGoal", s, tight) > 0
+
+
+def test_cpu_capacity_goal_host_resource():
+    s = small_cluster()
+    tight = dataclasses.replace(DEFAULT_CONSTRAINT, capacity_threshold=(0.3, 0.8, 0.8, 0.8))
+    # broker 0 leader CPU = 18+15+12+10 = 55 > 30
+    assert v("CpuCapacityGoal", s, tight) > 0
+    assert v("CpuCapacityGoal", s) == 0.0
+
+
+def test_resource_distribution_violated_on_skewed_cluster():
+    s = small_cluster()
+    # everything piled on broker 0 -> clearly outside the 1.1x band
+    assert v("NetworkOutboundUsageDistributionGoal", s) > 0
+    assert v("DiskUsageDistributionGoal", s) > 0
+
+
+def test_resource_distribution_zero_on_perfectly_balanced():
+    # uniform cluster: same load everywhere
+    from cruise_control_tpu.models import BrokerSpec, ClusterModelBuilder, PartitionSpec
+
+    b = ClusterModelBuilder()
+    cap = np.array([100.0, 1000.0, 1000.0, 10000.0], np.float32)
+    for i in range(4):
+        b.add_broker(BrokerSpec(i, rack=f"r{i}", capacity=cap))
+    load = np.array([4.0, 20.0, 20.0, 100.0], np.float32)
+    # ring placement: every broker gets 2 replicas, 1 leader
+    for p in range(4):
+        b.add_partition(PartitionSpec("T", p, [p, (p + 1) % 4], load))
+    s = b.build()
+    assert v("ReplicaDistributionGoal", s) == 0.0
+    assert v("LeaderReplicaDistributionGoal", s) == 0.0
+    assert v("DiskUsageDistributionGoal", s) == 0.0
+
+
+def test_leader_goals_on_skew():
+    s = small_cluster()  # broker 0 leads everything
+    assert v("LeaderReplicaDistributionGoal", s) > 0
+    assert v("LeaderBytesInDistributionGoal", s) > 0
+
+
+def test_preferred_leader_election_goal():
+    s = small_cluster()
+    assert v("PreferredLeaderElectionGoal", s) == 0.0
+    # demote partition 0's preferred leader
+    first_leader = int(np.flatnonzero(np.asarray(s.replica_is_leader))[0])
+    part = int(s.replica_partition[first_leader])
+    sibling = int(
+        np.flatnonzero(
+            (np.asarray(s.replica_partition) == part)
+            & (np.arange(s.shape.R) != first_leader)
+        )[0]
+    )
+    moved = s.with_leadership_moved(jnp.asarray(first_leader), jnp.asarray(sibling))
+    assert v("PreferredLeaderElectionGoal", moved) > 0
+
+
+def test_topic_replica_distribution():
+    spec = RandomClusterSpec(num_brokers=10, num_topics=3, num_partitions=90, skew=3.0)
+    s = random_cluster(spec, seed=3)
+    assert v("TopicReplicaDistributionGoal", s) >= 0  # smoke: computes
+
+
+def test_all_goals_finite_on_random_cluster():
+    s = random_cluster(RandomClusterSpec(num_brokers=12, num_partitions=300, num_dead_brokers=1), seed=4)
+    agg = compute_aggregates(s)
+    for g in GOALS_BY_NAME.values():
+        val = float(g.violation(s, agg, DEFAULT_CONSTRAINT))
+        assert np.isfinite(val) and val >= 0, g.name
+        sc = float(g.score(s, agg, DEFAULT_CONSTRAINT))
+        assert np.isfinite(sc) and sc >= 0, g.name

@@ -1,0 +1,148 @@
+"""ClusterState / builder / aggregates / stats unit tests (M0)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.models import (
+    ClusterModelBuilder,
+    BrokerSpec,
+    PartitionSpec,
+    compute_aggregates,
+    compute_stats,
+    validate,
+)
+from cruise_control_tpu.testing.fixtures import (
+    RandomClusterSpec,
+    dead_broker_cluster,
+    rack_violated_cluster,
+    random_cluster,
+    small_cluster,
+)
+
+
+def test_small_cluster_shapes():
+    s = small_cluster()
+    assert s.shape.B == 3
+    assert s.shape.P == 4
+    assert s.shape.R == 8
+    assert s.shape.num_racks == 3
+    assert s.shape.num_topics == 2
+    assert validate(s) == []
+
+
+def test_effective_load_leadership_split():
+    s = small_cluster()
+    load = np.asarray(s.replica_load)
+    lead = np.asarray(s.replica_is_leader)
+    # followers serve no NW_OUT
+    assert (load[~lead][:, Resource.NW_OUT] == 0).all()
+    # leaders carry their full leader load
+    ll = np.asarray(s.replica_load_leader)
+    assert np.allclose(load[lead], ll[lead])
+
+
+def test_broker_load_aggregation_matches_numpy():
+    s = random_cluster(RandomClusterSpec(num_brokers=10, num_partitions=200), seed=1)
+    agg = compute_aggregates(s)
+    load = np.asarray(s.replica_load)
+    brk = np.asarray(s.replica_broker)
+    expected = np.zeros((10, NUM_RESOURCES), np.float32)
+    np.add.at(expected, brk, load)
+    assert np.allclose(np.asarray(agg.broker_load), expected, rtol=1e-4, atol=1e-3)
+
+
+def test_replica_and_leader_counts():
+    s = small_cluster()
+    agg = compute_aggregates(s)
+    # broker 0 holds a replica of every partition and leads all 4
+    assert int(agg.broker_replica_count[0]) == 4
+    assert int(agg.broker_leader_count[0]) == 4
+    assert int(agg.broker_leader_count[1]) == 0
+    assert int(np.asarray(agg.broker_replica_count).sum()) == 8
+
+
+def test_part_rack_count_detects_violations():
+    s = rack_violated_cluster()
+    agg = compute_aggregates(s)
+    prc = np.asarray(agg.part_rack_count)
+    # partitions 0 and 1 are rack-violated (2 replicas on one rack)
+    assert prc.max() == 2
+    assert (prc == 2).sum() == 2
+
+
+def test_potential_nw_out():
+    s = small_cluster()
+    agg = compute_aggregates(s)
+    ll = np.asarray(s.replica_load_leader)[:, Resource.NW_OUT]
+    brk = np.asarray(s.replica_broker)
+    expected = np.zeros(3, np.float32)
+    np.add.at(expected, brk, ll)
+    assert np.allclose(np.asarray(agg.broker_potential_nw_out), expected, rtol=1e-5)
+
+
+def test_dead_broker_offline_flags():
+    s = dead_broker_cluster()
+    off = np.asarray(s.replica_offline)
+    brk = np.asarray(s.replica_broker)
+    assert (off == (brk == 3)).all()
+
+
+def test_stats_on_random_cluster():
+    s = random_cluster(RandomClusterSpec(num_brokers=20, num_partitions=500), seed=2)
+    stats = compute_stats(s)
+    avg = np.asarray(stats.avg)
+    mx = np.asarray(stats.max)
+    mn = np.asarray(stats.min)
+    assert (mx >= avg - 1e-5).all() and (avg >= mn - 1e-5).all()
+    assert (np.asarray(stats.std) >= 0).all()
+
+
+def test_builder_rejects_sparse_broker_ids():
+    b = ClusterModelBuilder()
+    b.add_broker(BrokerSpec(0, rack="r0"))
+    b.add_broker(BrokerSpec(2, rack="r0"))
+    with pytest.raises(ValueError, match="dense"):
+        b.build()
+
+
+def test_replica_padding():
+    spec = RandomClusterSpec(num_brokers=5, num_partitions=50, replica_capacity=512)
+    s = random_cluster(spec, seed=0)
+    assert s.shape.R == 512
+    valid = np.asarray(s.replica_valid)
+    assert valid.sum() < 512
+    # padded rows carry no load in aggregates
+    agg = compute_aggregates(s)
+    total = float(np.asarray(agg.broker_load).sum())
+    manual = float(np.asarray(s.replica_load)[valid].sum())
+    assert np.isclose(total, manual, rtol=1e-4)
+
+
+def test_validate_catches_double_leader():
+    s = small_cluster()
+    import dataclasses
+
+    bad = dataclasses.replace(s, replica_is_leader=jnp.ones_like(s.replica_is_leader))
+    problems = validate(bad, strict=False)
+    assert any("leader" in p for p in problems)
+
+
+def test_jbod_disk_modeling():
+    b = ClusterModelBuilder()
+    b.add_broker(BrokerSpec(0, rack="r0", disk_capacities=[1000.0, 2000.0]))
+    b.add_broker(BrokerSpec(1, rack="r1", disk_capacities=[1500.0, 1500.0], bad_disks=[1]))
+    load = np.array([1.0, 10.0, 10.0, 300.0], np.float32)
+    b.add_partition(PartitionSpec("T", 0, [0, 1], load, replica_disks=[1, 1]))
+    s = b.build()
+    assert s.shape.max_disks_per_broker == 2
+    assert float(s.broker_capacity[0, Resource.DISK]) == 3000.0
+    assert bool(s.disk_alive[1, 1]) is False
+    # replica on broker 1's dead disk is offline
+    off = np.asarray(s.replica_offline)
+    brk = np.asarray(s.replica_broker)
+    assert off[brk == 1].all()
+    agg = compute_aggregates(s)
+    dl = np.asarray(agg.disk_load)
+    assert np.isclose(dl[0, 1], 300.0) and dl[0, 0] == 0.0
