@@ -1,0 +1,28 @@
+"""Analyzer layer: goal framework + batched TPU optimization engine.
+
+Reference: cruise-control/.../analyzer/ (GoalOptimizer.java, goals/*).
+"""
+
+from cruise_control_tpu.analyzer.engine import Engine, OptimizerConfig
+from cruise_control_tpu.analyzer.objective import (
+    DEFAULT_CHAIN,
+    GoalChain,
+    balancedness_score,
+)
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
+from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal, extract_proposals
+
+__all__ = [
+    "DEFAULT_CHAIN",
+    "DEFAULT_OPTIONS",
+    "Engine",
+    "ExecutionProposal",
+    "GoalChain",
+    "GoalOptimizer",
+    "OptimizationOptions",
+    "OptimizerConfig",
+    "OptimizerResult",
+    "balancedness_score",
+    "extract_proposals",
+]

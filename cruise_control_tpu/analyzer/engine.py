@@ -1,0 +1,898 @@
+"""Batched simulated-annealing/greedy optimization engine.
+
+This replaces the reference's single-threaded greedy goal loop
+(reference analyzer/goals/AbstractGoal.java:66-107: while(!finished)
+rebalanceForBroker -> maybeApplyBalancingAction, one move tried at a time
+with O(#goals) veto checks) with a TPU-shaped search:
+
+  every step, K candidate moves (replica relocations + leadership
+  transfers) are sampled and their exact objective deltas are computed IN
+  PARALLEL in O(1) each — gathers against per-broker aggregates plus
+  frozen per-step globals — then a maximal non-conflicting subset of
+  improving moves is accepted (per-broker/per-partition rank argmin), and
+  aggregates are updated by scatter.  Hundreds of moves land per step; the
+  whole step is one fused XLA program under `lax.scan`.
+
+Objective semantics match GoalChain (analyzer/objective.py): weighted
+lexicographic goal violations + a dispersion tiebreaker.  The delta path
+and the full-eval path (goal classes) are kept consistent by unit test
+(tests/test_engine.py).
+
+Simulated annealing: a candidate is accepted if delta < -T·log(u) — at
+T=0 this is pure greedy improvement; early rounds use T>0 to escape the
+local optima the reference needs explicit swap moves for (reference
+ResourceDistributionGoal.java:502-599; SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
+from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.aggregates import BrokerAggregates, compute_aggregates
+from cruise_control_tpu.models.state import ClusterState
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Search knobs (no reference analog — the reference search is greedy)."""
+
+    num_candidates: int = 2048  # K sampled moves per step
+    leadership_candidates: int = 512  # of which leadership transfers
+    steps_per_round: int = 64  # jitted scan length
+    num_rounds: int = 10  # python-level rounds (aggregates re-derived each round)
+    init_temperature_scale: float = 1e-2  # T0 = scale * initial objective
+    temperature_decay: float = 0.5  # per-round geometric decay; last round T=0
+    seed: int = 0
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "replica_broker",
+        "replica_is_leader",
+        "replica_disk",
+        "broker_load",
+        "broker_replica_count",
+        "broker_leader_count",
+        "broker_potential_nw_out",
+        "broker_leader_bytes_in",
+        "broker_topic_count",
+        "part_rack_count",
+        "disk_load",
+        "host_load",
+        "key",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class EngineCarry:
+    """Mutable placement + incremental aggregates carried through lax.scan."""
+
+    replica_broker: jax.Array
+    replica_is_leader: jax.Array
+    replica_disk: jax.Array
+    broker_load: jax.Array  # f32[B, 4] (includes dead brokers' stranded load)
+    broker_replica_count: jax.Array  # i32[B]
+    broker_leader_count: jax.Array  # i32[B]
+    broker_potential_nw_out: jax.Array  # f32[B]
+    broker_leader_bytes_in: jax.Array  # f32[B]
+    broker_topic_count: jax.Array  # i32[T, B]
+    part_rack_count: jax.Array  # i32[P, num_racks]
+    disk_load: jax.Array  # f32[B, D]
+    host_load: jax.Array  # f32[H, 4]
+    key: jax.Array
+
+
+def partition_replica_table(state: ClusterState) -> np.ndarray:
+    """i32[P, max_rf] replica indices per partition, padded with R.
+
+    Membership never changes during optimization (only placement does), so
+    this is built once on the host.  Mirrors reference model/Partition.java's
+    replica list.
+    """
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)
+    pos = np.asarray(state.replica_pos)
+    P, R = state.shape.P, state.shape.R
+    max_rf = 1
+    counts = np.bincount(part[valid], minlength=P)
+    if counts.size:
+        max_rf = max(1, int(counts.max()))
+    table = np.full((P, max_rf), R, np.int32)
+    idx = np.nonzero(valid)[0]
+    slot = np.minimum(pos[idx], max_rf - 1)
+    table[part[idx], slot] = idx
+    return table
+
+
+def _weights_by_name(chain: GoalChain) -> dict[str, float]:
+    return {g.name: w for g, w in zip(chain.goals, chain.weights)}
+
+
+_RES_DIST_NAMES = {
+    Resource.CPU: "CpuUsageDistributionGoal",
+    Resource.NW_IN: "NetworkInboundUsageDistributionGoal",
+    Resource.NW_OUT: "NetworkOutboundUsageDistributionGoal",
+    Resource.DISK: "DiskUsageDistributionGoal",
+}
+_CAP_NAMES = {
+    Resource.CPU: "CpuCapacityGoal",
+    Resource.NW_IN: "NetworkInboundCapacityGoal",
+    Resource.NW_OUT: "NetworkOutboundCapacityGoal",
+    Resource.DISK: "DiskCapacityGoal",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class _Weights:
+    """Per-term weights extracted from a GoalChain (0 = goal not in chain)."""
+
+    offline: float
+    rack: float
+    replica_cap: float
+    cap: tuple[float, float, float, float]  # by Resource index
+    pot_nw_out: float
+    replica_dist: float
+    leader_dist: float
+    res_dist: tuple[float, float, float, float]
+    topic_dist: float
+    lbin_dist: float
+    pref_leader: float
+    intra_cap: float
+    intra_dist: float
+    tie: float
+
+    @staticmethod
+    def from_chain(chain: GoalChain) -> "_Weights":
+        w = _weights_by_name(chain)
+        return _Weights(
+            offline=w.get("OfflineReplicaGoal", 0.0),
+            rack=w.get("RackAwareGoal", 0.0),
+            replica_cap=w.get("ReplicaCapacityGoal", 0.0),
+            cap=tuple(w.get(_CAP_NAMES[Resource(i)], 0.0) for i in range(4)),
+            pot_nw_out=w.get("PotentialNwOutGoal", 0.0),
+            replica_dist=w.get("ReplicaDistributionGoal", 0.0),
+            leader_dist=w.get("LeaderReplicaDistributionGoal", 0.0),
+            res_dist=tuple(w.get(_RES_DIST_NAMES[Resource(i)], 0.0) for i in range(4)),
+            topic_dist=w.get("TopicReplicaDistributionGoal", 0.0),
+            lbin_dist=w.get("LeaderBytesInDistributionGoal", 0.0),
+            pref_leader=w.get("PreferredLeaderElectionGoal", 0.0),
+            intra_cap=w.get("IntraBrokerDiskCapacityGoal", 0.0),
+            intra_dist=w.get("IntraBrokerDiskUsageDistributionGoal", 0.0),
+            tie=TIE_WEIGHT * min(chain.weights),
+        )
+
+
+def _relu(x):
+    return jnp.maximum(x, 0.0)
+
+
+class Engine:
+    """Compiled optimization engine bound to one cluster shape.
+
+    Construction precomputes static topology tensors; `run` executes the
+    annealing schedule and returns final placement.  Rebinding per model
+    generation is cheap relative to one XLA compile, and recompilation only
+    happens when the padded ClusterShape changes (pad-and-mask, SURVEY §7).
+    """
+
+    def __init__(
+        self,
+        state: ClusterState,
+        chain: GoalChain,
+        constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        config: OptimizerConfig = OptimizerConfig(),
+    ):
+        self.state = state
+        self.chain = chain
+        self.constraint = constraint
+        self.options = options
+        self.config = config
+        self.w = _Weights.from_chain(chain)
+        s = state.shape
+
+        # --- static host-side precomputation ---
+        self.part_replicas = jnp.asarray(partition_replica_table(state))  # [P, max_rf]
+        alive = np.asarray(state.broker_valid) & np.asarray(state.broker_alive)
+        self.alive = jnp.asarray(alive)
+        self.n_alive = max(1, int(alive.sum()))
+        cap = np.asarray(state.broker_capacity)
+        self.total_cap = jnp.asarray((cap * alive[:, None]).sum(0) + 1e-12)  # [4]
+        self.n_valid = max(1, int(np.asarray(state.replica_valid).sum()))
+        dest = alive & options.dest_allowed(state)
+        self.dest_ids = jnp.asarray(np.nonzero(dest)[0].astype(np.int32))
+        lead_ok = alive & options.leadership_allowed(state)
+        self.lead_ok = jnp.asarray(lead_ok)
+        self.topic_movable = jnp.asarray(options.topic_movable(state))
+        host = np.asarray(state.broker_host)
+        bph = np.bincount(host[np.asarray(state.broker_valid)], minlength=s.num_hosts)
+        self.host_multi = jnp.asarray(bph > 1)
+        dmask = np.asarray(state.disk_alive) & alive[:, None]
+        self.total_disk_cap = float((np.asarray(state.disk_capacity) * dmask).sum() + 1e-12)
+        self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
+        self._scan = jax.jit(self._make_scan())
+
+    # ------------------------------------------------------------------
+    # state <-> carry
+    # ------------------------------------------------------------------
+
+    def init_carry(self, key: jax.Array) -> EngineCarry:
+        st = self.state
+        agg = compute_aggregates(st)
+        hseg = jnp.where(st.broker_valid, st.broker_host, st.shape.num_hosts)
+        host_load = jax.ops.segment_sum(
+            agg.broker_load, hseg, num_segments=st.shape.num_hosts + 1
+        )[: st.shape.num_hosts]
+        return EngineCarry(
+            replica_broker=st.replica_broker,
+            replica_is_leader=st.replica_is_leader,
+            replica_disk=st.replica_disk,
+            broker_load=agg.broker_load,
+            broker_replica_count=agg.broker_replica_count,
+            broker_leader_count=agg.broker_leader_count,
+            broker_potential_nw_out=agg.broker_potential_nw_out,
+            broker_leader_bytes_in=agg.broker_leader_bytes_in,
+            broker_topic_count=agg.broker_topic_count,
+            part_rack_count=agg.part_rack_count,
+            disk_load=agg.disk_load,
+            host_load=host_load,
+            key=key,
+        )
+
+    def carry_to_state(self, carry: EngineCarry) -> ClusterState:
+        st = self.state
+        offline = ~(
+            st.broker_alive[carry.replica_broker]
+            & st.disk_alive[carry.replica_broker, carry.replica_disk]
+        )
+        return dataclasses.replace(
+            st,
+            replica_broker=carry.replica_broker,
+            replica_is_leader=carry.replica_is_leader,
+            replica_disk=carry.replica_disk,
+            replica_offline=offline & st.replica_valid,
+        )
+
+    # ------------------------------------------------------------------
+    # objective terms
+    # ------------------------------------------------------------------
+
+    def _globals(self, carry: EngineCarry):
+        """Per-step frozen global scalars, O(B + T·B) from aggregates."""
+        st = self.state
+        am = self.alive
+        load = jnp.where(am[:, None], carry.broker_load, 0.0)
+        total_load = load.sum(0)  # [4]
+        avg_pct = total_load / self.total_cap
+        counts = jnp.where(am, carry.broker_replica_count, 0)
+        total_count = counts.sum()
+        lcounts = jnp.where(am, carry.broker_leader_count, 0)
+        total_lcount = lcounts.sum()
+        lbin = jnp.where(am, carry.broker_leader_bytes_in, 0.0)
+        total_lbin = lbin.sum()
+        topic_total = jnp.where(am[None, :], carry.broker_topic_count, 0).sum(1)  # [T]
+        dmask = st.disk_alive & am[:, None]
+        total_disk_load = jnp.where(dmask, carry.disk_load, 0.0).sum()
+        # dispersion tiebreaker sufficient statistics (utilization pct)
+        pct = jnp.where(am[:, None], carry.broker_load / (st.broker_capacity + 1e-12), 0.0)
+        return dict(
+            total_load=total_load,
+            avg_pct=avg_pct,
+            avg_count=total_count.astype(jnp.float32) / self.n_alive,
+            total_count=jnp.maximum(total_count.astype(jnp.float32), 1.0),
+            avg_lcount=total_lcount.astype(jnp.float32) / self.n_alive,
+            total_lcount=jnp.maximum(total_lcount.astype(jnp.float32), 1.0),
+            avg_lbin=total_lbin / self.n_alive,
+            total_lbin=total_lbin + 1e-12,
+            topic_avg=topic_total.astype(jnp.float32) / self.n_alive,
+            total_disk_load=total_disk_load + 1e-12,
+            pct_sum=pct.sum(0),  # [4]
+            pct_sumsq=(pct * pct).sum(0),  # [4]
+        )
+
+    def _broker_terms(self, b, load, rcount, lcount, pot, lbin, g):
+        """Weighted objective contribution of broker(s) b given hypothetical
+        per-broker stats.  All inputs may carry a leading candidate axis.
+
+        Mirrors (in delta-decomposable form): CapacityGoal (broker
+        granularity), ReplicaCapacityGoal, PotentialNwOutGoal,
+        ResourceDistributionGoal, Replica/LeaderReplicaDistributionGoal,
+        LeaderBytesInDistributionGoal — see the goal classes for the
+        reference citations.
+        """
+        st = self.state
+        w = self.w
+        c = self.constraint
+        cap = st.broker_capacity[b]  # [..., 4]
+        alive = self.alive[b]
+        out = jnp.zeros(jnp.shape(b), jnp.float32)
+
+        # capacity goals (broker granularity; host granularity handled in
+        # _host_terms for multi-broker hosts)
+        single = ~self.host_multi[st.broker_host[b]]
+        for r in range(NUM_RESOURCES):
+            thresh = c.capacity_threshold[r]
+            excess = _relu(load[..., r] - thresh * cap[..., r])
+            host_res = Resource(r).is_host_resource
+            use_broker = single if host_res else jnp.ones_like(single)
+            out += w.cap[r] * jnp.where(alive & use_broker, excess, 0.0) / self.total_cap[r]
+
+        # replica capacity
+        exc = _relu((rcount - c.max_replicas_per_broker).astype(jnp.float32))
+        out += w.replica_cap * jnp.where(alive, exc, 0.0) / self.n_valid
+
+        # potential nw out
+        r = int(Resource.NW_OUT)
+        exc = _relu(pot - c.capacity_threshold[r] * cap[..., r])
+        out += w.pot_nw_out * jnp.where(alive, exc, 0.0) / self.total_cap[r]
+
+        # resource distribution bands
+        for r in range(NUM_RESOURCES):
+            t = c.balance_threshold[r]
+            upper = g["avg_pct"][r] * t * cap[..., r]
+            lower = g["avg_pct"][r] * max(0.0, 2.0 - t) * cap[..., r]
+            term = _relu(load[..., r] - upper) + _relu(lower - load[..., r])
+            out += w.res_dist[r] * jnp.where(alive, term, 0.0) / (g["total_load"][r] + 1e-12)
+
+        # replica count distribution
+        t = c.replica_count_balance_threshold
+        upper = jnp.ceil(g["avg_count"] * t)
+        lower = jnp.floor(g["avg_count"] * max(0.0, 2.0 - t))
+        rc = rcount.astype(jnp.float32)
+        term = _relu(rc - upper) + _relu(lower - rc)
+        out += w.replica_dist * jnp.where(alive, term, 0.0) / g["total_count"]
+
+        # leader count distribution
+        t = c.leader_replica_count_balance_threshold
+        upper = jnp.ceil(g["avg_lcount"] * t)
+        lower = jnp.floor(g["avg_lcount"] * max(0.0, 2.0 - t))
+        lc = lcount.astype(jnp.float32)
+        term = _relu(lc - upper) + _relu(lower - lc)
+        out += w.leader_dist * jnp.where(alive, term, 0.0) / g["total_lcount"]
+
+        # leader bytes-in distribution (upper band only)
+        t = c.balance_threshold[int(Resource.NW_IN)]
+        term = _relu(lbin - g["avg_lbin"] * t)
+        out += w.lbin_dist * jnp.where(alive, term, 0.0) / g["total_lbin"]
+
+        return out
+
+    def _host_terms(self, h, hload):
+        """Host-granularity capacity terms for multi-broker hosts
+        (reference CapacityGoal host/broker split)."""
+        st = self.state
+        c = self.constraint
+        w = self.w
+        # host capacity: sum of alive member broker capacities — static
+        if not hasattr(self, "_host_cap"):
+            cap = jnp.where(self.alive[:, None], self.state.broker_capacity, 0.0)
+            hseg = jnp.where(
+                st.broker_valid, st.broker_host, st.shape.num_hosts
+            )
+            self._host_cap = jax.ops.segment_sum(
+                cap, hseg, num_segments=st.shape.num_hosts + 1
+            )[: st.shape.num_hosts]
+        hcap = self._host_cap[h]
+        multi = self.host_multi[h]
+        out = jnp.zeros(jnp.shape(h), jnp.float32)
+        for r in range(NUM_RESOURCES):
+            if not Resource(r).is_host_resource:
+                continue
+            excess = _relu(hload[..., r] - c.capacity_threshold[r] * hcap[..., r])
+            out += self.w.cap[r] * jnp.where(multi, excess, 0.0) / self.total_cap[r]
+        return out
+
+    def _disk_terms(self, b, disk_row, broker_disk_load, g):
+        """Intra-broker disk goal terms for broker(s) b.
+
+        disk_row: hypothetical f32[..., D] per-logdir load of broker b.
+        broker_disk_load: its sum (for the per-broker distribution band).
+        """
+        st = self.state
+        w = self.w
+        if w.intra_cap == 0.0 and w.intra_dist == 0.0:
+            return jnp.zeros(jnp.shape(b), jnp.float32)
+        dcap = st.disk_capacity[b]  # [..., D]
+        dalive = st.disk_alive[b] & self.alive[b][..., None]
+        out = jnp.zeros(jnp.shape(b), jnp.float32)
+        # IntraBrokerDiskCapacityGoal
+        cap_term = jnp.where(
+            dalive, _relu(disk_row - self.d_thresh * dcap), disk_row
+        ).sum(-1)
+        out += w.intra_cap * cap_term / self.total_disk_cap
+        # IntraBrokerDiskUsageDistributionGoal
+        bcap = jnp.where(dalive, dcap, 0.0).sum(-1, keepdims=True)
+        avg_pct = broker_disk_load[..., None] / (bcap + 1e-12)
+        t = self.constraint.balance_threshold[int(Resource.DISK)]
+        upper = avg_pct * t * dcap
+        lower = avg_pct * max(0.0, 2.0 - t) * dcap
+        dist = jnp.where(dalive, _relu(disk_row - upper) + _relu(lower - disk_row), 0.0).sum(-1)
+        out += w.intra_dist * dist / g["total_disk_load"]
+        return out
+
+    def _tie_term(self, pct_sum, pct_sumsq):
+        """Dispersion tiebreaker: sum over resources of std of utilization pct."""
+        n = self.n_alive
+        var = _relu(pct_sumsq / n - (pct_sum / n) ** 2)
+        return self.w.tie * jnp.sqrt(var + 1e-18).sum()
+
+    # ------------------------------------------------------------------
+    # candidate generation + delta evaluation
+    # ------------------------------------------------------------------
+
+    def _replica_candidates(self, carry: EngineCarry, key: jax.Array, g):
+        """K_r replica-move candidates -> (delta, src, dst, part, payload)."""
+        st = self.state
+        cfg = self.config
+        K = cfg.num_candidates - cfg.leadership_candidates
+        k1, k2 = jax.random.split(key)
+        r = jax.random.randint(k1, (K,), 0, st.shape.R)
+        dst = self.dest_ids[jax.random.randint(k2, (K,), 0, self.dest_ids.shape[0])]
+        src = carry.replica_broker[r]
+        part = st.replica_partition[r]
+
+        # feasibility (reference GoalUtils.legitMove:153 + exclusions)
+        offline = ~(
+            st.broker_alive[src] & st.disk_alive[src, carry.replica_disk[r]]
+        )
+        movable = self.topic_movable[st.replica_topic[r]] | offline
+        feasible = st.replica_valid[r] & movable & (src != dst)
+        # no second replica of the partition on dst (reference
+        # ClusterModel.relocateReplica precondition)
+        members = self.part_replicas[part]  # [K, max_rf]
+        member_broker = jnp.where(
+            members < st.shape.R, carry.replica_broker[jnp.minimum(members, st.shape.R - 1)], -1
+        )
+        feasible &= ~(member_broker == dst[:, None]).any(axis=1)
+
+        is_lead = carry.replica_is_leader[r]
+        load = jnp.where(
+            is_lead[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+        )  # [K, 4]
+        load = jnp.where(st.replica_valid[r][:, None], load, 0.0)
+
+        # destination logdir: most-free alive disk on dst
+        ddst_pct = carry.disk_load[dst] / (st.disk_capacity[dst] + 1e-12)
+        ddst_pct = jnp.where(st.disk_alive[dst], ddst_pct, jnp.inf)
+        d_dst = jnp.argmin(ddst_pct, axis=1).astype(jnp.int32)
+        d_src = carry.replica_disk[r]
+
+        pot = st.replica_load_leader[r, int(Resource.NW_OUT)]
+        lbin = jnp.where(is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0)
+        dcount = jnp.ones((K,), jnp.int32)
+        dlcount = is_lead.astype(jnp.int32)
+
+        delta = self._move_delta(
+            carry,
+            g,
+            src=src,
+            dst=dst,
+            dload_src=-load,
+            dload_dst=load,
+            dcount=dcount,
+            dlcount=dlcount,
+            dpot=pot,
+            dlbin=lbin,
+            d_src=d_src,
+            d_dst=d_dst,
+            ddisk=load[:, int(Resource.DISK)],
+        )
+
+        # rack cells (reference RackAwareGoal)
+        rack_s, rack_d = st.broker_rack[src], st.broker_rack[dst]
+        c_s = carry.part_rack_count[part, rack_s].astype(jnp.float32)
+        c_d = carry.part_rack_count[part, rack_d].astype(jnp.float32)
+        drack = (_relu(c_s - 2.0) - _relu(c_s - 1.0)) + (_relu(c_d) - _relu(c_d - 1.0))
+        delta += self.w.rack * jnp.where(rack_s != rack_d, drack, 0.0) / self.n_valid
+
+        # topic cells (reference TopicReplicaDistributionGoal)
+        if self.w.topic_dist != 0.0:
+            t = st.replica_topic[r]
+            tt = self.constraint.topic_replica_count_balance_threshold
+            upper = jnp.ceil(g["topic_avg"][t] * tt)
+            lower = jnp.floor(g["topic_avg"][t] * max(0.0, 2.0 - tt))
+
+            def cell(cnt):
+                return _relu(cnt - upper) + _relu(lower - cnt)
+
+            ct_s = carry.broker_topic_count[t, src].astype(jnp.float32)
+            ct_d = carry.broker_topic_count[t, dst].astype(jnp.float32)
+            dtop = (cell(ct_s - 1.0) - cell(ct_s)) + (cell(ct_d + 1.0) - cell(ct_d))
+            delta += self.w.topic_dist * dtop / g["total_count"]
+
+        # offline-replica term (reference OptimizationVerifier BROKEN_BROKERS)
+        dst_ok = st.broker_alive[dst] & st.disk_alive[dst, d_dst]
+        doff = (~dst_ok).astype(jnp.float32) - offline.astype(jnp.float32)
+        delta += self.w.offline * doff / self.n_valid
+
+        # preferred-leader eligibility shift (reference PreferredLeaderElectionGoal)
+        if self.w.pref_leader != 0.0:
+            pref = (st.replica_pos[r] == 0) & st.replica_valid[r] & ~is_lead
+            was = pref & ~offline
+            now = pref & dst_ok
+            delta += (
+                self.w.pref_leader
+                * (now.astype(jnp.float32) - was.astype(jnp.float32))
+                / max(1, st.shape.P)
+            )
+
+        payload = dict(kind=0, r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
+                       pot=pot, lbin=lbin, d_src=d_src)
+        return delta, feasible, src, dst, part, payload
+
+    def _leadership_candidates(self, carry: EngineCarry, key: jax.Array, g):
+        """K_l leadership-transfer candidates (reference relocateLeadership:374)."""
+        st = self.state
+        K = self.config.leadership_candidates
+        rt = jax.random.randint(key, (K,), 0, st.shape.R)
+        part = st.replica_partition[rt]
+        members = self.part_replicas[part]  # [K, max_rf]
+        m_valid = members < st.shape.R
+        m_idx = jnp.minimum(members, st.shape.R - 1)
+        m_lead = carry.replica_is_leader[m_idx] & m_valid
+        rf = m_idx[jnp.arange(K), jnp.argmax(m_lead, axis=1)]
+
+        src, dst = carry.replica_broker[rf], carry.replica_broker[rt]
+        dst_ok = st.broker_alive[dst] & st.disk_alive[dst, carry.replica_disk[rt]]
+        feasible = (
+            st.replica_valid[rt]
+            & ~carry.replica_is_leader[rt]
+            & m_lead.any(axis=1)
+            & dst_ok
+            & self.lead_ok[dst]
+        )
+
+        # load shift: rf leader->follower on src, rt follower->leader on dst
+        dl_f = st.replica_load_follower[rf] - st.replica_load_leader[rf]  # [K, 4]
+        dl_t = st.replica_load_leader[rt] - st.replica_load_follower[rt]
+        dlbin = st.replica_load_leader[rt, int(Resource.NW_IN)]  # gained by dst
+        # NOTE: src loses rf's leader NW_IN; handled via asymmetric lbin deltas
+        delta = self._move_delta(
+            carry,
+            g,
+            src=src,
+            dst=dst,
+            dload_src=dl_f,
+            dload_dst=dl_t,
+            dcount=jnp.zeros((K,), jnp.int32),
+            dlcount=jnp.ones((K,), jnp.int32),
+            dpot=jnp.zeros((K,), jnp.float32),
+            dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
+            dlbin=dlbin,
+            d_src=carry.replica_disk[rf],
+            d_dst=carry.replica_disk[rt],
+            ddisk_src=dl_f[:, int(Resource.DISK)],
+            ddisk=dl_t[:, int(Resource.DISK)],
+        )
+
+        if self.w.pref_leader != 0.0:
+            src_ok = st.broker_alive[src] & st.disk_alive[src, carry.replica_disk[rf]]
+            pref_f = (st.replica_pos[rf] == 0) & src_ok  # rf becomes violating
+            pref_t = (st.replica_pos[rt] == 0) & dst_ok  # rt stops violating
+            delta += (
+                self.w.pref_leader
+                * (pref_f.astype(jnp.float32) - pref_t.astype(jnp.float32))
+                / max(1, st.shape.P)
+            )
+
+        payload = dict(kind=1, rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
+                       dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
+                       dlbin_dst=dlbin)
+        return delta, feasible, src, dst, part, payload
+
+    def _move_delta(
+        self,
+        carry,
+        g,
+        *,
+        src,
+        dst,
+        dload_src,
+        dload_dst,
+        dcount,
+        dlcount,
+        dpot,
+        dlbin,
+        d_src,
+        d_dst,
+        ddisk,
+        dlbin_src=None,
+        ddisk_src=None,
+    ):
+        """Objective delta for candidates touching brokers (src, dst).
+
+        dload_src is ADDED to src (callers pass negative values to remove
+        load); dload_dst is added to dst.  dcount/dlcount/dpot/dlbin move
+        from src to dst unless an asymmetric *_src override is given.
+        """
+        st = self.state
+        if dlbin_src is None:
+            dlbin_src = dlbin
+        if ddisk_src is None:
+            ddisk_src = ddisk
+
+        def gather(b):
+            return (
+                carry.broker_load[b],
+                carry.broker_replica_count[b],
+                carry.broker_leader_count[b],
+                carry.broker_potential_nw_out[b],
+                carry.broker_leader_bytes_in[b],
+            )
+
+        ls, rs, lcs, ps, lbs = gather(src)
+        ld, rd, lcd, pd, lbd = gather(dst)
+        old = self._broker_terms(src, ls, rs, lcs, ps, lbs, g) + self._broker_terms(
+            dst, ld, rd, lcd, pd, lbd, g
+        )
+        new = self._broker_terms(
+            src, ls + dload_src, rs - dcount, lcs - dlcount, ps - dpot, lbs - dlbin_src, g
+        ) + self._broker_terms(
+            dst, ld + dload_dst, rd + dcount, lcd + dlcount, pd + dpot, lbd + dlbin, g
+        )
+        delta = new - old
+
+        # host-granularity capacity (same-host moves cancel)
+        h_s, h_d = st.broker_host[src], st.broker_host[dst]
+        hl_s, hl_d = carry.host_load[h_s], carry.host_load[h_d]
+        dh = (
+            self._host_terms(h_s, hl_s + dload_src)
+            - self._host_terms(h_s, hl_s)
+            + self._host_terms(h_d, hl_d + dload_dst)
+            - self._host_terms(h_d, hl_d)
+        )
+        delta += jnp.where(h_s != h_d, dh, 0.0)
+
+        # intra-broker disk goals
+        if self.w.intra_cap != 0.0 or self.w.intra_dist != 0.0:
+            row_s, row_d = carry.disk_load[src], carry.disk_load[dst]
+            D = st.shape.max_disks_per_broker
+            oh_s = jax.nn.one_hot(d_src, D, dtype=jnp.float32)
+            oh_d = jax.nn.one_hot(d_dst, D, dtype=jnp.float32)
+            row_s2 = row_s - oh_s * ddisk_src[:, None]
+            row_d2 = row_d + oh_d * ddisk[:, None]
+            bsum_s, bsum_d = row_s.sum(-1), row_d.sum(-1)
+            delta += (
+                self._disk_terms(src, row_s2, bsum_s - ddisk_src, g)
+                - self._disk_terms(src, row_s, bsum_s, g)
+                + self._disk_terms(dst, row_d2, bsum_d + ddisk, g)
+                - self._disk_terms(dst, row_d, bsum_d, g)
+            )
+
+        # dispersion tiebreaker via sufficient statistics
+        cap_s = st.broker_capacity[src] + 1e-12
+        cap_d = st.broker_capacity[dst] + 1e-12
+        p_s, p_d = ls / cap_s, ld / cap_d
+        p_s2, p_d2 = (ls + dload_src) / cap_s, (ld + dload_dst) / cap_d
+        a_s = self.alive[src][:, None].astype(jnp.float32)
+        a_d = self.alive[dst][:, None].astype(jnp.float32)
+        dsum = a_s * (p_s2 - p_s) + a_d * (p_d2 - p_d)
+        dsumsq = a_s * (p_s2**2 - p_s**2) + a_d * (p_d2**2 - p_d**2)
+        delta += self._tie_term(g["pct_sum"] + dsum, g["pct_sumsq"] + dsumsq) - self._tie_term(
+            g["pct_sum"], g["pct_sumsq"]
+        )
+        return delta
+
+    # ------------------------------------------------------------------
+    # step: propose -> evaluate -> select -> apply
+    # ------------------------------------------------------------------
+
+    def _step(self, carry: EngineCarry, temperature):
+        st = self.state
+        cfg = self.config
+        key, k_r, k_l, k_u = jax.random.split(carry.key, 4)
+        g = self._globals(carry)
+
+        dr, fr, sr, tr, pr, payr = self._replica_candidates(carry, k_r, g)
+        dl, fl, sl, tl, pl, payl = self._leadership_candidates(carry, k_l, g)
+
+        delta = jnp.concatenate([dr, dl])
+        feas = jnp.concatenate([fr, fl])
+        src = jnp.concatenate([sr, sl])
+        dst = jnp.concatenate([tr, tl])
+        part = jnp.concatenate([pr, pl])
+        K = delta.shape[0]
+        B, P = st.shape.B, st.shape.P
+
+        # Metropolis acceptance: delta < -T log u  (greedy at T=0)
+        u = jax.random.uniform(k_u, (K,), minval=1e-12, maxval=1.0)
+        thresh = -temperature * jnp.log(u)
+        accept = feas & (delta < thresh - 1e-12)
+
+        # conflict resolution: unique ranks; a candidate survives iff it is
+        # the best-ranked touching each of its brokers and its partition
+        big = jnp.where(accept, delta, jnp.inf)
+        rank = jnp.argsort(jnp.argsort(big)).astype(jnp.int32)
+        seg = jnp.concatenate([src, dst, B + part])
+        ranks3 = jnp.concatenate([rank, rank, rank])
+        min_rank = jax.ops.segment_min(ranks3, seg, num_segments=B + P)
+        survive = (
+            accept
+            & (min_rank[src] == rank)
+            & (min_rank[dst] == rank)
+            & (min_rank[B + part] == rank)
+        )
+        sv_r = survive[: dr.shape[0]]
+        sv_l = survive[dr.shape[0]:]
+
+        carry = self._apply(carry, sv_r, payr, sv_l, payl)
+        carry = dataclasses.replace(carry, key=key)
+        stats = dict(
+            accepted=survive.sum(),
+            improving=(feas & (delta < 0)).sum(),
+            delta=jnp.where(survive, delta, 0.0).sum(),
+        )
+        return carry, stats
+
+    def _apply(self, carry: EngineCarry, sv_r, payr, sv_l, payl) -> EngineCarry:
+        st = self.state
+        B, R, D = st.shape.B, st.shape.R, st.shape.max_disks_per_broker
+        drop = dict(mode="drop")
+
+        # ---- replica moves ----
+        r = jnp.where(sv_r, payr["r"], R)
+        dst = payr["dst"]
+        load = payr["load"] * sv_r[:, None]
+        src = carry.replica_broker[jnp.minimum(payr["r"], R - 1)]
+        src_idx = jnp.where(sv_r, src, B)
+        dst_idx = jnp.where(sv_r, dst, B)
+
+        replica_broker = carry.replica_broker.at[r].set(dst, **drop)
+        replica_disk = carry.replica_disk.at[r].set(payr["d_dst"], **drop)
+
+        bl = carry.broker_load.at[src_idx].add(-load, **drop).at[dst_idx].add(load, **drop)
+        ones = sv_r.astype(jnp.int32)
+        rc = carry.broker_replica_count.at[src_idx].add(-ones, **drop).at[dst_idx].add(
+            ones, **drop
+        )
+        dlc = (sv_r & payr["is_lead"]).astype(jnp.int32)
+        lc = carry.broker_leader_count.at[src_idx].add(-dlc, **drop).at[dst_idx].add(dlc, **drop)
+        dpot = payr["pot"] * sv_r
+        pot = carry.broker_potential_nw_out.at[src_idx].add(-dpot, **drop).at[dst_idx].add(
+            dpot, **drop
+        )
+        dlb = payr["lbin"] * sv_r
+        lb = carry.broker_leader_bytes_in.at[src_idx].add(-dlb, **drop).at[dst_idx].add(
+            dlb, **drop
+        )
+        t = st.replica_topic[jnp.minimum(payr["r"], R - 1)]
+        T = st.shape.num_topics
+        tc = (
+            carry.broker_topic_count.at[jnp.where(sv_r, t, T), src_idx].add(-ones, **drop)
+            .at[jnp.where(sv_r, t, T), dst_idx].add(ones, **drop)
+        )
+        p = st.replica_partition[jnp.minimum(payr["r"], R - 1)]
+        rack_s = st.broker_rack[src]
+        rack_d = st.broker_rack[dst]
+        prc = (
+            carry.part_rack_count.at[jnp.where(sv_r, p, st.shape.P), rack_s].add(-ones, **drop)
+            .at[jnp.where(sv_r, p, st.shape.P), rack_d].add(ones, **drop)
+        )
+        ddisk = load[:, int(Resource.DISK)]
+        dl_ = (
+            carry.disk_load.at[src_idx, payr["d_src"]].add(-ddisk, **drop)
+            .at[dst_idx, payr["d_dst"]].add(ddisk, **drop)
+        )
+        h_s = st.broker_host[src]
+        h_d = st.broker_host[dst]
+        H = st.shape.num_hosts
+        hl = (
+            carry.host_load.at[jnp.where(sv_r, h_s, H)].add(-load, **drop)
+            .at[jnp.where(sv_r, h_d, H)].add(load, **drop)
+        )
+
+        # ---- leadership transfers ----
+        rf = jnp.where(sv_l, payl["rf"], R)
+        rt = jnp.where(sv_l, payl["rt"], R)
+        is_leader = carry.replica_is_leader.at[rf].set(False, **drop).at[rt].set(True, **drop)
+
+        src_l = carry.replica_broker[jnp.minimum(payl["rf"], R - 1)]
+        dst_l = carry.replica_broker[jnp.minimum(payl["rt"], R - 1)]
+        sl_idx = jnp.where(sv_l, src_l, B)
+        tl_idx = jnp.where(sv_l, dst_l, B)
+        dl_f = payl["dl_f"] * sv_l[:, None]
+        dl_t = payl["dl_t"] * sv_l[:, None]
+        bl = bl.at[sl_idx].add(dl_f, **drop).at[tl_idx].add(dl_t, **drop)
+        ones_l = sv_l.astype(jnp.int32)
+        lc = lc.at[sl_idx].add(-ones_l, **drop).at[tl_idx].add(ones_l, **drop)
+        lb = (
+            lb.at[sl_idx].add(-payl["dlbin_src"] * sv_l, **drop)
+            .at[tl_idx].add(payl["dlbin_dst"] * sv_l, **drop)
+        )
+        d_f = carry.replica_disk[jnp.minimum(payl["rf"], R - 1)]
+        d_t = carry.replica_disk[jnp.minimum(payl["rt"], R - 1)]
+        dl_ = (
+            dl_.at[sl_idx, d_f].add(dl_f[:, int(Resource.DISK)], **drop)
+            .at[tl_idx, d_t].add(dl_t[:, int(Resource.DISK)], **drop)
+        )
+        h_f = st.broker_host[src_l]
+        h_t = st.broker_host[dst_l]
+        hl = (
+            hl.at[jnp.where(sv_l, h_f, H)].add(dl_f, **drop)
+            .at[jnp.where(sv_l, h_t, H)].add(dl_t, **drop)
+        )
+
+        return dataclasses.replace(
+            carry,
+            replica_broker=replica_broker,
+            replica_is_leader=is_leader,
+            replica_disk=replica_disk,
+            broker_load=bl,
+            broker_replica_count=rc,
+            broker_leader_count=lc,
+            broker_potential_nw_out=pot,
+            broker_leader_bytes_in=lb,
+            broker_topic_count=tc,
+            part_rack_count=prc,
+            disk_load=dl_,
+            host_load=hl,
+        )
+
+    def _make_scan(self):
+        def run_round(carry: EngineCarry, temps: jax.Array):
+            def body(c, t):
+                return self._step(c, t)
+
+            carry, stats = jax.lax.scan(body, carry, temps)
+            return carry, stats
+
+        return run_round
+
+    # ------------------------------------------------------------------
+    # driver
+    # ------------------------------------------------------------------
+
+    def run(self, *, verbose: bool = False):
+        """Execute the annealing schedule; returns (final_state, history)."""
+        cfg = self.config
+        key = jax.random.PRNGKey(cfg.seed)
+        carry = self.init_carry(key)
+
+        obj0, _, _ = self.chain.evaluate(self.state)
+        t0 = float(obj0) * cfg.init_temperature_scale
+        history = []
+        for rnd in range(cfg.num_rounds):
+            if rnd == cfg.num_rounds - 1:
+                t_round = 0.0
+            else:
+                t_round = t0 * (cfg.temperature_decay**rnd)
+            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
+            carry, stats = self._scan(carry, temps)
+            # re-derive aggregates from placement to wash out float drift
+            carry = self._refresh_aggregates(carry)
+            accepted = int(jax.device_get(stats["accepted"]).sum())
+            history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
+            if verbose:
+                obj, _, _ = self.chain.evaluate(self.carry_to_state(carry))
+                history[-1]["objective"] = float(obj)
+        return self.carry_to_state(carry), history
+
+    def _refresh_aggregates(self, carry: EngineCarry) -> EngineCarry:
+        state = self.carry_to_state(carry)
+        fresh_engine_state = compute_aggregates(state)
+        hseg = jnp.where(state.broker_valid, state.broker_host, state.shape.num_hosts)
+        host_load = jax.ops.segment_sum(
+            fresh_engine_state.broker_load, hseg, num_segments=state.shape.num_hosts + 1
+        )[: state.shape.num_hosts]
+        return dataclasses.replace(
+            carry,
+            broker_load=fresh_engine_state.broker_load,
+            broker_replica_count=fresh_engine_state.broker_replica_count,
+            broker_leader_count=fresh_engine_state.broker_leader_count,
+            broker_potential_nw_out=fresh_engine_state.broker_potential_nw_out,
+            broker_leader_bytes_in=fresh_engine_state.broker_leader_bytes_in,
+            broker_topic_count=fresh_engine_state.broker_topic_count,
+            part_rack_count=fresh_engine_state.part_rack_count,
+            disk_load=fresh_engine_state.disk_load,
+            host_load=host_load,
+        )

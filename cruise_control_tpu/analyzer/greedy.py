@@ -1,0 +1,109 @@
+"""Greedy CPU oracle — a faithful re-expression of the reference search.
+
+Mirrors reference analyzer/goals/AbstractGoal.optimize:66-107: goals are
+optimized strictly in priority order; for each goal, brokers are visited
+and single replica/leadership moves are applied when they (a) help the
+current goal and (b) do not regress any previously-optimized goal
+(reference AnalyzerUtils.isProposalAcceptableForOptimizedGoals:119).
+
+This exists for TESTS AND BENCHMARKS ONLY: it is the quality baseline the
+batched TPU engine must match or beat (SURVEY §7 "equal-or-better on the
+aggregate score"), the role OptimizationVerifier's greedy runs play in the
+reference test suite.  numpy, single-threaded, deliberately simple.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.objective import GoalChain
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.aggregates import compute_aggregates
+from cruise_control_tpu.models.state import ClusterState
+
+
+def _violations(state: ClusterState, chain: GoalChain, constraint) -> np.ndarray:
+    agg = compute_aggregates(state)
+    return np.asarray(
+        [float(g.violation(state, agg, constraint)) for g in chain.goals], np.float64
+    )
+
+
+def greedy_optimize(
+    state: ClusterState,
+    chain: GoalChain,
+    constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+    *,
+    max_moves_per_goal: int = 200,
+    candidate_dests: int = 10,
+    seed: int = 0,
+) -> ClusterState:
+    """Sequential greedy search over single moves, reference-style.
+
+    For tractability the oracle samples `candidate_dests` destinations per
+    source replica instead of scanning all brokers (the reference prunes
+    similarly via sorted candidate lists, model/SortedReplicas.java:47).
+    """
+    rng = np.random.default_rng(seed)
+    cur = state
+    viol = _violations(cur, chain, constraint)
+
+    for gi in range(len(chain.goals)):
+        for _ in range(max_moves_per_goal):
+            if viol[gi] <= 1e-12:
+                break
+            improved = False
+            move = _find_improving_move(
+                cur, chain, constraint, viol, gi, rng, candidate_dests
+            )
+            if move is not None:
+                cur, viol = move
+                improved = True
+            if not improved:
+                break
+    return cur
+
+
+def _find_improving_move(cur, chain, constraint, viol, gi, rng, candidate_dests):
+    """One accepted move: improves goal gi without regressing goals < gi."""
+    valid = np.asarray(cur.replica_valid)
+    brokers = np.asarray(cur.replica_broker)
+    alive = np.asarray(cur.broker_alive) & np.asarray(cur.broker_valid)
+    alive_ids = np.nonzero(alive)[0]
+    part = np.asarray(cur.replica_partition)
+
+    # candidate source replicas: prefer replicas on dead or overloaded brokers
+    ridx = np.nonzero(valid)[0]
+    rng.shuffle(ridx)
+    for r in ridx[:64]:
+        src = brokers[r]
+        dests = rng.choice(alive_ids, size=min(candidate_dests, alive_ids.size), replace=False)
+        for dst in dests:
+            if dst == src:
+                continue
+            # no duplicate replica of the partition on dst
+            if ((part == part[r]) & (brokers == dst) & valid).any():
+                continue
+            nxt = _apply_move(cur, int(r), int(dst))
+            nviol = _violations(nxt, chain, constraint)
+            if nviol[gi] < viol[gi] - 1e-12 and not (
+                nviol[:gi] > viol[:gi] + 1e-9
+            ).any():
+                return nxt, nviol
+    return None
+
+
+def _apply_move(cur: ClusterState, r: int, dst: int) -> ClusterState:
+    import jax.numpy as jnp
+
+    rb = np.asarray(cur.replica_broker).copy()
+    rb[r] = dst
+    offline = np.asarray(cur.replica_offline).copy()
+    offline[r] = not bool(np.asarray(cur.broker_alive)[dst])
+    return dataclasses.replace(
+        cur,
+        replica_broker=jnp.asarray(rb),
+        replica_offline=jnp.asarray(offline),
+    )

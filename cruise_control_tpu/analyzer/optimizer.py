@@ -1,0 +1,132 @@
+"""GoalOptimizer facade — compute optimization proposals for a cluster model.
+
+Reference: analyzer/GoalOptimizer.java:416-487 (per-goal sequential
+optimize + stats + diff) and analyzer/OptimizerResult.java:31.  The TPU
+rebuild runs the whole weighted goal chain at once through the batched
+annealing engine and reports per-goal violations before/after, cluster
+stats, the balancedness score, and the proposal diff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.engine import Engine, OptimizerConfig
+from cruise_control_tpu.analyzer.objective import (
+    DEFAULT_CHAIN,
+    GoalChain,
+    balancedness_score,
+)
+from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal, extract_proposals
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.state import ClusterState, validate
+from cruise_control_tpu.models.stats import ClusterStats, compute_stats
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerResult:
+    """What an optimization run produced (reference analyzer/OptimizerResult.java:31)."""
+
+    proposals: list[ExecutionProposal]
+    state_before: ClusterState
+    state_after: ClusterState
+    stats_before: ClusterStats
+    stats_after: ClusterStats
+    goal_names: list[str]
+    violations_before: np.ndarray  # f32[G]
+    violations_after: np.ndarray  # f32[G]
+    balancedness_before: float
+    balancedness_after: float
+    objective_before: float
+    objective_after: float
+    wall_seconds: float
+    history: list[dict]
+
+    @property
+    def num_inter_broker_moves(self) -> int:
+        return sum(1 for p in self.proposals if p.has_replica_action)
+
+    @property
+    def num_leadership_moves(self) -> int:
+        return sum(
+            1 for p in self.proposals if p.has_leader_action and not p.has_replica_action
+        )
+
+    @property
+    def data_to_move(self) -> float:
+        return sum(p.inter_broker_data_to_move for p in self.proposals)
+
+    def violated_goals_after(self, tol: float = 1e-9) -> list[str]:
+        return [n for n, v in zip(self.goal_names, self.violations_after) if v > tol]
+
+    def summary(self) -> dict:
+        return {
+            "numReplicaMovements": self.num_inter_broker_moves,
+            "numLeaderMovements": self.num_leadership_moves,
+            "dataToMoveMB": self.data_to_move,
+            "balancednessBefore": self.balancedness_before,
+            "balancednessAfter": self.balancedness_after,
+            "objectiveBefore": self.objective_before,
+            "objectiveAfter": self.objective_after,
+            "violatedGoalsAfter": self.violated_goals_after(),
+            "wallSeconds": self.wall_seconds,
+        }
+
+
+class GoalOptimizer:
+    """Entry point the service layer calls (reference GoalOptimizer.optimizations:416)."""
+
+    def __init__(
+        self,
+        chain: GoalChain = DEFAULT_CHAIN,
+        constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+        config: OptimizerConfig = OptimizerConfig(),
+    ):
+        self.chain = chain
+        self.constraint = constraint
+        self.config = config
+
+    def optimize(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        *,
+        verbose: bool = False,
+        config: OptimizerConfig | None = None,
+    ) -> OptimizerResult:
+        t0 = time.monotonic()
+        validate(state)
+        engine = Engine(
+            state,
+            self.chain,
+            constraint=self.constraint,
+            options=options,
+            config=config or self.config,
+        )
+        obj_b, viol_b, _ = self.chain.evaluate(state, constraint=self.constraint)
+        final, history = engine.run(verbose=verbose)
+        obj_a, viol_a, _ = self.chain.evaluate(final, constraint=self.constraint)
+        validate(final)
+        viol_b = np.asarray(viol_b)
+        viol_a = np.asarray(viol_a)
+        wall = time.monotonic() - t0
+        return OptimizerResult(
+            proposals=extract_proposals(state, final),
+            state_before=state,
+            state_after=final,
+            stats_before=compute_stats(state),
+            stats_after=compute_stats(final),
+            goal_names=self.chain.names(),
+            violations_before=viol_b,
+            violations_after=viol_a,
+            balancedness_before=balancedness_score(viol_b, self.chain),
+            balancedness_after=balancedness_score(viol_a, self.chain),
+            objective_before=float(obj_b),
+            objective_after=float(obj_a),
+            wall_seconds=wall,
+            history=history,
+        )

@@ -1,0 +1,57 @@
+"""Optimization options — exclusion masks and destination restriction.
+
+Reference: analyzer/OptimizationOptions.java (excluded topics, brokers
+excluded for leadership / replica moves, requested destination brokers).
+Here every exclusion is a dense mask over the topic/broker axis so the
+engine can apply them as vectorized feasibility predicates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.models.state import ClusterState
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationOptions:
+    #: replicas of these topics stay put unless offline (reference
+    #: OptimizationOptions.excludedTopics)
+    excluded_topics: np.ndarray | None = None  # bool[T]
+    #: brokers that may not *receive* leadership (reference
+    #: excludedBrokersForLeadership)
+    excluded_brokers_for_leadership: np.ndarray | None = None  # bool[B]
+    #: brokers that may not *receive* replicas (reference
+    #: excludedBrokersForReplicaMove)
+    excluded_brokers_for_replica_move: np.ndarray | None = None  # bool[B]
+    #: if set, replica moves may only land on these brokers (reference
+    #: requestedDestinationBrokerIds; used by add_broker/rebalance-to)
+    requested_destination_brokers: np.ndarray | None = None  # bool[B]
+
+    def dest_allowed(self, state: ClusterState) -> np.ndarray:
+        B = state.shape.B
+        allowed = np.ones(B, bool)
+        if self.excluded_brokers_for_replica_move is not None:
+            allowed &= ~np.asarray(self.excluded_brokers_for_replica_move, bool)
+        if self.requested_destination_brokers is not None:
+            allowed &= np.asarray(self.requested_destination_brokers, bool)
+        return allowed
+
+    def leadership_allowed(self, state: ClusterState) -> np.ndarray:
+        B = state.shape.B
+        allowed = np.ones(B, bool)
+        if self.excluded_brokers_for_leadership is not None:
+            allowed &= ~np.asarray(self.excluded_brokers_for_leadership, bool)
+        return allowed
+
+    def topic_movable(self, state: ClusterState) -> np.ndarray:
+        T = state.shape.num_topics
+        movable = np.ones(T, bool)
+        if self.excluded_topics is not None:
+            movable &= ~np.asarray(self.excluded_topics, bool)
+        return movable
+
+
+DEFAULT_OPTIONS = OptimizationOptions()

@@ -1,0 +1,112 @@
+"""Execution proposals — the optimizer's output contract.
+
+Reference: executor/ExecutionProposal.java:25 (old/new replica lists +
+data-to-move) and analyzer/AnalyzerUtils.getDiff:50-117 (distribution diff
+between pre- and post-optimization cluster models).  Here the diff is an
+array comparison between two ClusterStates sharing the same replica axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.state import ClusterState
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment (reference executor/ExecutionProposal.java:25).
+
+    Replica lists are broker ids, leader first (the reference keeps the new
+    leader at the head of the new replica list).
+    """
+
+    partition: int
+    topic: int
+    old_leader: int
+    new_leader: int
+    old_replicas: tuple[int, ...]
+    new_replicas: tuple[int, ...]
+    #: per-replica (broker, old_disk, new_disk) intra-broker moves (JBOD)
+    disk_moves: tuple[tuple[int, int, int], ...] = ()
+    #: bytes of replica data crossing broker boundaries
+    inter_broker_data_to_move: float = 0.0
+
+    @property
+    def has_replica_action(self) -> bool:
+        return set(self.old_replicas) != set(self.new_replicas)
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader != self.new_leader
+
+    def to_json(self) -> dict:
+        return {
+            "topicPartition": {"topic": int(self.topic), "partition": int(self.partition)},
+            "oldLeader": int(self.old_leader),
+            "oldReplicas": [int(b) for b in self.old_replicas],
+            "newReplicas": [int(b) for b in self.new_replicas],
+        }
+
+
+def extract_proposals(before: ClusterState, after: ClusterState) -> list[ExecutionProposal]:
+    """Diff two placements into per-partition proposals
+    (reference analyzer/AnalyzerUtils.getDiff:50-117)."""
+    valid = np.asarray(before.replica_valid)
+    part = np.asarray(before.replica_partition)[valid]
+    topic = np.asarray(before.replica_topic)[valid]
+    pos = np.asarray(before.replica_pos)[valid]
+    b_old = np.asarray(before.replica_broker)[valid]
+    b_new = np.asarray(after.replica_broker)[valid]
+    l_old = np.asarray(before.replica_is_leader)[valid]
+    l_new = np.asarray(after.replica_is_leader)[valid]
+    d_old = np.asarray(before.replica_disk)[valid]
+    d_new = np.asarray(after.replica_disk)[valid]
+    disk_bytes = np.asarray(before.replica_load_leader)[valid][:, int(Resource.DISK)]
+
+    changed = (b_old != b_new) | (l_old != l_new) | (d_old != d_new)
+    touched = np.unique(part[changed])
+    if touched.size == 0:
+        return []
+
+    # group replica rows by partition
+    order = np.argsort(part, kind="stable")
+    proposals: list[ExecutionProposal] = []
+    bounds = np.searchsorted(part[order], [touched, touched + 1])
+    for k, p in enumerate(touched):
+        rows = order[bounds[0][k]: bounds[1][k]]
+        rows = rows[np.argsort(pos[rows], kind="stable")]  # preferred order
+        ol = rows[l_old[rows]]
+        nl = rows[l_new[rows]]
+        old_leader = int(b_old[ol[0]]) if ol.size else -1
+        new_leader = int(b_new[nl[0]]) if nl.size else -1
+
+        def ordered(brokers, leader):
+            lst = [int(x) for x in brokers]
+            if leader in lst:
+                lst.remove(leader)
+                lst.insert(0, leader)
+            return tuple(lst)
+
+        moved = rows[b_old[rows] != b_new[rows]]
+        disk_moves = tuple(
+            (int(b_new[r]), int(d_old[r]), int(d_new[r]))
+            for r in rows
+            if b_old[r] == b_new[r] and d_old[r] != d_new[r]
+        )
+        proposals.append(
+            ExecutionProposal(
+                partition=int(p),
+                topic=int(topic[rows[0]]),
+                old_leader=old_leader,
+                new_leader=new_leader,
+                old_replicas=ordered(b_old[rows], old_leader),
+                new_replicas=ordered(b_new[rows], new_leader),
+                disk_moves=disk_moves,
+                inter_broker_data_to_move=float(disk_bytes[moved].sum()),
+            )
+        )
+    return proposals

@@ -1,0 +1,38 @@
+"""Dev smoke: run the optimizer on small fixtures and print what happened."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+from cruise_control_tpu.testing.fixtures import (
+    RandomClusterSpec,
+    dead_broker_cluster,
+    rack_violated_cluster,
+    random_cluster,
+    small_cluster,
+)
+
+
+def run(name, state, cfg):
+    opt = GoalOptimizer(config=cfg)
+    res = opt.optimize(state, verbose=True)
+    print(f"== {name} ==")
+    print("  summary:", {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.summary().items()})
+    print("  violations before:", dict(zip(res.goal_names, np.round(res.violations_before, 5))))
+    print("  violations after: ", dict(zip(res.goal_names, np.round(res.violations_after, 5))))
+    print("  history:", res.history)
+    return res
+
+
+if __name__ == "__main__":
+    cfg = OptimizerConfig(num_candidates=256, leadership_candidates=64,
+                          steps_per_round=32, num_rounds=4, seed=0)
+    run("small", small_cluster(), cfg)
+    run("rack", rack_violated_cluster(), cfg)
+    run("dead", dead_broker_cluster(), cfg)
+    cfg2 = OptimizerConfig(num_candidates=1024, leadership_candidates=256,
+                           steps_per_round=64, num_rounds=6, seed=0)
+    run("random50", random_cluster(RandomClusterSpec(num_brokers=20, num_partitions=500, skew=1.0)), cfg2)

@@ -1,8 +1,12 @@
-"""Test config: force an 8-device virtual CPU platform before jax imports.
+"""Test config: force an 8-device virtual CPU platform before any compute.
 
 Multi-chip sharding paths are exercised on a virtual device mesh (real TPU
 hardware in CI is single-chip; the driver separately dry-runs
 __graft_entry__.dryrun_multichip).
+
+Note: the environment's sitecustomize registers/pins the 'axon' TPU
+platform at interpreter start, so setting JAX_PLATFORMS here is not enough
+— the jax config value itself must be overridden before first backend use.
 """
 
 import os
@@ -11,3 +15,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

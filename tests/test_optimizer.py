@@ -1,0 +1,182 @@
+"""Optimizer engine tests.
+
+Modeled on the reference's analyzer test strategy (SURVEY §4): deterministic
+fixtures + randomized clusters, verified through invariants rather than
+golden proposals (reference analyzer/OptimizationVerifier.java checks:
+GOAL_VIOLATION, BROKEN_BROKERS, NEW_BROKERS, REGRESSION).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (
+    DEFAULT_CHAIN,
+    Engine,
+    GoalOptimizer,
+    OptimizationOptions,
+    OptimizerConfig,
+)
+from cruise_control_tpu.models.aggregates import compute_aggregates
+from cruise_control_tpu.models.state import validate
+from cruise_control_tpu.testing.fixtures import (
+    RandomClusterSpec,
+    dead_broker_cluster,
+    rack_violated_cluster,
+    random_cluster,
+    small_cluster,
+)
+
+FAST = OptimizerConfig(
+    num_candidates=256, leadership_candidates=64, steps_per_round=24, num_rounds=3, seed=1
+)
+
+
+@pytest.fixture(scope="module")
+def small_result():
+    return GoalOptimizer(config=FAST).optimize(small_cluster())
+
+
+def test_objective_improves(small_result):
+    assert small_result.objective_after < small_result.objective_before
+    assert small_result.balancedness_after >= small_result.balancedness_before
+
+
+def test_final_state_valid(small_result):
+    assert validate(small_result.state_after) == []
+
+
+def test_proposals_match_diff(small_result):
+    res = small_result
+    before, after = res.state_before, res.state_after
+    n_changed_parts = len(
+        np.unique(
+            np.asarray(before.replica_partition)[
+                np.asarray(before.replica_valid)
+                & (
+                    (np.asarray(before.replica_broker) != np.asarray(after.replica_broker))
+                    | (
+                        np.asarray(before.replica_is_leader)
+                        != np.asarray(after.replica_is_leader)
+                    )
+                )
+            ]
+        )
+    )
+    assert len(res.proposals) == n_changed_parts
+    for p in res.proposals:
+        # replica count preserved, leader heads the new replica list
+        assert len(p.old_replicas) == len(p.new_replicas)
+        if p.new_replicas:
+            assert p.new_replicas[0] == p.new_leader
+
+
+def test_rack_violation_fixed():
+    res = GoalOptimizer(config=FAST).optimize(rack_violated_cluster())
+    i = res.goal_names.index("RackAwareGoal")
+    assert res.violations_before[i] > 0
+    assert res.violations_after[i] == 0
+
+
+def test_dead_broker_evacuated():
+    res = GoalOptimizer(config=FAST).optimize(dead_broker_cluster())
+    after = res.state_after
+    on_dead = (
+        np.asarray(after.replica_valid)
+        & ~np.asarray(after.broker_alive)[np.asarray(after.replica_broker)]
+    )
+    assert not on_dead.any(), "BROKEN_BROKERS: replicas remain on dead broker"
+
+
+def test_incremental_aggregates_stay_consistent():
+    """The scatter-updated carry must equal a from-scratch aggregation.
+
+    This pins the delta engine's bookkeeping against compute_aggregates —
+    the TPU analog of reference ClusterModel.sanityCheck (ClusterModel.java:1081).
+    """
+    state = random_cluster(RandomClusterSpec(num_brokers=12, num_partitions=200, skew=1.0), seed=3)
+    eng = Engine(state, DEFAULT_CHAIN, config=FAST)
+    carry = eng.init_carry(jax.random.PRNGKey(0))
+    temps = jnp.full((24,), 0.0, jnp.float32)
+    carry, stats = eng._scan(carry, temps)
+    assert int(stats["accepted"].sum()) > 0
+
+    fresh = compute_aggregates(eng.carry_to_state(carry))
+    np.testing.assert_allclose(
+        np.asarray(carry.broker_load), np.asarray(fresh.broker_load), rtol=1e-4, atol=1e-2
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry.broker_replica_count), np.asarray(fresh.broker_replica_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry.broker_leader_count), np.asarray(fresh.broker_leader_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry.part_rack_count), np.asarray(fresh.part_rack_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry.broker_topic_count), np.asarray(fresh.broker_topic_count)
+    )
+    np.testing.assert_allclose(
+        np.asarray(carry.broker_potential_nw_out),
+        np.asarray(fresh.broker_potential_nw_out),
+        rtol=1e-4,
+        atol=1e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(carry.broker_leader_bytes_in),
+        np.asarray(fresh.broker_leader_bytes_in),
+        rtol=1e-4,
+        atol=1e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(carry.disk_load), np.asarray(fresh.disk_load), rtol=1e-4, atol=1e-2
+    )
+
+
+def test_greedy_never_worsens_objective():
+    """At T=0 every accepted move must strictly improve the SA objective
+    (REGRESSION check, reference AbstractGoal.java:92-101)."""
+    state = random_cluster(RandomClusterSpec(num_brokers=10, num_partitions=150, skew=1.5), seed=5)
+    chain = DEFAULT_CHAIN
+    eng = Engine(state, chain, config=FAST)
+    carry = eng.init_carry(jax.random.PRNGKey(2))
+    obj_prev, _, _ = chain.evaluate(state)
+    obj_prev = float(obj_prev)
+    for _ in range(4):
+        temps = jnp.full((8,), 0.0, jnp.float32)
+        carry, _ = eng._scan(carry, temps)
+        obj, _, _ = chain.evaluate(eng.carry_to_state(carry))
+        assert float(obj) <= obj_prev + max(1e-5, abs(obj_prev) * 1e-3)
+        obj_prev = float(obj)
+
+
+def test_excluded_topics_do_not_move():
+    state = random_cluster(RandomClusterSpec(num_brokers=8, num_partitions=100, skew=1.5), seed=7)
+    T = state.shape.num_topics
+    excluded = np.zeros(T, bool)
+    excluded[:T // 2] = True
+    opts = OptimizationOptions(excluded_topics=excluded)
+    res = GoalOptimizer(config=FAST).optimize(state, options=opts)
+    before, after = res.state_before, res.state_after
+    moved = np.asarray(before.replica_broker) != np.asarray(after.replica_broker)
+    moved &= np.asarray(before.replica_valid)
+    bad = moved & excluded[np.asarray(before.replica_topic)]
+    assert not bad.any(), "replica of an excluded topic was moved"
+
+
+def test_excluded_brokers_receive_nothing():
+    state = random_cluster(RandomClusterSpec(num_brokers=8, num_partitions=100, skew=1.5), seed=9)
+    B = state.shape.B
+    excluded = np.zeros(B, bool)
+    excluded[0] = True
+    opts = OptimizationOptions(excluded_brokers_for_replica_move=excluded)
+    res = GoalOptimizer(config=FAST).optimize(state, options=opts)
+    before, after = res.state_before, res.state_after
+    moved = (
+        np.asarray(before.replica_broker) != np.asarray(after.replica_broker)
+    ) & np.asarray(before.replica_valid)
+    assert not (np.asarray(after.replica_broker)[moved] == 0).any()
