@@ -74,18 +74,25 @@ def portfolio_run(
 
     try:
         from jax import shard_map
-    except ImportError:  # older jax
+
+        smap = shard_map(
+            chain_fn,
+            mesh=mesh,
+            in_specs=(P(RESTART_AXIS), P()),
+            out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
+            check_vma=False,
+        )
+    except (ImportError, TypeError):  # older jax
         from jax.experimental.shard_map import shard_map
 
-    sharded = jax.jit(
-        shard_map(
+        smap = shard_map(
             chain_fn,
             mesh=mesh,
             in_specs=(P(RESTART_AXIS), P()),
             out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
             check_rep=False,
         )
-    )
+    sharded = jax.jit(smap)
     carry0 = engine.init_carry(jax.random.PRNGKey(seed))
     winners, objs = sharded(keys, carry0)
     # out axis stacks each device's all_gather copy: [n_dev, n_chains]
