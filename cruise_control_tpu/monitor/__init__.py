@@ -1,0 +1,52 @@
+"""Monitor layer: sampling, windowed aggregation, cluster-model generation.
+
+Reference: cruise-control/.../monitor/ (LoadMonitor.java, sampling/,
+metricdefinition/) + cruise-control-core aggregator.
+"""
+
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    AggregationResult,
+    Extrapolation,
+    MetricSampleCompleteness,
+    WindowedMetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityInfo,
+    FileCapacityResolver,
+    FixedCapacityResolver,
+)
+from cruise_control_tpu.monitor.completeness import (
+    DEFAULT_REQUIREMENTS,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    ModelGeneration,
+    MonitorState,
+    NotEnoughValidWindowsError,
+)
+from cruise_control_tpu.monitor.metricdef import (
+    KAFKA_METRIC_DEF,
+    MetricDef,
+    MetricScope,
+    ValueComputingStrategy,
+)
+from cruise_control_tpu.monitor.sampling import (
+    BrokerEntity,
+    FileSampleStore,
+    InMemorySampleStore,
+    MetricFetcherManager,
+    MetricSample,
+    MetricSampler,
+    NoopSampleStore,
+    PartitionEntity,
+    SamplingResult,
+)
+from cruise_control_tpu.monitor.topology import (
+    BrokerNode,
+    ClusterTopology,
+    MetadataProvider,
+    PartitionInfo,
+    StaticMetadataProvider,
+)

@@ -1,0 +1,101 @@
+"""CPU utilization estimation.
+
+Reference: model/ModelUtils.java:53-84 (follower CPU derived from leader
+byte rates via static coefficients; leader CPU per core estimation) and
+model/LinearRegressionModelParameters.java (optional trained linear
+regression from broker samples).
+
+The regression here is a tiny closed-form least-squares on host (numpy) —
+training data volumes are trivial; no reason to involve the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# reference ModelUtils static coefficients (ModelUtils.java:30-36):
+# CPU contribution weights of leader bytes-in / bytes-out / follower bytes-in
+LEADER_BYTES_IN_CPU_WEIGHT = 0.7
+LEADER_BYTES_OUT_CPU_WEIGHT = 0.15
+FOLLOWER_BYTES_IN_CPU_WEIGHT = 0.15
+
+
+def follower_cpu_util(leader_bytes_in: float, leader_bytes_out: float, leader_cpu: float) -> float:
+    """CPU a follower of this partition would use, from leader-side rates
+    (reference ModelUtils.getFollowerCpuUtilFromLeaderLoad:53-67)."""
+    total = (
+        LEADER_BYTES_IN_CPU_WEIGHT * leader_bytes_in
+        + LEADER_BYTES_OUT_CPU_WEIGHT * leader_bytes_out
+    )
+    if total <= 0:
+        return 0.0
+    return leader_cpu * (FOLLOWER_BYTES_IN_CPU_WEIGHT * leader_bytes_in) / total
+
+
+def follower_cpu_util_array(leader_loads: np.ndarray, leader_cpu: np.ndarray) -> np.ndarray:
+    """Vectorized follower CPU for [N, 4] leader loads."""
+    from cruise_control_tpu.common.resources import Resource
+
+    bin_ = leader_loads[:, Resource.NW_IN]
+    bout = leader_loads[:, Resource.NW_OUT]
+    total = LEADER_BYTES_IN_CPU_WEIGHT * bin_ + LEADER_BYTES_OUT_CPU_WEIGHT * bout
+    out = np.where(
+        total > 0, leader_cpu * FOLLOWER_BYTES_IN_CPU_WEIGHT * bin_ / np.maximum(total, 1e-12), 0.0
+    )
+    return out.astype(np.float32)
+
+
+@dataclasses.dataclass
+class LinearRegressionModelParameters:
+    """Broker CPU =~ w . [leader_bytes_in, leader_bytes_out, follower_bytes_in]
+    (reference model/LinearRegressionModelParameters.java).
+
+    Accumulates training samples; `train` solves least squares; once
+    trained, `estimate` replaces the static-coefficient path.
+    """
+
+    min_samples_to_train: int = 100
+
+    def __post_init__(self):
+        self._x: list[np.ndarray] = []
+        self._y: list[float] = []
+        self.coefficients: np.ndarray | None = None
+
+    def add_sample(self, leader_bytes_in: float, leader_bytes_out: float,
+                   follower_bytes_in: float, cpu_util: float):
+        self._x.append(np.array([leader_bytes_in, leader_bytes_out, follower_bytes_in]))
+        self._y.append(cpu_util)
+
+    @property
+    def num_samples(self) -> int:
+        return len(self._y)
+
+    @property
+    def trained(self) -> bool:
+        return self.coefficients is not None
+
+    def train(self) -> bool:
+        if len(self._y) < self.min_samples_to_train:
+            return False
+        x = np.stack(self._x)
+        y = np.asarray(self._y)
+        coef, *_ = np.linalg.lstsq(x, y, rcond=None)
+        self.coefficients = np.maximum(coef, 0.0)
+        return True
+
+    def estimate(self, leader_bytes_in: float, leader_bytes_out: float,
+                 follower_bytes_in: float) -> float:
+        if self.coefficients is None:
+            raise ValueError("model not trained")
+        return float(
+            self.coefficients @ np.array([leader_bytes_in, leader_bytes_out, follower_bytes_in])
+        )
+
+    def state(self) -> dict:
+        return {
+            "trained": self.trained,
+            "numSamples": self.num_samples,
+            "coefficients": None if self.coefficients is None else self.coefficients.tolist(),
+        }

@@ -1,0 +1,302 @@
+"""LoadMonitor — sampling/aggregation orchestration + ClusterState generation.
+
+Reference: monitor/LoadMonitor.java:81 — clusterModel():485-568 (metadata
+refresh -> partition aggregation -> rack/broker creation with capacity
+resolver -> per-partition load population -> bad-broker marking),
+acquireForModelGeneration():390 (semaphore), meetCompletenessRequirements():616,
+and monitor/task/LoadMonitorTaskRunner.java:33 (state machine
+NOT_STARTED/RUNNING/SAMPLING/PAUSED/BOOTSTRAPPING/TRAINING/LOADING).
+
+The generation step is the monitor's whole purpose: it turns the windowed
+aggregation tensors + live topology into the array-encoded ClusterState
+the TPU optimizer consumes.  Everything here is host-side numpy — the
+device boundary starts at the optimizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.models.builder import BrokerSpec, ClusterModelBuilder, PartitionSpec
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    WindowedMetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.capacity import BrokerCapacityConfigResolver
+from cruise_control_tpu.monitor.completeness import (
+    DEFAULT_REQUIREMENTS,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.cpu_model import follower_cpu_util_array
+from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF, MetricDef
+from cruise_control_tpu.monitor.sampling import PartitionEntity
+from cruise_control_tpu.monitor.topology import ClusterTopology, MetadataProvider
+
+
+class MonitorState(enum.Enum):
+    """Reference LoadMonitorTaskRunner.LoadMonitorTaskRunnerState."""
+
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    SAMPLING = "SAMPLING"
+    PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    TRAINING = "TRAINING"
+    LOADING = "LOADING"
+
+
+class NotEnoughValidWindowsError(Exception):
+    """Reference NotEnoughValidWindowsException."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelGeneration:
+    """(metadata generation, load/sample generation) pair
+    (reference monitor/ModelGeneration.java)."""
+
+    metadata_generation: int
+    load_generation: int
+
+
+class LoadMonitor:
+    """Builds ClusterStates from aggregated samples + topology."""
+
+    def __init__(
+        self,
+        metadata: MetadataProvider,
+        capacity_resolver: BrokerCapacityConfigResolver,
+        partition_aggregator: WindowedMetricSampleAggregator,
+        *,
+        metric_def: MetricDef = KAFKA_METRIC_DEF,
+        max_concurrent_model_generations: int = 1,
+        replica_capacity: int | None = None,
+    ):
+        self.metadata = metadata
+        self.capacity_resolver = capacity_resolver
+        self.partition_aggregator = partition_aggregator
+        self.metric_def = metric_def
+        self._state = MonitorState.NOT_STARTED
+        # reference acquireForModelGeneration():390 — semaphore bounding
+        # concurrent model generations
+        self._model_semaphore = threading.Semaphore(max_concurrent_model_generations)
+        self._replica_capacity = replica_capacity
+        self._generation_lock = threading.Lock()
+        self._load_generation = 0
+        self._paused_reason: str | None = None
+        # metric column ids resolved once
+        self._cpu_id = metric_def.metric_id("CPU_USAGE")
+        self._disk_id = metric_def.metric_id("DISK_USAGE")
+        self._nwin_id = metric_def.metric_id("LEADER_BYTES_IN")
+        self._nwout_id = metric_def.metric_id("LEADER_BYTES_OUT")
+
+    # ------------------------------------------------------------------
+
+    @property
+    def state(self) -> MonitorState:
+        return self._state
+
+    def start(self):
+        self._state = MonitorState.RUNNING
+
+    def pause(self, reason: str = "user request"):
+        """Reference LoadMonitor.pauseMetricSampling."""
+        self._state = MonitorState.PAUSED
+        self._paused_reason = reason
+
+    def resume(self):
+        self._state = MonitorState.RUNNING
+        self._paused_reason = None
+
+    def acquire_for_model_generation(self, timeout_s: float = 600.0):
+        """Context manager bounding concurrent model generations
+        (reference acquireForModelGeneration:390)."""
+        monitor = self
+
+        class _Ctx:
+            def __enter__(self):
+                if not monitor._model_semaphore.acquire(timeout=timeout_s):
+                    raise TimeoutError("could not acquire model-generation semaphore")
+                return monitor
+
+            def __exit__(self, *exc):
+                monitor._model_semaphore.release()
+                return False
+
+        return _Ctx()
+
+    # ------------------------------------------------------------------
+
+    def meets_completeness_requirements(
+        self, requirements: ModelCompletenessRequirements
+    ) -> bool:
+        """Reference meetCompletenessRequirements():616."""
+        try:
+            agg = self.partition_aggregator.aggregate(
+                AggregationOptions(
+                    min_valid_entity_ratio=requirements.min_monitored_partitions_percentage
+                )
+            )
+        except ValueError:
+            return False
+        enough_windows = (
+            agg.completeness.valid_windows.size >= requirements.min_required_num_windows
+        )
+        enough_partitions = (
+            agg.completeness.valid_entity_ratio
+            >= requirements.min_monitored_partitions_percentage
+        )
+        return enough_windows and enough_partitions
+
+    def cluster_model(
+        self,
+        requirements: ModelCompletenessRequirements = DEFAULT_REQUIREMENTS,
+        *,
+        allow_capacity_estimation: bool = True,
+    ) -> ClusterState:
+        """Generate the array-encoded cluster model
+        (reference LoadMonitor.clusterModel():485-568)."""
+        topology = self.metadata.refresh()
+        agg = self.partition_aggregator.aggregate(
+            AggregationOptions(
+                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage
+            )
+        )
+        if agg.completeness.valid_windows.size < requirements.min_required_num_windows:
+            raise NotEnoughValidWindowsError(
+                f"{agg.completeness.valid_windows.size} valid windows < "
+                f"required {requirements.min_required_num_windows}"
+            )
+        if (
+            agg.completeness.valid_entity_ratio
+            < requirements.min_monitored_partitions_percentage
+        ):
+            raise NotEnoughValidWindowsError(
+                f"valid partition ratio {agg.completeness.valid_entity_ratio:.3f} < "
+                f"required {requirements.min_monitored_partitions_percentage:.3f}"
+            )
+        state = self._build_state(topology, agg)
+        with self._generation_lock:
+            self._load_generation = agg.completeness.generation
+        return state
+
+    def model_generation(self) -> ModelGeneration:
+        return ModelGeneration(
+            metadata_generation=self.metadata.topology().generation,
+            load_generation=self._load_generation,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _window_reduced_loads(self, agg) -> dict:
+        """Reduce [E, W, M] window values to per-entity [4] loads.
+
+        AVG-strategy resources average over valid windows; DISK (LATEST)
+        takes the newest valid window (reference model/Load.expectedUtilizationFor,
+        model/Load.java:84-118 — AVG vs LATEST per KafkaMetricDef strategy).
+        """
+        values = agg.values  # [E, W, M]
+        valid = agg.window_valid  # [E, W]
+        n_valid = np.maximum(valid.sum(1), 1)  # [E]
+        vm = valid[..., None]
+
+        mean = (values * vm).sum(1) / n_valid[:, None]  # [E, M]
+        # newest valid window per entity (window axis is newest -> oldest)
+        first_valid = np.argmax(valid, axis=1)  # [E]
+        latest = values[np.arange(values.shape[0]), first_valid]  # [E, M]
+
+        load = np.zeros((values.shape[0], NUM_RESOURCES), np.float32)
+        load[:, Resource.CPU] = mean[:, self._cpu_id]
+        load[:, Resource.NW_IN] = mean[:, self._nwin_id]
+        load[:, Resource.NW_OUT] = mean[:, self._nwout_id]
+        load[:, Resource.DISK] = latest[:, self._disk_id]
+        return load
+
+    def _build_state(self, topology: ClusterTopology, agg) -> ClusterState:
+        entity_rows = self.partition_aggregator.entity_index()
+        loads = self._window_reduced_loads(agg)
+
+        topic_ids: dict[str, int] = {}
+        for p in topology.partitions:
+            topic_ids.setdefault(p.topic, len(topic_ids))
+
+        builder = ClusterModelBuilder(replica_capacity=self._replica_capacity)
+        for b in topology.brokers:
+            info = self.capacity_resolver.capacity_for_broker(b.rack, b.host, b.broker_id)
+            disk_caps = None
+            bad_disks = None
+            if info.disk_capacities:
+                logdirs = b.logdirs or tuple(info.disk_capacities)
+                disk_caps = [info.disk_capacities.get(d, 0.0) for d in logdirs]
+                bad = set(b.offline_logdirs)
+                bad_disks = [i for i, d in enumerate(logdirs) if d in bad] or None
+            builder.add_broker(
+                BrokerSpec(
+                    b.broker_id,
+                    rack=b.rack,
+                    host=b.host,
+                    capacity=np.asarray(info.capacity, np.float32),
+                    disk_capacities=disk_caps,
+                    alive=b.alive,
+                    new_broker=b.is_new,
+                    bad_disks=bad_disks,
+                )
+            )
+
+        leader_cpu = loads[:, Resource.CPU]
+        follower_cpu = follower_cpu_util_array(loads, leader_cpu)
+        alive = topology.alive_broker_ids()
+        for p in topology.partitions:
+            tid = topic_ids[p.topic]
+            entity = PartitionEntity(tid, p.partition)
+            row = entity_rows.get(entity)
+            if row is None:
+                # unmonitored partition: zero load (reference populates only
+                # monitored partitions; include_all_topics keeps it in the model)
+                leader_load = np.zeros(NUM_RESOURCES, np.float32)
+                follower = np.zeros(NUM_RESOURCES, np.float32)
+            else:
+                leader_load = loads[row]
+                follower = leader_load.copy()
+                follower[Resource.NW_OUT] = 0.0
+                follower[Resource.CPU] = follower_cpu[row]
+            # leader position within the replica list
+            leader_pos = 0
+            if p.leader in p.replicas:
+                leader_pos = list(p.replicas).index(p.leader)
+            builder.add_partition(
+                PartitionSpec(
+                    p.topic,
+                    p.partition,
+                    list(p.replicas),
+                    leader_load,
+                    follower_load=follower,
+                    leader_pos=leader_pos,
+                )
+            )
+        return builder.build()
+
+    # ------------------------------------------------------------------
+
+    def monitor_state(self) -> dict:
+        """STATE endpoint payload (reference LoadMonitorState)."""
+        try:
+            agg = self.partition_aggregator.aggregate()
+            windows = agg.completeness.valid_windows.size
+            ratio = agg.completeness.valid_entity_ratio
+        except ValueError:
+            windows, ratio = 0, 0.0
+        return {
+            "state": self._state.value,
+            "reasonOfLatestPauseOrResume": self._paused_reason,
+            "numValidWindows": int(windows),
+            "monitoredPartitionsPercentage": round(float(ratio) * 100.0, 3),
+            "numMonitoredPartitions": self.partition_aggregator.num_entities(),
+            "loadGeneration": self._load_generation,
+        }

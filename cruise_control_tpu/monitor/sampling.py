@@ -1,0 +1,265 @@
+"""Metric sampling framework: sampler SPI, fetcher, raw-metric processing.
+
+Reference: monitor/sampling/MetricSampler.java (plugin SPI),
+MetricFetcherManager.java:145 (scheduled fetch loops),
+CruiseControlMetricsProcessor.java (raw broker/topic/partition metrics ->
+partition & broker samples, incl. CPU attribution),
+holder/PartitionMetricSample.java + BrokerMetricSample.java.
+
+The TPU rebuild keeps sampling host-side (it is network I/O) but makes the
+sample payloads dense arrays keyed by the MetricDef so they pour straight
+into the windowed aggregation tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Protocol
+
+import numpy as np
+
+from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF, MetricDef
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionEntity:
+    """Aggregation entity for one partition; group = topic (reference
+    monitor/sampling/PartitionEntity.java)."""
+
+    topic: int
+    partition: int
+
+    @property
+    def group(self):
+        return self.topic
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerEntity:
+    """Reference monitor/sampling/BrokerEntity.java."""
+
+    broker_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSample:
+    """One entity's metrics at one time (reference
+    cruise-control-core monitor/sampling/MetricSample.java)."""
+
+    entity: object
+    time_ms: int
+    values: np.ndarray  # f32[M] indexed by MetricDef ids
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingResult:
+    partition_samples: list[MetricSample]
+    broker_samples: list[MetricSample]
+
+
+class MetricSampler(Protocol):
+    """Pluggable sampler SPI (reference monitor/sampling/MetricSampler.java).
+
+    Implementations fetch metrics for the assigned partitions between two
+    timestamps — from the metrics-reporter topic, a REST endpoint, files,
+    or synthetic generators in tests.
+    """
+
+    def get_samples(
+        self, assigned_partitions: list[PartitionEntity], start_ms: int, end_ms: int
+    ) -> SamplingResult:
+        ...
+
+
+class SampleStore(Protocol):
+    """Persists samples for warm restart (reference KafkaSampleStore.java:117)."""
+
+    def store(self, result: SamplingResult) -> None:
+        ...
+
+    def load(self) -> list[SamplingResult]:
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+class NoopSampleStore:
+    """Reference monitor/sampling/NoopSampleStore.java."""
+
+    def store(self, result: SamplingResult) -> None:
+        pass
+
+    def load(self) -> list[SamplingResult]:
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+class InMemorySampleStore:
+    """Bounded in-memory store, useful for tests and single-process runs."""
+
+    def __init__(self, max_results: int = 10_000):
+        self._results: list[SamplingResult] = []
+        self._max = max_results
+        self._lock = threading.Lock()
+
+    def store(self, result: SamplingResult) -> None:
+        with self._lock:
+            self._results.append(result)
+            if len(self._results) > self._max:
+                self._results = self._results[-self._max:]
+
+    def load(self) -> list[SamplingResult]:
+        with self._lock:
+            return list(self._results)
+
+    def close(self) -> None:
+        pass
+
+
+class FileSampleStore:
+    """npz-file-backed store — the warm-restart path when there is no Kafka
+    sample topic (role of reference KafkaSampleStore, storage swapped for
+    local files)."""
+
+    def __init__(self, path: str):
+        import os
+
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._n = len(self._files())
+
+    def _files(self):
+        import glob
+        import os
+
+        return sorted(glob.glob(os.path.join(self.path, "samples_*.npz")))
+
+    def store(self, result: SamplingResult) -> None:
+        import os
+
+        def pack(samples: list[MetricSample]):
+            if not samples:
+                return np.zeros((0, 3), np.int64), np.zeros((0, 0), np.float32)
+            meta = np.array(
+                [
+                    [
+                        getattr(s.entity, "topic", getattr(s.entity, "broker_id", -1)),
+                        getattr(s.entity, "partition", -1),
+                        s.time_ms,
+                    ]
+                    for s in samples
+                ],
+                np.int64,
+            )
+            vals = np.stack([s.values for s in samples])
+            return meta, vals
+
+        pm, pv = pack(result.partition_samples)
+        bm, bv = pack(result.broker_samples)
+        np.savez_compressed(
+            os.path.join(self.path, f"samples_{self._n:08d}.npz"),
+            part_meta=pm, part_values=pv, broker_meta=bm, broker_values=bv,
+        )
+        self._n += 1
+
+    def load(self) -> list[SamplingResult]:
+        out = []
+        for f in self._files():
+            z = np.load(f)
+            ps = [
+                MetricSample(PartitionEntity(int(t), int(p)), int(ts), v)
+                for (t, p, ts), v in zip(z["part_meta"], z["part_values"])
+            ]
+            bs = [
+                MetricSample(BrokerEntity(int(b)), int(ts), v)
+                for (b, _, ts), v in zip(z["broker_meta"], z["broker_values"])
+            ]
+            out.append(SamplingResult(ps, bs))
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class MetricFetcherManager:
+    """Schedules sampling rounds and feeds aggregators + sample store
+    (reference monitor/sampling/MetricFetcherManager.java:145,
+    SamplingFetcher.java:32).  Synchronous `fetch_once` plus an optional
+    background thread; partition assignment is a single list here because
+    the Python sampler SPI takes the whole batch (the reference splits
+    across fetcher threads — our samplers vectorize instead).
+    """
+
+    def __init__(
+        self,
+        sampler: MetricSampler,
+        partition_aggregator,
+        broker_aggregator,
+        sample_store: SampleStore | None = None,
+        *,
+        sampling_interval_ms: int = 120_000,
+    ):
+        self.sampler = sampler
+        self.partition_aggregator = partition_aggregator
+        self.broker_aggregator = broker_aggregator
+        self.sample_store = sample_store or NoopSampleStore()
+        self.sampling_interval_ms = sampling_interval_ms
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.total_samples = 0
+        self.failed_fetches = 0
+
+    def fetch_once(self, partitions: list[PartitionEntity], start_ms: int, end_ms: int) -> int:
+        """One sampling round (reference fetchPartitionMetricSamples:145)."""
+        try:
+            result = self.sampler.get_samples(partitions, start_ms, end_ms)
+        except Exception:
+            self.failed_fetches += 1
+            raise
+        n = self._absorb(result)
+        self.sample_store.store(result)
+        return n
+
+    def _absorb(self, result: SamplingResult) -> int:
+        n = 0
+        for s in result.partition_samples:
+            if self.partition_aggregator.add_sample(
+                s.entity, s.time_ms, s.values, group=getattr(s.entity, "group", None)
+            ):
+                n += 1
+        for s in result.broker_samples:
+            if self.broker_aggregator.add_sample(s.entity, s.time_ms, s.values):
+                n += 1
+        self.total_samples += n
+        return n
+
+    def load_samples(self) -> int:
+        """Warm restart from the sample store (reference SampleLoadingTask)."""
+        n = 0
+        for result in self.sample_store.load():
+            n += self._absorb(result)
+        return n
+
+    def start(self, partitions_fn, *, interval_s: float | None = None):
+        interval = interval_s or self.sampling_interval_ms / 1000.0
+
+        def loop():
+            while not self._stop.wait(interval):
+                now = int(time.time() * 1000)
+                try:
+                    self.fetch_once(partitions_fn(), now - self.sampling_interval_ms, now)
+                except Exception:  # noqa: BLE001 — keep the loop alive like the reference fetchers
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="metric-fetcher")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,84 @@
+"""Cluster topology description + metadata provider SPI.
+
+Plays the role of the reference's Kafka `Cluster` metadata +
+common/MetadataClient.java:1 (refreshMetadata against brokers).  The
+monitor consumes topology through this SPI so the same LoadMonitor serves
+a real Kafka-backed provider, the simulated cluster backend
+(executor tests), and synthetic fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerNode:
+    broker_id: int
+    rack: str
+    host: str
+    alive: bool = True
+    logdirs: tuple[str, ...] = ()
+    offline_logdirs: tuple[str, ...] = ()
+    is_new: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionInfo:
+    topic: str
+    partition: int
+    leader: int  # broker id, -1 if none
+    replicas: tuple[int, ...]  # broker ids, preferred order
+    replica_logdirs: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterTopology:
+    brokers: tuple[BrokerNode, ...]
+    partitions: tuple[PartitionInfo, ...]
+    generation: int = 0
+
+    def broker_ids(self) -> list[int]:
+        return [b.broker_id for b in self.brokers]
+
+    def alive_broker_ids(self) -> set[int]:
+        return {b.broker_id for b in self.brokers if b.alive}
+
+    def topics(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for p in self.partitions:
+            seen.setdefault(p.topic, None)
+        return list(seen)
+
+    @property
+    def num_replicas(self) -> int:
+        return sum(len(p.replicas) for p in self.partitions)
+
+
+class MetadataProvider(Protocol):
+    """Reference common/MetadataClient.java role."""
+
+    def topology(self) -> ClusterTopology:
+        ...
+
+    def refresh(self) -> ClusterTopology:
+        ...
+
+
+class StaticMetadataProvider:
+    """Fixed topology (tests, simulations); mutate via set_topology."""
+
+    def __init__(self, topology: ClusterTopology):
+        self._topology = topology
+
+    def topology(self) -> ClusterTopology:
+        return self._topology
+
+    def refresh(self) -> ClusterTopology:
+        return self._topology
+
+    def set_topology(self, topology: ClusterTopology):
+        self._topology = dataclasses.replace(
+            topology, generation=self._topology.generation + 1
+        )

@@ -1,0 +1,245 @@
+"""Monitor-layer tests.
+
+Mirrors the reference core test strategy (SURVEY §4.1): aggregator
+semantics (window rolling, extrapolation, completeness) on synthetic
+entities (reference MetricSampleAggregatorTest / RawMetricValuesTest), plus
+end-to-end LoadMonitor -> ClusterState -> optimizer integration
+(reference LoadMonitorTest with mocks; here a synthetic sampler).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.state import validate
+from cruise_control_tpu.monitor import (
+    AggregationOptions,
+    Extrapolation,
+    FileCapacityResolver,
+    FixedCapacityResolver,
+    KAFKA_METRIC_DEF,
+    LoadMonitor,
+    MetricFetcherManager,
+    ModelCompletenessRequirements,
+    NotEnoughValidWindowsError,
+    PartitionEntity,
+    StaticMetadataProvider,
+    WindowedMetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.cpu_model import (
+    LinearRegressionModelParameters,
+    follower_cpu_util,
+)
+from cruise_control_tpu.monitor.sampling import InMemorySampleStore
+from cruise_control_tpu.testing.synthetic import (
+    SyntheticWorkloadSampler,
+    WorkloadSpec,
+    synthetic_topology,
+)
+
+WINDOW_MS = 1000
+M = KAFKA_METRIC_DEF.num_metrics
+CPU = KAFKA_METRIC_DEF.metric_id("CPU_USAGE")
+DISK = KAFKA_METRIC_DEF.metric_id("DISK_USAGE")
+
+
+def agg_factory(num_windows=4, min_samples=2):
+    return WindowedMetricSampleAggregator(
+        num_windows=num_windows,
+        window_ms=WINDOW_MS,
+        min_samples_per_window=min_samples,
+        metric_def=KAFKA_METRIC_DEF,
+    )
+
+
+def sample(v_cpu, v_disk=0.0):
+    v = np.zeros(M, np.float32)
+    v[CPU] = v_cpu
+    v[DISK] = v_disk
+    return v
+
+
+def test_avg_and_latest_strategies():
+    agg = agg_factory()
+    e = PartitionEntity(0, 0)
+    # window 0: two samples; CPU averages, DISK takes latest by time
+    agg.add_sample(e, 100, sample(10.0, 100.0))
+    agg.add_sample(e, 900, sample(20.0, 140.0))
+    agg.add_sample(e, 1100, sample(0.0))  # opens window 1 -> window 0 completed
+    res = agg.aggregate()
+    w0 = np.where(res.window_indices == 0)[0][0]
+    assert res.values[0, w0, CPU] == pytest.approx(15.0)
+    assert res.values[0, w0, DISK] == pytest.approx(140.0)
+    assert res.extrapolation[0, w0] == Extrapolation.NONE
+
+
+def test_extrapolation_ladder():
+    agg = agg_factory(num_windows=6, min_samples=4)
+    e = PartitionEntity(0, 0)
+    # w0: 4 samples (NONE); w1: 2 (AVG_AVAILABLE >= half); w2: 1 (FORCED);
+    # w3: 0 with invalid neighbors (NO_VALID); w5 current
+    for i in range(4):
+        agg.add_sample(e, i * 10, sample(8.0))
+    for i in range(2):
+        agg.add_sample(e, 1000 + i * 10, sample(6.0))
+    agg.add_sample(e, 2000, sample(4.0))
+    agg.add_sample(e, 5500, sample(1.0))  # current window = 5
+    res = agg.aggregate()
+    by_w = {int(w): i for i, w in enumerate(res.window_indices)}
+    ext = res.extrapolation[0]
+    assert ext[by_w[0]] == Extrapolation.NONE
+    assert ext[by_w[1]] == Extrapolation.AVG_AVAILABLE
+    assert ext[by_w[2]] == Extrapolation.FORCED_INSUFFICIENT
+    assert ext[by_w[3]] == Extrapolation.NO_VALID_EXTRAPOLATION
+    assert not res.window_valid[0, by_w[3]]
+
+
+def test_avg_adjacent_extrapolation():
+    agg = agg_factory(num_windows=4, min_samples=1)
+    e = PartitionEntity(0, 0)
+    agg.add_sample(e, 100, sample(10.0))  # w0 full
+    # w1 empty
+    agg.add_sample(e, 2100, sample(30.0))  # w2 full
+    agg.add_sample(e, 3100, sample(0.0))  # opens w3 (current)
+    res = agg.aggregate()
+    by_w = {int(w): i for i, w in enumerate(res.window_indices)}
+    assert res.extrapolation[0, by_w[1]] == Extrapolation.AVG_ADJACENT
+    assert res.values[0, by_w[1], CPU] == pytest.approx(20.0)
+
+
+def test_window_rolling_evicts_old():
+    agg = agg_factory(num_windows=2, min_samples=1)
+    e = PartitionEntity(0, 0)
+    agg.add_sample(e, 100, sample(1.0))
+    agg.add_sample(e, 5100, sample(5.0))  # jump to w5; w0 rolled out
+    assert not agg.add_sample(e, 200, sample(9.9))  # too old now
+    res = agg.aggregate()
+    assert set(int(w) for w in res.window_indices) == {3, 4}
+
+
+def test_completeness_ratios():
+    agg = agg_factory(num_windows=2, min_samples=1)
+    e0, e1 = PartitionEntity(0, 0), PartitionEntity(0, 1)
+    agg.add_sample(e0, 100, sample(1.0), group=0)
+    agg.add_sample(e1, 150, sample(1.0), group=0)
+    agg.add_sample(e0, 1100, sample(1.0), group=0)  # e1 misses window 1
+    agg.add_sample(e0, 2100, sample(1.0), group=0)  # current w2
+    res = agg.aggregate(AggregationOptions(min_valid_entity_ratio=1.0))
+    # window 0 has both entities, window 1 only e0
+    by_w = {int(w): i for i, w in enumerate(res.window_indices)}
+    assert res.completeness.valid_entity_ratio_by_window[by_w[0]] == pytest.approx(1.0)
+    assert res.completeness.valid_entity_ratio_by_window[by_w[1]] == pytest.approx(0.5)
+    assert list(res.completeness.valid_windows) == [0]
+    # ENTITY_GROUP granularity: e1 invalid -> whole topic group invalid
+    res2 = agg.aggregate(
+        AggregationOptions(min_valid_entity_ratio=0.4, granularity="ENTITY_GROUP")
+    )
+    assert res2.completeness.valid_entity_group_ratio == 0.0
+
+
+def test_follower_cpu_model():
+    # followers only pay the bytes-in share of leader CPU
+    assert follower_cpu_util(100.0, 0.0, 10.0) == pytest.approx(
+        10.0 * 0.15 * 100.0 / (0.7 * 100.0)
+    )
+    assert follower_cpu_util(0.0, 0.0, 10.0) == 0.0
+
+    lr = LinearRegressionModelParameters(min_samples_to_train=10)
+    rng = np.random.default_rng(0)
+    true_w = np.array([0.002, 0.001, 0.0005])
+    for _ in range(50):
+        x = rng.uniform(0, 1000, 3)
+        lr.add_sample(*x, cpu_util=float(true_w @ x))
+    assert lr.train()
+    est = lr.estimate(100.0, 100.0, 100.0)
+    assert est == pytest.approx(float(true_w.sum() * 100.0), rel=1e-3)
+
+
+def test_file_capacity_resolver_jbod(tmp_path):
+    doc = {
+        "brokerCapacities": [
+            {
+                "brokerId": "-1",
+                "capacity": {"DISK": "100000", "CPU": "100", "NW_IN": "10000", "NW_OUT": "10000"},
+            },
+            {
+                "brokerId": "0",
+                "capacity": {
+                    "DISK": {"/d1": "250000", "/d2": "250000"},
+                    "CPU": "100",
+                    "NW_IN": "50000",
+                    "NW_OUT": "50000",
+                },
+            },
+        ]
+    }
+    p = tmp_path / "capacity.json"
+    p.write_text(json.dumps(doc))
+    r = FileCapacityResolver(str(p))
+    b0 = r.capacity_for_broker("r0", "h0", 0)
+    assert b0.is_jbod and b0.capacity[Resource.DISK] == 500000
+    b9 = r.capacity_for_broker("r0", "h0", 9)  # falls back to default
+    assert b9.capacity[Resource.DISK] == 100000
+
+
+@pytest.fixture()
+def monitored_cluster():
+    topo = synthetic_topology(num_brokers=6, topics={"T0": 12, "T1": 12}, seed=2)
+    sampler = SyntheticWorkloadSampler(topo, WorkloadSpec(), seed=2)
+    agg = agg_factory(num_windows=3, min_samples=1)
+    store = InMemorySampleStore()
+    fetcher = MetricFetcherManager(sampler, agg, agg_factory(), sample_store=store)
+    parts = sampler.all_partition_entities()
+    for w in range(4):  # 3 completed windows + current
+        fetcher.fetch_once(parts, w * WINDOW_MS, (w + 1) * WINDOW_MS - 1)
+    monitor = LoadMonitor(
+        StaticMetadataProvider(topo), FixedCapacityResolver([100.0, 1e5, 1e5, 1e6]), agg
+    )
+    return topo, sampler, monitor, store
+
+
+def test_load_monitor_builds_valid_state(monitored_cluster):
+    topo, sampler, monitor, _ = monitored_cluster
+    req = ModelCompletenessRequirements(min_required_num_windows=2)
+    assert monitor.meets_completeness_requirements(req)
+    state = monitor.cluster_model(req)
+    assert validate(state) == []
+    assert state.shape.B == 6
+    assert int(np.asarray(state.replica_valid).sum()) == topo.num_replicas
+    # loads reflect the sampler's base rates (non-zero CPU on every leader)
+    leads = np.asarray(state.replica_is_leader) & np.asarray(state.replica_valid)
+    assert (np.asarray(state.replica_load_leader)[leads][:, Resource.CPU] > 0).all()
+
+
+def test_load_monitor_rejects_insufficient_windows(monitored_cluster):
+    _, _, monitor, _ = monitored_cluster
+    with pytest.raises(NotEnoughValidWindowsError):
+        monitor.cluster_model(ModelCompletenessRequirements(min_required_num_windows=50))
+
+
+def test_sample_store_warm_restart(monitored_cluster):
+    topo, sampler, _, store = monitored_cluster
+    fresh_agg = agg_factory(num_windows=3, min_samples=1)
+    fetcher = MetricFetcherManager(sampler, fresh_agg, agg_factory(), sample_store=store)
+    n = fetcher.load_samples()
+    assert n > 0
+    monitor = LoadMonitor(
+        StaticMetadataProvider(topo), FixedCapacityResolver([100.0, 1e5, 1e5, 1e6]), fresh_agg
+    )
+    state = monitor.cluster_model(ModelCompletenessRequirements(min_required_num_windows=2))
+    assert validate(state) == []
+
+
+def test_monitor_to_optimizer_end_to_end(monitored_cluster):
+    """The full monitor -> analyzer slice (SURVEY §3.3 without the servlet)."""
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+
+    _, _, monitor, _ = monitored_cluster
+    state = monitor.cluster_model(ModelCompletenessRequirements(min_required_num_windows=2))
+    cfg = OptimizerConfig(
+        num_candidates=128, leadership_candidates=32, steps_per_round=16, num_rounds=2
+    )
+    res = GoalOptimizer(config=cfg).optimize(state)
+    assert res.objective_after <= res.objective_before
