@@ -1,0 +1,36 @@
+"""Executor layer: proposal execution with throttling and progress tracking.
+
+Reference: cruise-control/.../executor/ (Executor.java, ExecutionTaskPlanner.java,
+strategy/, ReplicationThrottleHelper.java) + the Scala ZK bridge
+(ExecutorUtils.scala), replaced by the ClusterAdmin SPI.
+"""
+
+from cruise_control_tpu.executor.admin import (
+    ClusterAdmin,
+    LeadershipSpec,
+    ReassignmentSpec,
+    SimulatedClusterAdmin,
+)
+from cruise_control_tpu.executor.executor import (
+    ExecutionOptions,
+    ExecutionResult,
+    Executor,
+    ExecutorState,
+    OngoingExecutionError,
+)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import (
+    STRATEGIES_BY_NAME,
+    BaseReplicaMovementStrategy,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    ReplicaMovementStrategy,
+)
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskTracker,
+    TaskState,
+    TaskType,
+)
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper

@@ -1,0 +1,170 @@
+"""Cluster administration SPI + simulated backend.
+
+Reference boundary: the Scala ZK bridge (ExecutorUtils.scala:31
+executeReplicaReassignmentTasks / :95 executePreferredLeaderElection /
+:103 partitionsBeingReassigned) + executor/ExecutorAdminUtils.java
+(alterReplicaLogDirs, describe logdirs).  Modern Kafka does reassignment
+through the AdminClient API, so the SPI is shaped like that — a real
+implementation wraps an AdminClient; the simulated one mutates a
+StaticMetadataProvider topology with throttle-limited progress, playing
+the role of the reference's embedded-cluster test harness
+(CCKafkaIntegrationTestHarness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+from cruise_control_tpu.monitor.topology import (
+    ClusterTopology,
+    PartitionInfo,
+    StaticMetadataProvider,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReassignmentSpec:
+    topic: str
+    partition: int
+    new_replicas: tuple[int, ...]  # target replica list, leader candidate first
+    data_to_move: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class LeadershipSpec:
+    topic: str
+    partition: int
+    preferred_leader: int
+
+
+class ClusterAdmin(Protocol):
+    """What the executor needs from the cluster."""
+
+    def reassign_partitions(self, specs: list[ReassignmentSpec]) -> None:
+        ...
+
+    def in_progress_reassignments(self) -> set[tuple[str, int]]:
+        ...
+
+    def cancel_reassignments(self) -> None:
+        ...
+
+    def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
+        ...
+
+    def alter_replica_logdirs(self, moves: list[tuple[str, int, int, int]]) -> None:
+        """(topic, partition, broker, target_disk) intra-broker moves."""
+        ...
+
+    def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
+        ...
+
+    def clear_replication_throttle(self) -> None:
+        ...
+
+    def topology(self) -> ClusterTopology:
+        ...
+
+
+@dataclasses.dataclass
+class _Inflight:
+    spec: ReassignmentSpec
+    remaining_bytes: float
+
+
+class SimulatedClusterAdmin:
+    """Deterministic simulated cluster: reassignments progress by
+    `tick(seconds)` at min(throttle, link_rate) per partition."""
+
+    def __init__(
+        self,
+        metadata: StaticMetadataProvider,
+        *,
+        link_rate_bytes_per_s: float = 50_000.0,
+        fail_partitions: set[tuple[str, int]] | None = None,
+    ):
+        self.metadata = metadata
+        self.link_rate = link_rate_bytes_per_s
+        self.throttle_rate: float | None = None
+        self.throttled_topics: set[str] = set()
+        self._inflight: dict[tuple[str, int], _Inflight] = {}
+        self._fail = fail_partitions or set()
+        self.reassign_calls = 0
+        self.election_calls = 0
+
+    # --- ClusterAdmin SPI ---
+
+    def reassign_partitions(self, specs: list[ReassignmentSpec]) -> None:
+        self.reassign_calls += 1
+        for s in specs:
+            key = (s.topic, s.partition)
+            if key in self._inflight:
+                raise ValueError(f"reassignment already in progress for {key}")
+            self._inflight[key] = _Inflight(s, max(s.data_to_move, 0.0))
+
+    def in_progress_reassignments(self) -> set[tuple[str, int]]:
+        return set(self._inflight)
+
+    def cancel_reassignments(self) -> None:
+        # reference force-stop deletes the ZK node (Executor.java:1145)
+        self._inflight.clear()
+
+    def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
+        self.election_calls += 1
+        topo = self.metadata.topology()
+        parts = list(topo.partitions)
+        index = {(p.topic, p.partition): i for i, p in enumerate(parts)}
+        for s in specs:
+            i = index[(s.topic, s.partition)]
+            p = parts[i]
+            if s.preferred_leader in p.replicas:
+                parts[i] = dataclasses.replace(p, leader=s.preferred_leader)
+        self.metadata.set_topology(dataclasses.replace(topo, partitions=tuple(parts)))
+
+    def alter_replica_logdirs(self, moves) -> None:
+        pass  # logdir placement is not modeled in the simulated topology
+
+    def set_replication_throttle(self, rate: float, topics: set[str]) -> None:
+        self.throttle_rate = rate
+        self.throttled_topics = set(topics)
+
+    def clear_replication_throttle(self) -> None:
+        self.throttle_rate = None
+        self.throttled_topics = set()
+
+    def topology(self) -> ClusterTopology:
+        return self.metadata.topology()
+
+    # --- simulation ---
+
+    def tick(self, seconds: float) -> list[tuple[str, int]]:
+        """Advance time; returns reassignments that completed this tick."""
+        rate = self.link_rate
+        if self.throttle_rate is not None:
+            rate = min(rate, self.throttle_rate)
+        done = []
+        for key, fl in list(self._inflight.items()):
+            if key in self._fail:
+                continue  # stuck forever (tests exercise DEAD handling)
+            fl.remaining_bytes -= rate * seconds
+            if fl.remaining_bytes <= 0:
+                self._apply(fl.spec)
+                del self._inflight[key]
+                done.append(key)
+        return done
+
+    def _apply(self, spec: ReassignmentSpec):
+        topo = self.metadata.topology()
+        parts = list(topo.partitions)
+        index = {(p.topic, p.partition): i for i, p in enumerate(parts)}
+        i = index[(spec.topic, spec.partition)]
+        p = parts[i]
+        leader = p.leader if p.leader in spec.new_replicas else spec.new_replicas[0]
+        parts[i] = PartitionInfo(
+            topic=p.topic,
+            partition=p.partition,
+            leader=leader,
+            replicas=tuple(spec.new_replicas),
+        )
+        self.metadata.set_topology(dataclasses.replace(topo, partitions=tuple(parts)))

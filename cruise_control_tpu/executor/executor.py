@@ -1,0 +1,337 @@
+"""Executor — applies optimization proposals to the cluster.
+
+Reference: executor/Executor.java:72 — executeProposals():395,
+ProposalExecutionRunnable.run():749 (phase 1 inter/intra-broker moves,
+phase 2 leadership), updateOngoingExecutionState():912 (progress loop),
+maybeReexecuteTasks():1430, graceful + forced stop (:1145 deletes the ZK
+reassignment node; here admin.cancel_reassignments), per-broker
+concurrency caps (Executor.java:485-510), removed/demoted broker history.
+
+The execution loop is tick-driven: each `progress_check` round collects
+finished reassignments from the ClusterAdmin, transitions tasks, and
+drains new ones within concurrency caps.  `execute_proposals` runs the
+loop synchronously (simulation advances via admin.tick) or in a
+background thread against a real cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.admin import ClusterAdmin, LeadershipSpec, ReassignmentSpec
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskTracker,
+    TaskState,
+    TaskType,
+)
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+
+
+class ExecutorState(enum.Enum):
+    """Reference executor/ExecutorState.java states."""
+
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    )
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    )
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+@dataclasses.dataclass
+class ExecutionOptions:
+    """Concurrency caps (reference config/constants/ExecutorConfig.java:
+    num.concurrent.partition.movements.per.broker default 5,
+    num.concurrent.intra.broker.partition.movements default 2,
+    num.concurrent.leader.movements default 1000)."""
+
+    concurrent_partition_movements_per_broker: int = 5
+    concurrent_intra_broker_partition_movements: int = 2
+    concurrent_leader_movements: int = 1000
+    replication_throttle_bytes_per_s: float | None = None
+    progress_check_interval_s: float = 0.5
+    #: tasks in progress longer than this raise an alert flag
+    task_execution_alerting_s: float = 90.0
+    max_ticks: int = 10_000  # simulation safety bound
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    completed: int
+    aborted: int
+    dead: int
+    ticks: int
+    stopped: bool
+    tracker_status: dict
+
+
+class OngoingExecutionError(Exception):
+    """Reference sanityCheckDryRun / ongoing-execution guard
+    (KafkaCruiseControl.java:216-229)."""
+
+
+class Executor:
+    def __init__(
+        self,
+        admin: ClusterAdmin,
+        *,
+        strategy: ReplicaMovementStrategy | None = None,
+        topic_names: dict[int, str] | None = None,
+        catalog=None,
+    ):
+        self.admin = admin
+        self.strategy = strategy
+        self.topic_names = topic_names or {}
+        #: ClusterCatalog resolving global partition ids -> (topic, partition)
+        self.catalog = catalog
+        self.state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = False
+        self._force_stop = False
+        self._lock = threading.RLock()
+        self.tracker = ExecutionTaskTracker()
+        self._planner: ExecutionTaskPlanner | None = None
+        # reference Executor recentlyRemovedBrokers / recentlyDemotedBrokers
+        self.removed_brokers: set[int] = set()
+        self.demoted_brokers: set[int] = set()
+        self.num_executions_started = 0
+        self.num_executions_stopped = 0
+        self._uuid: str | None = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self.state != ExecutorState.NO_TASK_IN_PROGRESS
+
+    def stop_execution(self, *, force: bool = False):
+        """Reference Executor.userTriggeredStopExecution (+ force stop :1145)."""
+        with self._lock:
+            if self.has_ongoing_execution:
+                self._stop_requested = True
+                self._force_stop = force
+                self.num_executions_stopped += 1
+                self.state = ExecutorState.STOPPING_EXECUTION
+
+    def execute_proposals(
+        self,
+        proposals: list[ExecutionProposal],
+        options: ExecutionOptions | None = None,
+        *,
+        uuid: str | None = None,
+        removed_brokers: set[int] | None = None,
+        demoted_brokers: set[int] | None = None,
+        strategy_context: dict | None = None,
+    ) -> ExecutionResult:
+        """Reference Executor.executeProposals():395 (synchronous variant)."""
+        options = options or ExecutionOptions()
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("an execution is already in progress")
+            self.state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+            self._uuid = uuid
+            self.num_executions_started += 1
+            if removed_brokers:
+                self.removed_brokers |= removed_brokers
+            if demoted_brokers:
+                self.demoted_brokers |= demoted_brokers
+            self.tracker = ExecutionTaskTracker()
+            self._planner = ExecutionTaskPlanner(self.strategy)
+            tasks = self._planner.add_execution_proposals(proposals, strategy_context)
+            for t in tasks:
+                self.tracker.add(t)
+
+        throttle = ReplicationThrottleHelper(
+            self.admin, options.replication_throttle_bytes_per_s
+        )
+        throttle.set_throttles(proposals, self.topic_names)
+        try:
+            result = self._run(options)
+        finally:
+            throttle.clear_throttles()
+            with self._lock:
+                self.state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._planner = None
+        return result
+
+    # ------------------------------------------------------------------
+
+    def _run(self, options: ExecutionOptions) -> ExecutionResult:
+        """The proposal execution loop (reference ProposalExecutionRunnable.run:749):
+        phase 1 — inter/intra-broker replica moves; phase 2 — leadership."""
+        planner = self._planner
+        assert planner is not None
+        in_flight: dict[tuple[str, int], ExecutionTask] = {}
+        ticks = 0
+        simulated = hasattr(self.admin, "tick")
+
+        def now_ms() -> int:
+            return int(time.time() * 1000) if not simulated else ticks * 1000
+
+        # --- phase 1: replica movements ---
+        self.state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        while ticks < options.max_ticks:
+            if self._stop_requested:
+                self._handle_stop(in_flight, now_ms())
+                break
+            # collect completions
+            in_progress = self.admin.in_progress_reassignments()
+            for key, task in list(in_flight.items()):
+                if key not in in_progress:
+                    task.completed(now_ms())
+                    del in_flight[key]
+                elif (
+                    task.alert_time_ms < 0
+                    and now_ms() - task.start_time_ms
+                    > options.task_execution_alerting_s * 1000
+                ):
+                    task.alert_time_ms = now_ms()
+            # mark tasks dead when a destination broker died mid-move
+            alive = self.admin.topology().alive_broker_ids()
+            for key, task in list(in_flight.items()):
+                if not set(task.proposal.new_replicas) <= alive:
+                    task.kill(now_ms())
+                    del in_flight[key]
+
+            # drain new tasks within caps
+            ready = self._ready_brokers(options, in_flight)
+            new_tasks = planner.get_inter_broker_replica_movement_tasks(
+                ready, set(in_flight)
+            )
+            intra = planner.get_intra_broker_replica_movement_tasks(
+                {
+                    b: options.concurrent_intra_broker_partition_movements
+                    for b in alive
+                }
+            )
+            if new_tasks:
+                specs = []
+                for t in new_tasks:
+                    t.in_progress(now_ms())
+                    key = self._partition_key(t.proposal)
+                    in_flight[key] = t
+                    specs.append(
+                        ReassignmentSpec(
+                            topic=key[0],
+                            partition=key[1],
+                            new_replicas=tuple(t.proposal.new_replicas),
+                            data_to_move=t.proposal.inter_broker_data_to_move,
+                        )
+                    )
+                self.admin.reassign_partitions(specs)
+            for t in intra:
+                t.in_progress(now_ms())
+                tname, pnum = self._partition_key(t.proposal)
+                self.admin.alter_replica_logdirs(
+                    [
+                        (tname, pnum, b, d_new)
+                        for (b, _d_old, d_new) in t.proposal.disk_moves
+                    ]
+                )
+                t.completed(now_ms())
+
+            if not in_flight and not planner.remaining_inter_broker_moves:
+                break
+            ticks += 1
+            if simulated:
+                self.admin.tick(options.progress_check_interval_s)
+            else:
+                time.sleep(options.progress_check_interval_s)
+
+        # --- phase 2: leadership movements ---
+        if not self._stop_requested:
+            self.state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+            while True:
+                batch = planner.get_leadership_movement_tasks(
+                    options.concurrent_leader_movements
+                )
+                if not batch:
+                    break
+                specs = []
+                for t in batch:
+                    t.in_progress(now_ms())
+                    tname, pnum = self._partition_key(t.proposal)
+                    specs.append(
+                        LeadershipSpec(
+                            topic=tname,
+                            partition=pnum,
+                            preferred_leader=t.proposal.new_leader,
+                        )
+                    )
+                self.admin.elect_leaders(specs)
+                for t in batch:
+                    t.completed(now_ms())
+
+        # abort anything still pending after a stop
+        for t in self.tracker.tasks(state=TaskState.PENDING):
+            t.in_progress(now_ms())
+            t.aborting(now_ms())
+            t.aborted(now_ms())
+
+        return ExecutionResult(
+            completed=self.tracker.count(state=TaskState.COMPLETED),
+            aborted=self.tracker.count(state=TaskState.ABORTED),
+            dead=self.tracker.count(state=TaskState.DEAD),
+            ticks=ticks,
+            stopped=self._stop_requested,
+            tracker_status=self.tracker.status(),
+        )
+
+    def _handle_stop(self, in_flight, now: int):
+        """Graceful stop finishes nothing new; forced stop cancels in-flight
+        reassignments (reference Executor.java:1145)."""
+        if self._force_stop:
+            self.admin.cancel_reassignments()
+            for task in in_flight.values():
+                task.aborting(now)
+                task.aborted(now)
+            in_flight.clear()
+
+    def _ready_brokers(self, options: ExecutionOptions, in_flight) -> dict[int, int]:
+        cap = options.concurrent_partition_movements_per_broker
+        alive = self.admin.topology().alive_broker_ids()
+        used: dict[int, int] = {}
+        for task in in_flight.values():
+            p = task.proposal
+            for b in set(p.old_replicas) ^ set(p.new_replicas):
+                used[b] = used.get(b, 0) + 1
+        return {b: max(0, cap - used.get(b, 0)) for b in alive}
+
+    def _partition_key(self, proposal: ExecutionProposal) -> tuple[str, int]:
+        """(topic name, partition number) for a proposal: the catalog maps
+        the array model's global partition id; without one, proposal ids are
+        taken at face value (fixture-built proposals)."""
+        if self.catalog is not None:
+            return self.catalog.partition_key(proposal.partition)
+        return (
+            self.topic_names.get(proposal.topic, str(proposal.topic)),
+            proposal.partition,
+        )
+
+    # ------------------------------------------------------------------
+
+    def executor_state(self) -> dict:
+        """STATE endpoint payload (reference ExecutorState JSON)."""
+        return {
+            "state": self.state.value,
+            "numFinishedMovements": self.tracker.count(state=TaskState.COMPLETED),
+            "numTotalMovements": len(self.tracker.tasks()),
+            "finishedDataMovementMB": self.tracker.finished_data_bytes(),
+            "recentlyRemovedBrokers": sorted(self.removed_brokers),
+            "recentlyDemotedBrokers": sorted(self.demoted_brokers),
+            "numExecutionsStarted": self.num_executions_started,
+            "numExecutionsStopped": self.num_executions_stopped,
+            "triggeredUserTaskId": self._uuid,
+        }

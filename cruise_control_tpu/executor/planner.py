@@ -1,0 +1,134 @@
+"""Execution task planning: proposals -> strategy-ordered task queues with
+per-broker concurrency-aware draining.
+
+Reference: executor/ExecutionTaskPlanner.java:63 (addExecutionProposals),
+:280-295 (leadership drain), :314+ (getInterBrokerReplicaMovementTasks —
+round-robin over ready brokers so no broker starves).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.strategy import (
+    BaseReplicaMovementStrategy,
+    ReplicaMovementStrategy,
+)
+from cruise_control_tpu.executor.tasks import ExecutionTask, TaskType
+
+
+class ExecutionTaskPlanner:
+    def __init__(self, strategy: ReplicaMovementStrategy | None = None):
+        self.strategy = strategy or BaseReplicaMovementStrategy()
+        self._next_id = 0
+        self._inter: list[ExecutionTask] = []
+        self._intra: list[ExecutionTask] = []
+        self._leadership: list[ExecutionTask] = []
+
+    def _task(self, proposal: ExecutionProposal, tt: TaskType) -> ExecutionTask:
+        t = ExecutionTask(self._next_id, proposal, tt)
+        self._next_id += 1
+        return t
+
+    def add_execution_proposals(
+        self, proposals: list[ExecutionProposal], context: dict | None = None
+    ) -> list[ExecutionTask]:
+        """Split proposals into typed tasks (reference addExecutionProposals:63)."""
+        all_tasks = []
+        for p in proposals:
+            if p.has_replica_action:
+                all_tasks.append(self._task(p, TaskType.INTER_BROKER_REPLICA_ACTION))
+            elif p.disk_moves:
+                all_tasks.append(self._task(p, TaskType.INTRA_BROKER_REPLICA_ACTION))
+            if p.has_leader_action:
+                # leadership settles in phase 2 via preferred-leader election,
+                # after any replica move of the same partition completed
+                # (reference runs moveLeaderships after interBrokerMoveReplicas,
+                # Executor.java:749)
+                all_tasks.append(self._task(p, TaskType.LEADER_ACTION))
+        self._inter += [t for t in all_tasks if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION]
+        self._intra += [t for t in all_tasks if t.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION]
+        self._leadership += [t for t in all_tasks if t.task_type == TaskType.LEADER_ACTION]
+        self._inter = self.strategy.order(self._inter, context)
+        return all_tasks
+
+    # ------------------------------------------------------------------
+
+    @property
+    def remaining_inter_broker_moves(self) -> list[ExecutionTask]:
+        return list(self._inter)
+
+    @property
+    def remaining_intra_broker_moves(self) -> list[ExecutionTask]:
+        return list(self._intra)
+
+    @property
+    def remaining_leadership_moves(self) -> list[ExecutionTask]:
+        return list(self._leadership)
+
+    def get_leadership_movement_tasks(self, num_tasks: int) -> list[ExecutionTask]:
+        """Reference getLeadershipMovementTasks:295."""
+        out, self._leadership = self._leadership[:num_tasks], self._leadership[num_tasks:]
+        return out
+
+    def get_intra_broker_replica_movement_tasks(
+        self, ready_brokers: dict[int, int]
+    ) -> list[ExecutionTask]:
+        out = []
+        rest = []
+        for t in self._intra:
+            b = t.proposal.new_replicas[0] if t.proposal.new_replicas else -1
+            if ready_brokers.get(b, 0) > 0:
+                ready_brokers[b] -= 1
+                out.append(t)
+            else:
+                rest.append(t)
+        self._intra = rest
+        return out
+
+    def get_inter_broker_replica_movement_tasks(
+        self,
+        ready_brokers: dict[int, int],
+        in_progress_partitions: set[tuple[int, int]],
+    ) -> list[ExecutionTask]:
+        """Drain tasks whose source AND destination brokers have slots,
+        round-robin across brokers so slots aren't starved
+        (reference getInterBrokerReplicaMovementTasks:314)."""
+        slots = dict(ready_brokers)
+        chosen: list[ExecutionTask] = []
+        chosen_ids: set[int] = set()
+        partitions_involved = set(in_progress_partitions)
+
+        new_task_added = True
+        while new_task_added:
+            new_task_added = False
+            brokers_involved: set[int] = set()
+            for broker_id in list(slots):
+                if broker_id in brokers_involved or slots.get(broker_id, 0) <= 0:
+                    continue
+                for t in self._inter:
+                    if t.execution_id in chosen_ids:
+                        continue
+                    p = t.proposal
+                    key = (p.topic, p.partition)
+                    old, new = set(p.old_replicas), set(p.new_replicas)
+                    adds = new - old
+                    drops = old - new
+                    involved = adds | drops
+                    if broker_id not in involved:
+                        continue
+                    if key in partitions_involved:
+                        continue
+                    if any(slots.get(b, 0) <= 0 for b in involved):
+                        continue
+                    for b in involved:
+                        slots[b] -= 1
+                        brokers_involved.add(b)
+                    partitions_involved.add(key)
+                    chosen.append(t)
+                    chosen_ids.add(t.execution_id)
+                    new_task_added = True
+                    break
+        self._inter = [t for t in self._inter if t.execution_id not in chosen_ids]
+        return chosen

@@ -1,0 +1,36 @@
+"""Replication throttling around an execution.
+
+Reference: executor/ReplicationThrottleHelper.java:32-47 — sets
+leader/follower throttled rates + throttled-replica lists on the brokers
+and topics involved in an execution, and cleans them up afterwards (even
+on failure).
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.admin import ClusterAdmin
+
+
+class ReplicationThrottleHelper:
+    def __init__(self, admin: ClusterAdmin, throttle_rate_bytes_per_s: float | None):
+        self.admin = admin
+        self.rate = throttle_rate_bytes_per_s
+        self._active = False
+
+    def set_throttles(self, proposals: list[ExecutionProposal], topic_names: dict[int, str]):
+        if self.rate is None:
+            return
+        topics = {
+            topic_names.get(p.topic, str(p.topic))
+            for p in proposals
+            if p.has_replica_action
+        }
+        if topics:
+            self.admin.set_replication_throttle(self.rate, topics)
+            self._active = True
+
+    def clear_throttles(self):
+        if self._active:
+            self.admin.clear_replication_throttle()
+            self._active = False

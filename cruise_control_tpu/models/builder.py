@@ -29,6 +29,30 @@ class BrokerSpec:
     bad_disks: list[int] | None = None
 
 
+@dataclasses.dataclass(frozen=True)
+class ClusterCatalog:
+    """Host-side id <-> name mappings for one built ClusterState.
+
+    The array model only carries dense integer ids; everything that talks
+    to the outside world (executor, REST responses, logs) resolves names
+    through this catalog (role of the reference's TopicPartition objects).
+    """
+
+    topics: tuple[str, ...]  # topic name by topic id
+    partitions: tuple[tuple[str, int], ...]  # (topic name, partition number) by global pid
+    racks: tuple[str, ...] = ()
+    hosts: tuple[str, ...] = ()
+
+    def topic_id(self, name: str) -> int:
+        return self.topics.index(name)
+
+    def partition_key(self, pid: int) -> tuple[str, int]:
+        return self.partitions[pid]
+
+    def topic_names_by_id(self) -> dict[int, str]:
+        return dict(enumerate(self.topics))
+
+
 @dataclasses.dataclass
 class PartitionSpec:
     topic: str
@@ -153,6 +177,12 @@ class ClusterModelBuilder:
                 r_fl[k] = fl
                 k += 1
 
+        self.catalog = ClusterCatalog(
+            topics=tuple(topics),
+            partitions=tuple((p.topic, p.partition) for p in parts),
+            racks=tuple(racks),
+            hosts=tuple(hosts),
+        )
         shape = ClusterShape(
             num_replicas=R,
             num_brokers=B,

@@ -95,6 +95,8 @@ class LoadMonitor:
         self._disk_id = metric_def.metric_id("DISK_USAGE")
         self._nwin_id = metric_def.metric_id("LEADER_BYTES_IN")
         self._nwout_id = metric_def.metric_id("LEADER_BYTES_OUT")
+        #: id<->name catalog of the most recent cluster_model() build
+        self.last_catalog = None
 
     # ------------------------------------------------------------------
 
@@ -280,7 +282,9 @@ class LoadMonitor:
                     leader_pos=leader_pos,
                 )
             )
-        return builder.build()
+        state = builder.build()
+        self.last_catalog = builder.catalog
+        return state
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,253 @@
+"""Executor tests against the simulated cluster backend.
+
+Mirrors reference ExecutionTaskPlannerTest + ExecutorTest (embedded-cluster
+integration, SURVEY §4.5) with the SimulatedClusterAdmin standing in for
+embedded brokers.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor import (
+    ExecutionOptions,
+    ExecutionTaskPlanner,
+    Executor,
+    ExecutorState,
+    OngoingExecutionError,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    SimulatedClusterAdmin,
+    TaskState,
+    TaskType,
+)
+from cruise_control_tpu.monitor.topology import (
+    BrokerNode,
+    ClusterTopology,
+    PartitionInfo,
+    StaticMetadataProvider,
+)
+
+
+def proposal(topic, part, old, new, old_leader=None, new_leader=None, data=100.0):
+    return ExecutionProposal(
+        partition=part,
+        topic=topic,
+        old_leader=old[0] if old_leader is None else old_leader,
+        new_leader=new[0] if new_leader is None else new_leader,
+        old_replicas=tuple(old),
+        new_replicas=tuple(new),
+        inter_broker_data_to_move=data,
+    )
+
+
+def topo_4brokers(partitions):
+    brokers = tuple(BrokerNode(i, rack=f"r{i % 2}", host=f"h{i}") for i in range(4))
+    return ClusterTopology(brokers=brokers, partitions=tuple(partitions))
+
+
+@pytest.fixture()
+def sim():
+    parts = [
+        PartitionInfo("T0", 0, leader=0, replicas=(0, 1)),
+        PartitionInfo("T0", 1, leader=1, replicas=(1, 2)),
+        PartitionInfo("T1", 0, leader=2, replicas=(2, 3)),
+        PartitionInfo("T1", 1, leader=3, replicas=(3, 0)),
+    ]
+    meta = StaticMetadataProvider(topo_4brokers(parts))
+    return SimulatedClusterAdmin(meta, link_rate_bytes_per_s=200.0)
+
+
+def test_planner_concurrency_and_fairness():
+    pl = ExecutionTaskPlanner()
+    props = [proposal(0, i, [0, 1], [0, 2], data=10.0 * (i + 1)) for i in range(6)]
+    pl.add_execution_proposals(props)
+    # broker 1 (drop) and 2 (add) involved in every move; cap 2 each
+    tasks = pl.get_inter_broker_replica_movement_tasks({0: 5, 1: 2, 2: 2, 3: 5}, set())
+    assert len(tasks) == 2
+    assert len(pl.remaining_inter_broker_moves) == 4
+    # in-progress partitions are excluded
+    tasks2 = pl.get_inter_broker_replica_movement_tasks(
+        {1: 5, 2: 5}, {(0, tasks[0].proposal.partition)}
+    )
+    assert all(t.proposal.partition != tasks[0].proposal.partition for t in tasks2)
+
+
+def test_strategy_ordering():
+    props = [proposal(0, i, [0], [1], data=d) for i, d in enumerate([50.0, 200.0, 100.0])]
+    pl = ExecutionTaskPlanner(PrioritizeLargeReplicaMovementStrategy())
+    pl.add_execution_proposals(props)
+    sizes = [t.proposal.inter_broker_data_to_move for t in pl.remaining_inter_broker_moves]
+    assert sizes == sorted(sizes, reverse=True)
+    pl2 = ExecutionTaskPlanner(PrioritizeSmallReplicaMovementStrategy())
+    pl2.add_execution_proposals(props)
+    sizes2 = [t.proposal.inter_broker_data_to_move for t in pl2.remaining_inter_broker_moves]
+    assert sizes2 == sorted(sizes2)
+
+
+def test_execute_replica_and_leader_moves(sim):
+    ex = Executor(sim, topic_names={0: "T0", 1: "T1"})
+    props = [
+        proposal(0, 0, [0, 1], [2, 1], old_leader=0, new_leader=2, data=100.0),
+        proposal(1, 0, [2, 3], [2, 3], old_leader=2, new_leader=3, data=0.0),  # leader only
+    ]
+    res = ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=0.5))
+    # proposal 0 emits a replica task + a leadership task; proposal 1 one task
+    assert res.completed == 3 and res.dead == 0
+    topo = sim.topology()
+    by_key = {(p.topic, p.partition): p for p in topo.partitions}
+    assert set(by_key[("T0", 0)].replicas) == {1, 2}
+    assert by_key[("T0", 0)].leader == 2
+    assert by_key[("T1", 0)].leader == 3
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
+    assert sim.election_calls >= 1
+
+
+def test_throttle_set_and_cleared(sim):
+    observed = []
+    orig_tick = sim.tick
+
+    def spy_tick(seconds):
+        observed.append(sim.throttle_rate)
+        return orig_tick(seconds)
+
+    sim.tick = spy_tick
+    ex = Executor(sim, topic_names={0: "T0"})
+    props = [proposal(0, 0, [0, 1], [2, 1], data=500.0)]
+    ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            replication_throttle_bytes_per_s=100.0, progress_check_interval_s=1.0
+        ),
+    )
+    assert observed and all(r == 100.0 for r in observed)
+    assert sim.throttle_rate is None  # cleared afterwards
+    # throttled rate (100/s) on 500 bytes -> at least 5 ticks
+    assert len(observed) >= 5
+
+
+def test_per_broker_concurrency_cap(sim):
+    # all proposals touch broker 0 -> cap 1 means strictly serial execution
+    parts = [PartitionInfo("T0", i, leader=0, replicas=(0, 1)) for i in range(4)]
+    meta = StaticMetadataProvider(topo_4brokers(parts))
+    admin = SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1000.0)
+    max_concurrent = []
+    orig = admin.tick
+
+    def spy(seconds):
+        max_concurrent.append(len(admin.in_progress_reassignments()))
+        return orig(seconds)
+
+    admin.tick = spy
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = [proposal(0, i, [0, 1], [2, 1], data=1000.0) for i in range(4)]
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_partition_movements_per_broker=1, progress_check_interval_s=1.0
+        ),
+    )
+    # 4 replica tasks + 4 leadership tasks (leader 0 left the replica set)
+    assert res.completed == 8
+    assert max(max_concurrent) == 1
+
+
+def test_force_stop_aborts(sim):
+    ex = Executor(sim, topic_names={0: "T0"})
+    orig = sim.tick
+    calls = []
+
+    def stop_after_2(seconds):
+        calls.append(1)
+        if len(calls) == 2:
+            ex.stop_execution(force=True)
+        return orig(seconds)
+
+    sim.tick = stop_after_2
+    props = [proposal(0, i, [0, 1], [2, 1], data=10_000.0) for i in range(2)]
+    res = ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=1.0))
+    assert res.stopped
+    assert res.aborted >= 1
+    assert sim.in_progress_reassignments() == set()
+
+
+def test_dead_destination_marks_task_dead(sim):
+    ex = Executor(sim, topic_names={0: "T0"})
+    orig = sim.tick
+    calls = []
+
+    def kill_broker_2(seconds):
+        calls.append(1)
+        if len(calls) == 1:
+            topo = sim.metadata.topology()
+            brokers = tuple(
+                dataclasses.replace(b, alive=(b.broker_id != 2)) for b in topo.brokers
+            )
+            sim.metadata.set_topology(dataclasses.replace(topo, brokers=brokers))
+        return orig(seconds)
+
+    sim.tick = kill_broker_2
+    props = [proposal(0, 0, [0, 1], [2, 1], data=100_000.0)]
+    res = ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=1.0))
+    assert res.dead == 1
+
+
+def test_ongoing_execution_guard(sim):
+    ex = Executor(sim)
+    ex.state = ExecutorState.STARTING_EXECUTION
+    with pytest.raises(OngoingExecutionError):
+        ex.execute_proposals([proposal(0, 0, [0], [1])])
+
+
+def test_optimizer_to_executor_full_loop():
+    """Monitor-model -> optimizer -> executor -> topology reflects proposals
+    (the SURVEY §3.3 rebalance stack minus HTTP)."""
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+    from cruise_control_tpu.monitor import (
+        FixedCapacityResolver,
+        LoadMonitor,
+        MetricFetcherManager,
+        ModelCompletenessRequirements,
+        StaticMetadataProvider as SMP,
+        WindowedMetricSampleAggregator,
+        KAFKA_METRIC_DEF,
+    )
+    from cruise_control_tpu.testing.synthetic import (
+        SyntheticWorkloadSampler,
+        WorkloadSpec,
+        synthetic_topology,
+    )
+
+    topo = synthetic_topology(num_brokers=5, topics={"T0": 10, "T1": 10}, seed=5)
+    meta = SMP(topo)
+    sampler = SyntheticWorkloadSampler(topo, WorkloadSpec(), seed=5)
+    agg = WindowedMetricSampleAggregator(3, 1000, 1, KAFKA_METRIC_DEF)
+    fetcher = MetricFetcherManager(sampler, agg, None)
+    parts = sampler.all_partition_entities()
+    for w in range(4):
+        fetcher.fetch_once(parts, w * 1000, (w + 1) * 1000 - 1)
+    monitor = LoadMonitor(meta, FixedCapacityResolver([100.0, 1e5, 1e5, 1e6]), agg)
+    state = monitor.cluster_model(ModelCompletenessRequirements(min_required_num_windows=2))
+
+    cfg = OptimizerConfig(
+        num_candidates=128, leadership_candidates=32, steps_per_round=16, num_rounds=2
+    )
+    res = GoalOptimizer(config=cfg).optimize(state)
+    if not res.proposals:
+        pytest.skip("optimizer found nothing to move on this fixture")
+
+    admin = SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1e12)
+    ex = Executor(admin, catalog=monitor.last_catalog)
+    out = ex.execute_proposals(res.proposals, ExecutionOptions(progress_check_interval_s=1.0))
+    assert out.dead == 0 and out.completed > 0
+
+    # post-execution topology must match the optimizer's target placement
+    after = meta.topology()
+    by_key = {(p.topic, p.partition): p for p in after.partitions}
+    for p in res.proposals:
+        got = by_key[monitor.last_catalog.partition_key(p.partition)]
+        assert set(got.replicas) == set(p.new_replicas)
+        if p.new_leader >= 0:
+            assert got.leader == p.new_leader
