@@ -1,0 +1,37 @@
+"""Detector layer: anomaly detection + self-healing dispatch.
+
+Reference: cruise-control/.../detector/ (AnomalyDetector.java, 5 detectors,
+notifier/).
+"""
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    SlowBrokers,
+    TopicPartitionSizeAnomaly,
+    TopicReplicationFactorAnomaly,
+)
+from cruise_control_tpu.detector.detector import (
+    AnomalyDetector,
+    AnomalyDetectorState,
+    AnomalyRecord,
+    SelfHealingActions,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    PartitionSizeAnomalyFinder,
+    SlowBrokerFinder,
+    TopicReplicationFactorAnomalyFinder,
+)
+from cruise_control_tpu.detector.notifier import (
+    Action,
+    AnomalyNotificationResult,
+    AnomalyNotifier,
+    NoopNotifier,
+    SelfHealingNotifier,
+)

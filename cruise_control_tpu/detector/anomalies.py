@@ -1,0 +1,125 @@
+"""Anomaly taxonomy.
+
+Reference: cruise-control-core detector/Anomaly.java + AnomalyType.java
+(SPI) and the main-module payloads: detector/GoalViolations.java,
+BrokerFailures.java, DiskFailures.java, SlowBrokers.java,
+TopicReplicationFactorAnomaly.java, TopicPartitionSizeAnomaly.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import time
+
+
+class AnomalyType(enum.Enum):
+    """Reference KafkaAnomalyType; priority order matters — lower value is
+    handled first (reference AnomalyDetector priority queue)."""
+
+    BROKER_FAILURE = 0
+    DISK_FAILURE = 1
+    METRIC_ANOMALY = 2
+    GOAL_VIOLATION = 3
+    TOPIC_ANOMALY = 4
+
+    @property
+    def priority(self) -> int:
+        return self.value
+
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Anomaly:
+    anomaly_type: AnomalyType
+    detected_ms: int = dataclasses.field(default_factory=lambda: int(time.time() * 1000))
+    anomaly_id: int = dataclasses.field(default_factory=lambda: next(_ids))
+    #: whether the fix path is expected to change anything
+    fixable: bool = True
+
+    def description(self) -> str:
+        return self.anomaly_type.name
+
+    def __lt__(self, other: "Anomaly") -> bool:
+        return (self.anomaly_type.priority, self.detected_ms) < (
+            other.anomaly_type.priority,
+            other.detected_ms,
+        )
+
+
+@dataclasses.dataclass
+class GoalViolations(Anomaly):
+    """Reference detector/GoalViolations.java — which goals are violated,
+    split by whether optimization could fix them."""
+
+    anomaly_type: AnomalyType = AnomalyType.GOAL_VIOLATION
+    fixable_violations: list[str] = dataclasses.field(default_factory=list)
+    unfixable_violations: list[str] = dataclasses.field(default_factory=list)
+
+    def description(self) -> str:
+        return (
+            f"GoalViolations(fixable={self.fixable_violations}, "
+            f"unfixable={self.unfixable_violations})"
+        )
+
+
+@dataclasses.dataclass
+class BrokerFailures(Anomaly):
+    """Reference detector/BrokerFailures.java."""
+
+    anomaly_type: AnomalyType = AnomalyType.BROKER_FAILURE
+    failed_brokers: dict[int, int] = dataclasses.field(default_factory=dict)  # id -> failed_ms
+
+    def description(self) -> str:
+        return f"BrokerFailures({sorted(self.failed_brokers)})"
+
+
+@dataclasses.dataclass
+class DiskFailures(Anomaly):
+    """Reference detector/DiskFailures.java — (broker -> offline logdirs)."""
+
+    anomaly_type: AnomalyType = AnomalyType.DISK_FAILURE
+    failed_disks: dict[int, list[str]] = dataclasses.field(default_factory=dict)
+
+    def description(self) -> str:
+        return f"DiskFailures({self.failed_disks})"
+
+
+@dataclasses.dataclass
+class SlowBrokers(Anomaly):
+    """Reference detector/SlowBrokers.java (a MetricAnomaly flavor)."""
+
+    anomaly_type: AnomalyType = AnomalyType.METRIC_ANOMALY
+    slow_brokers: dict[int, float] = dataclasses.field(default_factory=dict)  # id -> severity
+    #: remove (true) vs demote (false) — reference SlowBrokerFinder config
+    remove_slow_brokers: bool = False
+
+    def description(self) -> str:
+        return f"SlowBrokers({self.slow_brokers}, remove={self.remove_slow_brokers})"
+
+
+@dataclasses.dataclass
+class TopicReplicationFactorAnomaly(Anomaly):
+    """Reference detector/TopicReplicationFactorAnomaly.java."""
+
+    anomaly_type: AnomalyType = AnomalyType.TOPIC_ANOMALY
+    bad_topics: dict[str, int] = dataclasses.field(default_factory=dict)  # topic -> observed RF
+    target_rf: int = 2
+
+    def description(self) -> str:
+        return f"TopicReplicationFactorAnomaly({self.bad_topics} -> rf={self.target_rf})"
+
+
+@dataclasses.dataclass
+class TopicPartitionSizeAnomaly(Anomaly):
+    """Reference detector/TopicPartitionSizeAnomaly.java."""
+
+    anomaly_type: AnomalyType = AnomalyType.TOPIC_ANOMALY
+    oversized: dict[tuple[str, int], float] = dataclasses.field(default_factory=dict)
+    fixable: bool = False  # reference: self-healing not supported for this one
+
+    def description(self) -> str:
+        return f"TopicPartitionSizeAnomaly({len(self.oversized)} partitions)"

@@ -1,0 +1,253 @@
+"""AnomalyDetector — priority queue + handler dispatch + state tracking.
+
+Reference: detector/AnomalyDetector.java:47 (detectors wired :63-68,
+startDetection():189, AnomalyHandlerTask:318 FIX/CHECK/IGNORE dispatch,
+skip-and-backoff while the executor is busy), AnomalyDetectorState.java
+(rolling per-type history, rates), AnomalyMetrics.java
+(mean-time-between-anomalies, self-healing-enabled ratio).
+
+Self-healing fixes dispatch through the SelfHealingActions protocol —
+implemented by the service facade: goal violation -> rebalance, broker
+failure -> remove_brokers, disk failure -> fix_offline_replicas, slow
+brokers -> demote/remove (reference RebalanceRunnable/RemoveBrokersRunnable/
+FixOfflineReplicasRunnable/DemoteBrokerRunnable self-healing constructors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Protocol
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    SlowBrokers,
+    TopicReplicationFactorAnomaly,
+)
+from cruise_control_tpu.detector.notifier import Action, AnomalyNotifier
+
+
+class SelfHealingActions(Protocol):
+    """Fix entry points the service facade provides."""
+
+    def rebalance(self, reason: str) -> bool:
+        ...
+
+    def remove_brokers(self, broker_ids: list[int], reason: str) -> bool:
+        ...
+
+    def demote_brokers(self, broker_ids: list[int], reason: str) -> bool:
+        ...
+
+    def fix_offline_replicas(self, reason: str) -> bool:
+        ...
+
+    def fix_topic_replication_factor(self, topics: dict[str, int], target_rf: int, reason: str) -> bool:
+        ...
+
+    @property
+    def is_busy(self) -> bool:
+        ...
+
+
+@dataclasses.dataclass
+class AnomalyRecord:
+    anomaly: Anomaly
+    status: str  # DETECTED / IGNORED / CHECKED / FIX_STARTED / FIX_FAILED_TO_START
+    handled_ms: int
+
+
+class AnomalyDetectorState:
+    """Rolling anomaly history + self-healing metrics
+    (reference detector/AnomalyDetectorState.java, AnomalyMetrics.java)."""
+
+    def __init__(self, history_size: int = 50):
+        self.recent: dict[AnomalyType, deque[AnomalyRecord]] = {
+            t: deque(maxlen=history_size) for t in AnomalyType
+        }
+        self.ignored = 0
+        self.fixed = 0
+        self._detection_times: dict[AnomalyType, list[int]] = {t: [] for t in AnomalyType}
+
+    def record(self, anomaly: Anomaly, status: str, now_ms: int):
+        self.recent[anomaly.anomaly_type].append(AnomalyRecord(anomaly, status, now_ms))
+        self._detection_times[anomaly.anomaly_type].append(now_ms)
+        if status == "IGNORED":
+            self.ignored += 1
+        if status == "FIX_STARTED":
+            self.fixed += 1
+
+    def mean_time_between_anomalies_ms(self, anomaly_type: AnomalyType) -> float:
+        """Reference MeanTimeBetweenAnomaliesMs."""
+        times = self._detection_times[anomaly_type]
+        if len(times) < 2:
+            return 0.0
+        return (times[-1] - times[0]) / (len(times) - 1)
+
+    def to_json(self, notifier: AnomalyNotifier) -> dict:
+        healing = notifier.self_healing_enabled()
+        return {
+            "selfHealingEnabled": [t.name for t, on in healing.items() if on],
+            "selfHealingDisabled": [t.name for t, on in healing.items() if not on],
+            "recentAnomalies": {
+                t.name: [
+                    {
+                        "description": r.anomaly.description(),
+                        "status": r.status,
+                        "detectionMs": r.anomaly.detected_ms,
+                    }
+                    for r in self.recent[t]
+                ]
+                for t in AnomalyType
+            },
+            "meanTimeBetweenAnomaliesMs": {
+                t.name: self.mean_time_between_anomalies_ms(t) for t in AnomalyType
+            },
+            "numSelfHealingStarted": self.fixed,
+            "numIgnored": self.ignored,
+        }
+
+
+class AnomalyDetector:
+    """Queue + dispatch (reference AnomalyDetector.java:47).
+
+    Synchronous mode: call `register_detector(...)` then `run_once()` per
+    detection round (deterministic for tests and for the service's
+    scheduler).  `start(interval)` runs rounds on a daemon thread like the
+    reference's scheduled executor.
+    """
+
+    def __init__(
+        self,
+        notifier: AnomalyNotifier,
+        actions: SelfHealingActions,
+        *,
+        now_ms=None,
+    ):
+        self.notifier = notifier
+        self.actions = actions
+        self.state = AnomalyDetectorState()
+        self._queue: list[tuple[int, int, Anomaly]] = []  # (priority, seq, anomaly)
+        self._seq = 0
+        self._detectors: list = []
+        self._now = now_ms or (lambda: int(time.time() * 1000))
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: re-check delays scheduled by CHECK actions: (due_ms, anomaly)
+        self._delayed: list[tuple[int, int, Anomaly]] = []
+
+    def register_detector(self, detect_fn):
+        """detect_fn() -> Anomaly | None (bound method of a detector)."""
+        self._detectors.append(detect_fn)
+
+    def add_anomaly(self, anomaly: Anomaly):
+        with self._lock:
+            heapq.heappush(
+                self._queue, (anomaly.anomaly_type.priority, self._seq, anomaly)
+            )
+            self._seq += 1
+
+    # ------------------------------------------------------------------
+
+    def run_once(self) -> list[AnomalyRecord]:
+        """One detection + handling round."""
+        now = self._now()
+        with self._lock:
+            # re-enqueue due delayed checks
+            due = [x for x in self._delayed if x[0] <= now]
+            self._delayed = [x for x in self._delayed if x[0] > now]
+            for _, _, anomaly in due:
+                self.add_anomaly(anomaly)
+        for detect in self._detectors:
+            try:
+                anomaly = detect()
+            except Exception:  # noqa: BLE001 — a broken detector must not stop the loop
+                continue
+            if anomaly is not None:
+                self.add_anomaly(anomaly)
+        return self._drain()
+
+    def _drain(self) -> list[AnomalyRecord]:
+        handled = []
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                _, _, anomaly = heapq.heappop(self._queue)
+            handled.append(self._handle(anomaly))
+        return handled
+
+    def _handle(self, anomaly: Anomaly) -> AnomalyRecord:
+        """Reference AnomalyHandlerTask:318."""
+        now = self._now()
+        if self.actions.is_busy:
+            # executor busy: re-check later (reference handleAnomalyInProgress)
+            with self._lock:
+                self._delayed.append((now + 30_000, self._seq, anomaly))
+                self._seq += 1
+            rec = AnomalyRecord(anomaly, "CHECKED", now)
+            self.state.record(anomaly, "CHECKED", now)
+            return rec
+        result = self.notifier.on_anomaly(anomaly)
+        if result.action == Action.IGNORE:
+            status = "IGNORED"
+        elif result.action == Action.CHECK:
+            with self._lock:
+                self._delayed.append((now + result.delay_ms, self._seq, anomaly))
+                self._seq += 1
+            status = "CHECKED"
+        else:
+            started = self._fix(anomaly)
+            status = "FIX_STARTED" if started else "FIX_FAILED_TO_START"
+        self.state.record(anomaly, status, now)
+        return AnomalyRecord(anomaly, status, now)
+
+    def _fix(self, anomaly: Anomaly) -> bool:
+        a = self.actions
+        try:
+            if isinstance(anomaly, GoalViolations):
+                return a.rebalance(reason=anomaly.description())
+            if isinstance(anomaly, BrokerFailures):
+                return a.remove_brokers(
+                    sorted(anomaly.failed_brokers), reason=anomaly.description()
+                )
+            if isinstance(anomaly, DiskFailures):
+                return a.fix_offline_replicas(reason=anomaly.description())
+            if isinstance(anomaly, SlowBrokers):
+                ids = sorted(anomaly.slow_brokers)
+                if anomaly.remove_slow_brokers:
+                    return a.remove_brokers(ids, reason=anomaly.description())
+                return a.demote_brokers(ids, reason=anomaly.description())
+            if isinstance(anomaly, TopicReplicationFactorAnomaly):
+                return a.fix_topic_replication_factor(
+                    anomaly.bad_topics, anomaly.target_rf, reason=anomaly.description()
+                )
+        except Exception:  # noqa: BLE001 — fix failure is recorded, not fatal
+            return False
+        return False
+
+    # ------------------------------------------------------------------
+
+    def start(self, interval_s: float = 30.0):
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.run_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="anomaly-detector")
+        self._thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def detector_state(self) -> dict:
+        return self.state.to_json(self.notifier)

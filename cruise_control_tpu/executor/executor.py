@@ -301,13 +301,22 @@ class Executor:
 
     def _ready_brokers(self, options: ExecutionOptions, in_flight) -> dict[int, int]:
         cap = options.concurrent_partition_movements_per_broker
-        alive = self.admin.topology().alive_broker_ids()
+        topo = self.admin.topology()
+        alive = topo.alive_broker_ids()
         used: dict[int, int] = {}
         for task in in_flight.values():
             p = task.proposal
             for b in set(p.old_replicas) ^ set(p.new_replicas):
                 used[b] = used.get(b, 0) + 1
-        return {b: max(0, cap - used.get(b, 0)) for b in alive}
+        ready = {b: max(0, cap - used.get(b, 0)) for b in alive}
+        # dead brokers do no replication work: moves off them are only
+        # bounded by the destination's slots (replicas rebuild from alive
+        # leaders — reference executes dead-broker evacuation uncapped on
+        # the failed side)
+        for b in topo.broker_ids():
+            if b not in alive:
+                ready[b] = 1_000_000
+        return ready
 
     def _partition_key(self, proposal: ExecutionProposal) -> tuple[str, int]:
         """(topic name, partition number) for a proposal: the catalog maps
