@@ -1,3 +1,18 @@
-from cruise_control_tpu.config.balancing import DEFAULT_CONSTRAINT, BalancingConstraint
+"""Config layer: typed ConfigDef + domain-grouped application config.
 
-__all__ = ["DEFAULT_CONSTRAINT", "BalancingConstraint"]
+Reference: cruise-control-core common/config/ + config/KafkaCruiseControlConfig.java.
+"""
+
+from cruise_control_tpu.config.app_config import (
+    CruiseControlConfig,
+    cruise_control_config_def,
+    load_properties,
+)
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.config.config_def import (
+    AbstractConfig,
+    ConfigDef,
+    ConfigException,
+    ConfigType,
+    Importance,
+)

@@ -1,0 +1,253 @@
+"""Application configuration — domain-grouped, reference-compatible keys.
+
+Reference: config/KafkaCruiseControlConfig.java:38 (chained define across
+domain constant classes) with the domain groups AnalyzerConfig.java,
+MonitorConfig.java, ExecutorConfig.java, AnomalyDetectorConfig.java,
+WebServerConfig.java.  Key names match the reference's where the concept
+carries over, so existing cruisecontrol.properties files remain readable;
+TPU-specific knobs (candidate batch etc.) are new keys under the
+`analyzer.tpu` group.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from cruise_control_tpu.analyzer.engine import OptimizerConfig
+from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER, GOALS_BY_NAME
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.config.config_def import (
+    AbstractConfig,
+    ConfigDef,
+    ConfigException,
+    ConfigType as T,
+    Importance as I,
+    in_range,
+)
+
+_HARD_GOALS_DEFAULT = (
+    "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,NetworkInboundCapacityGoal,"
+    "NetworkOutboundCapacityGoal,CpuCapacityGoal"
+)
+
+
+def _analyzer_defs() -> ConfigDef:
+    """Reference config/constants/AnalyzerConfig.java."""
+    d = ConfigDef()
+    g = "analyzer"
+    d.define("default.goals", T.LIST, ",".join(DEFAULT_GOAL_ORDER), I.HIGH,
+             "goal names in priority order", group=g)
+    d.define("hard.goals", T.LIST, _HARD_GOALS_DEFAULT, I.HIGH, "hard goal subset", group=g)
+    for res in ("cpu", "disk", "network.inbound", "network.outbound"):
+        d.define(f"{res}.balance.threshold", T.DOUBLE, 1.10, I.MEDIUM,
+                 f"balance band multiplier for {res}", in_range(lo=1.0), group=g)
+        d.define(f"{res}.capacity.threshold", T.DOUBLE, 0.8, I.MEDIUM,
+                 f"usable capacity fraction for {res}", in_range(lo=0.0, hi=1.0), group=g)
+        d.define(f"{res}.low.utilization.threshold", T.DOUBLE, 0.0, I.LOW,
+                 f"below this the {res} balance is ignored", group=g)
+    d.define("replica.count.balance.threshold", T.DOUBLE, 1.10, I.MEDIUM,
+             "replica count band multiplier", in_range(lo=1.0), group=g)
+    d.define("leader.replica.count.balance.threshold", T.DOUBLE, 1.10, I.MEDIUM,
+             "leader count band multiplier", in_range(lo=1.0), group=g)
+    d.define("topic.replica.count.balance.threshold", T.DOUBLE, 3.0, I.LOW,
+             "per-topic replica band multiplier", in_range(lo=1.0), group=g)
+    d.define("max.replicas.per.broker", T.LONG, 10_000, I.MEDIUM,
+             "replica capacity per broker", in_range(lo=1), group=g)
+    d.define("proposal.expiration.ms", T.LONG, 900_000, I.MEDIUM,
+             "cached proposal validity", in_range(lo=0), group=g)
+    d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0, I.LOW,
+             "slack multiplier for violation detection", in_range(lo=1.0), group=g)
+    d.define("num.proposal.precompute.threads", T.INT, 1, I.LOW,
+             "proposal precompute workers", in_range(lo=0), group=g)
+    # --- TPU optimizer knobs (new in this framework) ---
+    g = "analyzer.tpu"
+    d.define("tpu.num.candidates", T.INT, 2048, I.MEDIUM,
+             "candidate moves evaluated per optimization step", in_range(lo=16), group=g)
+    d.define("tpu.leadership.candidates", T.INT, 512, I.MEDIUM,
+             "of which leadership transfers", in_range(lo=0), group=g)
+    d.define("tpu.steps.per.round", T.INT, 64, I.MEDIUM, "scan length per round",
+             in_range(lo=1), group=g)
+    d.define("tpu.num.rounds", T.INT, 10, I.MEDIUM, "annealing rounds", in_range(lo=1), group=g)
+    d.define("tpu.init.temperature.scale", T.DOUBLE, 1e-2, I.LOW,
+             "T0 as fraction of initial objective", group=g)
+    d.define("tpu.temperature.decay", T.DOUBLE, 0.5, I.LOW, "per-round decay", group=g)
+    return d
+
+
+def _monitor_defs() -> ConfigDef:
+    """Reference config/constants/MonitorConfig.java."""
+    d = ConfigDef()
+    g = "monitor"
+    d.define("num.partition.metrics.windows", T.INT, 5, I.HIGH,
+             "windows kept for partition metrics", in_range(lo=1), group=g)
+    d.define("partition.metrics.window.ms", T.LONG, 3_600_000, I.HIGH,
+             "partition metric window span", in_range(lo=1), group=g)
+    d.define("min.samples.per.partition.metrics.window", T.INT, 3, I.MEDIUM,
+             "samples for a window to avoid extrapolation", in_range(lo=1), group=g)
+    d.define("num.broker.metrics.windows", T.INT, 20, I.MEDIUM, "broker windows",
+             in_range(lo=1), group=g)
+    d.define("broker.metrics.window.ms", T.LONG, 300_000, I.MEDIUM, "broker window span",
+             in_range(lo=1), group=g)
+    d.define("min.samples.per.broker.metrics.window", T.INT, 1, I.LOW, "",
+             in_range(lo=1), group=g)
+    d.define("metric.sampling.interval.ms", T.LONG, 120_000, I.MEDIUM, "sampler cadence",
+             in_range(lo=1), group=g)
+    d.define("min.valid.partition.ratio", T.DOUBLE, 0.95, I.MEDIUM,
+             "monitored partition ratio gate", in_range(lo=0.0, hi=1.0), group=g)
+    d.define("metric.sampler.class", T.CLASS,
+             "cruise_control_tpu.testing.synthetic.SyntheticWorkloadSampler", I.HIGH,
+             "MetricSampler plugin", group=g)
+    d.define("sample.store.class", T.CLASS,
+             "cruise_control_tpu.monitor.sampling.NoopSampleStore", I.MEDIUM,
+             "SampleStore plugin", group=g)
+    d.define("capacity.config.file", T.STRING, None, I.MEDIUM,
+             "broker capacity JSON (reference config/capacity.json schema)", group=g)
+    d.define("max.allowed.extrapolations.per.partition", T.INT, 5, I.LOW, "", group=g)
+    return d
+
+
+def _executor_defs() -> ConfigDef:
+    """Reference config/constants/ExecutorConfig.java."""
+    d = ConfigDef()
+    g = "executor"
+    d.define("num.concurrent.partition.movements.per.broker", T.INT, 5, I.HIGH,
+             "inter-broker move cap per broker", in_range(lo=1), group=g)
+    d.define("num.concurrent.intra.broker.partition.movements", T.INT, 2, I.MEDIUM,
+             "intra-broker move cap per broker", in_range(lo=1), group=g)
+    d.define("num.concurrent.leader.movements", T.INT, 1000, I.MEDIUM,
+             "cluster-wide leadership batch", in_range(lo=1), group=g)
+    d.define("default.replication.throttle", T.LONG, None, I.MEDIUM,
+             "bytes/s replication throttle during execution", group=g)
+    d.define("execution.progress.check.interval.ms", T.LONG, 10_000, I.MEDIUM,
+             "progress poll cadence", in_range(lo=1), group=g)
+    d.define("task.execution.alerting.threshold.ms", T.LONG, 90_000, I.LOW,
+             "slow-task alert threshold", in_range(lo=1), group=g)
+    d.define("default.replica.movement.strategies", T.LIST,
+             "BaseReplicaMovementStrategy", I.LOW, "ordered strategy chain", group=g)
+    return d
+
+
+def _anomaly_defs() -> ConfigDef:
+    """Reference config/constants/AnomalyDetectorConfig.java."""
+    d = ConfigDef()
+    g = "anomaly.detector"
+    d.define("anomaly.detection.interval.ms", T.LONG, 300_000, I.MEDIUM,
+             "detector cadence", in_range(lo=1), group=g)
+    d.define("anomaly.notifier.class", T.CLASS,
+             "cruise_control_tpu.detector.notifier.SelfHealingNotifier", I.MEDIUM,
+             "AnomalyNotifier plugin", group=g)
+    for t in ("broker.failure", "goal.violation", "disk.failure", "metric.anomaly",
+              "topic.anomaly"):
+        d.define(f"self.healing.{t}.enabled", T.BOOLEAN, False, I.MEDIUM,
+                 f"auto-fix {t} anomalies", group=g)
+    d.define("broker.failure.alert.threshold.ms", T.LONG, 900_000, I.MEDIUM, "", group=g)
+    d.define("broker.failure.self.healing.threshold.ms", T.LONG, 1_800_000, I.MEDIUM,
+             "", group=g)
+    d.define("slow.broker.removal.enabled", T.BOOLEAN, False, I.LOW, "", group=g)
+    d.define("topic.anomaly.target.replication.factor", T.INT, 2, I.LOW, "", group=g)
+    return d
+
+
+def _webserver_defs() -> ConfigDef:
+    """Reference config/constants/WebServerConfig.java + UserTaskManagerConfig."""
+    d = ConfigDef()
+    g = "webserver"
+    d.define("webserver.http.port", T.INT, 9090, I.HIGH, "REST port",
+             in_range(lo=0, hi=65535), group=g)
+    d.define("webserver.http.address", T.STRING, "127.0.0.1", I.MEDIUM, "bind address", group=g)
+    d.define("webserver.api.urlprefix", T.STRING, "/kafkacruisecontrol", I.LOW, "", group=g)
+    d.define("webserver.session.maxExpiryPeriodMs", T.LONG, 3_600_000, I.LOW, "", group=g)
+    d.define("max.cached.completed.user.tasks", T.INT, 100, I.LOW, "", group=g)
+    d.define("completed.user.task.retention.time.ms", T.LONG, 86_400_000, I.LOW, "", group=g)
+    d.define("webserver.security.enable", T.BOOLEAN, False, I.MEDIUM, "", group=g)
+    d.define("basic.auth.credentials.file", T.STRING, None, I.MEDIUM,
+             "htpasswd-style user:password[:role] lines", group=g)
+    d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
+             "POSTs park in the review purgatory first", group=g)
+    return d
+
+
+def cruise_control_config_def() -> ConfigDef:
+    return (
+        _analyzer_defs()
+        .merge(_monitor_defs())
+        .merge(_executor_defs())
+        .merge(_anomaly_defs())
+        .merge(_webserver_defs())
+    )
+
+
+class CruiseControlConfig(AbstractConfig):
+    """Reference config/KafkaCruiseControlConfig.java:38 + goal-name sanity
+    checks (:106-120)."""
+
+    def __init__(self, props: dict[str, Any] | None = None):
+        super().__init__(cruise_control_config_def(), props or {})
+        self._sanity_check_goals()
+
+    def _sanity_check_goals(self):
+        goals = self.get("default.goals")
+        hard = set(self.get("hard.goals"))
+        unknown = [g for g in goals if g not in GOALS_BY_NAME]
+        if unknown:
+            raise ConfigException(f"unknown goals in default.goals: {unknown}")
+        unknown_hard = [g for g in hard if g not in GOALS_BY_NAME]
+        if unknown_hard:
+            raise ConfigException(f"unknown goals in hard.goals: {unknown_hard}")
+        if not goals:
+            raise ConfigException("default.goals must not be empty")
+
+    def balancing_constraint(self) -> BalancingConstraint:
+        g = self.get
+        return BalancingConstraint(
+            balance_threshold=(
+                g("cpu.balance.threshold"),
+                g("network.inbound.balance.threshold"),
+                g("network.outbound.balance.threshold"),
+                g("disk.balance.threshold"),
+            ),
+            capacity_threshold=(
+                g("cpu.capacity.threshold"),
+                g("network.inbound.capacity.threshold"),
+                g("network.outbound.capacity.threshold"),
+                g("disk.capacity.threshold"),
+            ),
+            low_utilization_threshold=(
+                g("cpu.low.utilization.threshold"),
+                g("network.inbound.low.utilization.threshold"),
+                g("network.outbound.low.utilization.threshold"),
+                g("disk.low.utilization.threshold"),
+            ),
+            replica_count_balance_threshold=g("replica.count.balance.threshold"),
+            leader_replica_count_balance_threshold=g("leader.replica.count.balance.threshold"),
+            topic_replica_count_balance_threshold=g("topic.replica.count.balance.threshold"),
+            max_replicas_per_broker=g("max.replicas.per.broker"),
+            goal_violation_distribution_threshold_multiplier=g(
+                "goal.violation.distribution.threshold.multiplier"
+            ),
+        )
+
+    def optimizer_config(self) -> OptimizerConfig:
+        g = self.get
+        return OptimizerConfig(
+            num_candidates=g("tpu.num.candidates"),
+            leadership_candidates=g("tpu.leadership.candidates"),
+            steps_per_round=g("tpu.steps.per.round"),
+            num_rounds=g("tpu.num.rounds"),
+            init_temperature_scale=g("tpu.init.temperature.scale"),
+            temperature_decay=g("tpu.temperature.decay"),
+        )
+
+
+def load_properties(path: str) -> dict[str, str]:
+    """Java-style .properties loader (reference reads cruisecontrol.properties)."""
+    props: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            if "=" in line:
+                k, _, v = line.partition("=")
+                props[k.strip()] = v.strip()
+    return props

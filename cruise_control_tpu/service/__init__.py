@@ -1,0 +1,14 @@
+"""Service layer: facade, REST API, user tasks, progress, purgatory.
+
+Reference: KafkaCruiseControl.java + servlet/ + async/.
+"""
+
+from cruise_control_tpu.service.facade import CruiseControl, SelfHealingAdapter
+from cruise_control_tpu.service.progress import OperationProgress
+from cruise_control_tpu.service.purgatory import Purgatory, ReviewStatus
+from cruise_control_tpu.service.server import (
+    GET_ENDPOINTS,
+    POST_ENDPOINTS,
+    CruiseControlApp,
+)
+from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTask, UserTaskManager

@@ -1,0 +1,590 @@
+"""CruiseControl service facade — wires monitor, analyzer, executor, detector.
+
+Reference: KafkaCruiseControl.java:100-117 (construction wires the four
+subsystems), startUp():162 (start monitor + detection + proposal
+precompute), optimizations():493, executeProposals():546, and the
+operation runnables (servlet/handler/async/runnable/): RebalanceRunnable,
+AddBrokersRunnable, RemoveBrokersRunnable, DemoteBrokerRunnable,
+FixOfflineReplicasRunnable, UpdateTopicConfigurationRunnable.
+
+Also implements the detector's SelfHealingActions so anomaly fixes run
+through the exact same paths user requests do (reference GoalViolations
+fix == RebalanceRunnable self-healing constructor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import (
+    GoalChain,
+    GoalOptimizer,
+    OptimizationOptions,
+    OptimizerConfig,
+    OptimizerResult,
+)
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.config.app_config import CruiseControlConfig
+from cruise_control_tpu.detector import (
+    AnomalyDetector,
+    AnomalyType,
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    SelfHealingNotifier,
+    TopicReplicationFactorAnomalyFinder,
+)
+from cruise_control_tpu.executor import ExecutionOptions, Executor, OngoingExecutionError
+from cruise_control_tpu.executor.admin import ClusterAdmin
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.monitor import (
+    LoadMonitor,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.service.progress import (
+    BatchedOptimization,
+    ExecutingProposals,
+    GeneratingClusterModel,
+    OperationProgress,
+    WaitingForClusterModel,
+)
+
+
+@dataclasses.dataclass
+class _CachedResult:
+    result: OptimizerResult
+    computed_ms: int
+    model_generation: object
+
+
+class CruiseControl:
+    """The service facade (reference KafkaCruiseControl.java)."""
+
+    def __init__(
+        self,
+        config: CruiseControlConfig,
+        monitor: LoadMonitor,
+        admin: ClusterAdmin,
+        *,
+        chain: GoalChain | None = None,
+    ):
+        self.config = config
+        self.monitor = monitor
+        self.admin = admin
+        self.constraint = config.balancing_constraint()
+        self.chain = chain or GoalChain.from_names(config.get("default.goals"))
+        self.optimizer = GoalOptimizer(
+            chain=self.chain,
+            constraint=self.constraint,
+            config=config.optimizer_config(),
+        )
+        self.executor = Executor(admin)
+        self._cache: _CachedResult | None = None
+        self._cache_lock = threading.Lock()
+        self._proposal_expiration_ms = config.get("proposal.expiration.ms")
+        notifier = SelfHealingNotifier(
+            self_healing={
+                AnomalyType.BROKER_FAILURE: config.get("self.healing.broker.failure.enabled"),
+                AnomalyType.GOAL_VIOLATION: config.get("self.healing.goal.violation.enabled"),
+                AnomalyType.DISK_FAILURE: config.get("self.healing.disk.failure.enabled"),
+                AnomalyType.METRIC_ANOMALY: config.get("self.healing.metric.anomaly.enabled"),
+                AnomalyType.TOPIC_ANOMALY: config.get("self.healing.topic.anomaly.enabled"),
+            },
+            broker_failure_alert_threshold_ms=config.get("broker.failure.alert.threshold.ms"),
+            broker_failure_self_healing_threshold_ms=config.get(
+                "broker.failure.self.healing.threshold.ms"
+            ),
+        )
+        self.notifier = notifier
+        self.actions = SelfHealingAdapter(self)
+        self.anomaly_detector = AnomalyDetector(notifier, self.actions)
+        self._wire_detectors()
+        self._started_ms = int(time.time() * 1000)
+        self._precompute_thread: threading.Thread | None = None
+        self._stop_precompute = threading.Event()
+
+    def _wire_detectors(self):
+        """Reference AnomalyDetector.java:63-68 wiring."""
+        req = ModelCompletenessRequirements(min_required_num_windows=1)
+        gvd = GoalViolationDetector(
+            lambda: self.monitor.cluster_model(req), self.chain, self.constraint
+        )
+        bfd = BrokerFailureDetector(self.admin.topology)
+        dfd = DiskFailureDetector(self.admin.topology)
+        rfd = TopicReplicationFactorAnomalyFinder(
+            self.admin.topology,
+            target_rf=self.config.get("topic.anomaly.target.replication.factor"),
+        )
+        self.broker_failure_detector = bfd
+        self.anomaly_detector.register_detector(gvd.detect)
+        self.anomaly_detector.register_detector(bfd.detect)
+        self.anomaly_detector.register_detector(dfd.detect)
+        self.anomaly_detector.register_detector(rfd.detect)
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference startUp():162)
+    # ------------------------------------------------------------------
+
+    def start_up(self, *, detection_interval_s: float | None = None, precompute: bool = False):
+        self.monitor.start()
+        self.anomaly_detector.start(
+            detection_interval_s
+            or self.config.get("anomaly.detection.interval.ms") / 1000.0
+        )
+        if precompute:
+            self._precompute_thread = threading.Thread(
+                target=self._precompute_loop, daemon=True, name="proposal-precompute"
+            )
+            self._precompute_thread.start()
+
+    def shutdown(self):
+        self._stop_precompute.set()
+        self.anomaly_detector.shutdown()
+
+    def _precompute_loop(self):
+        """Reference GoalOptimizer.run precompute loop (GoalOptimizer.java:124-175)."""
+        while not self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
+            try:
+                self.proposals(OperationProgress(), ignore_cache=True)
+            except Exception:  # noqa: BLE001 — precompute failures surface on demand
+                pass
+
+    # ------------------------------------------------------------------
+    # proposal computation + cache (reference optimizations():276-324,493)
+    # ------------------------------------------------------------------
+
+    def _cluster_model(self, progress: OperationProgress) -> ClusterState:
+        progress.add_step(WaitingForClusterModel())
+        with self.monitor.acquire_for_model_generation():
+            progress.add_step(GeneratingClusterModel())
+            req = ModelCompletenessRequirements(
+                min_required_num_windows=1,
+                min_monitored_partitions_percentage=self.config.get(
+                    "min.valid.partition.ratio"
+                ),
+            )
+            return self.monitor.cluster_model(req)
+
+    def proposals(
+        self,
+        progress: OperationProgress,
+        *,
+        ignore_cache: bool = False,
+        options: OptimizationOptions | None = None,
+        goals: list[str] | None = None,
+    ) -> OptimizerResult:
+        """Cached unless options/goals are non-default
+        (reference ignoreProposalCache():469)."""
+        cacheable = options is None and goals is None
+        if cacheable and not ignore_cache:
+            cached = self._valid_cache()
+            if cached is not None:
+                return cached
+        state = self._cluster_model(progress)
+        optimizer = self.optimizer
+        if goals is not None:
+            optimizer = GoalOptimizer(
+                chain=GoalChain.from_names(goals),
+                constraint=self.constraint,
+                config=self.config.optimizer_config(),
+            )
+        progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
+        result = optimizer.optimize(state, options=options or OptimizationOptions())
+        if cacheable:
+            with self._cache_lock:
+                self._cache = _CachedResult(
+                    result, int(time.time() * 1000), self.monitor.model_generation()
+                )
+        return result
+
+    def _valid_cache(self) -> OptimizerResult | None:
+        with self._cache_lock:
+            c = self._cache
+            if c is None:
+                return None
+            expired = (
+                int(time.time() * 1000) - c.computed_ms > self._proposal_expiration_ms
+            )
+            stale = c.model_generation != self.monitor.model_generation()
+            if expired or stale:
+                self._cache = None
+                return None
+            return c.result
+
+    def invalidate_proposal_cache(self):
+        with self._cache_lock:
+            self._cache = None
+
+    # ------------------------------------------------------------------
+    # operations (reference servlet/handler/async/runnable/*)
+    # ------------------------------------------------------------------
+
+    def _execute(
+        self,
+        result: OptimizerResult,
+        progress: OperationProgress,
+        *,
+        removed: set[int] | None = None,
+        demoted: set[int] | None = None,
+        extra_proposals: list[ExecutionProposal] | None = None,
+    ) -> dict:
+        progress.add_step(ExecutingProposals())
+        proposals = list(result.proposals) + list(extra_proposals or [])
+        exec_options = ExecutionOptions(
+            concurrent_partition_movements_per_broker=self.config.get(
+                "num.concurrent.partition.movements.per.broker"
+            ),
+            concurrent_intra_broker_partition_movements=self.config.get(
+                "num.concurrent.intra.broker.partition.movements"
+            ),
+            concurrent_leader_movements=self.config.get("num.concurrent.leader.movements"),
+            replication_throttle_bytes_per_s=self.config.get("default.replication.throttle"),
+            progress_check_interval_s=self.config.get(
+                "execution.progress.check.interval.ms"
+            )
+            / 1000.0,
+        )
+        self.executor.catalog = self.monitor.last_catalog
+        out = self.executor.execute_proposals(
+            proposals, exec_options, removed_brokers=removed, demoted_brokers=demoted
+        )
+        self.invalidate_proposal_cache()
+        return {
+            "completed": out.completed,
+            "aborted": out.aborted,
+            "dead": out.dead,
+            "stopped": out.stopped,
+        }
+
+    def _build_options(
+        self,
+        state: ClusterState,
+        *,
+        destination_broker_ids: list[int] | None = None,
+        excluded_topics_pattern: str | None = None,
+    ) -> OptimizationOptions:
+        """Translate request parameters into array masks
+        (reference OptimizationOptions construction in RunnableUtils)."""
+        import re
+
+        dest = None
+        if destination_broker_ids:
+            dest = np.zeros(state.shape.B, bool)
+            dest[list(destination_broker_ids)] = True
+        excluded_topics = None
+        if excluded_topics_pattern and self.monitor.last_catalog is not None:
+            rx = re.compile(excluded_topics_pattern)
+            excluded_topics = np.array(
+                [bool(rx.fullmatch(t)) for t in self.monitor.last_catalog.topics], bool
+            )
+        return OptimizationOptions(
+            excluded_topics=excluded_topics,
+            requested_destination_brokers=dest,
+        )
+
+    def rebalance(
+        self,
+        progress: OperationProgress,
+        *,
+        dryrun: bool = True,
+        goals: list[str] | None = None,
+        destination_broker_ids: list[int] | None = None,
+        excluded_topics_pattern: str | None = None,
+    ) -> dict:
+        """Reference RebalanceRunnable.workWithoutClusterModel:116."""
+        custom = bool(destination_broker_ids or excluded_topics_pattern or goals)
+        if custom:
+            state = self._cluster_model(progress)
+            options = self._build_options(
+                state,
+                destination_broker_ids=destination_broker_ids,
+                excluded_topics_pattern=excluded_topics_pattern,
+            )
+            optimizer = self.optimizer
+            if goals is not None:
+                optimizer = GoalOptimizer(
+                    chain=GoalChain.from_names(goals),
+                    constraint=self.constraint,
+                    config=self.config.optimizer_config(),
+                )
+            progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
+            result = optimizer.optimize(state, options=options)
+        else:
+            result = self.proposals(progress)
+        out = result.summary()
+        out["proposals"] = [p.to_json() for p in result.proposals[:100]]
+        if not dryrun:
+            out["execution"] = self._execute(result, progress)
+        return out
+
+    def add_brokers(self, progress: OperationProgress, broker_ids: list[int], *,
+                    dryrun: bool = True) -> dict:
+        """Reference AddBrokersRunnable: only move replicas TO the new brokers."""
+        return self.rebalance(
+            progress, dryrun=dryrun, destination_broker_ids=broker_ids
+        )
+
+    def remove_brokers(self, progress: OperationProgress, broker_ids: list[int], *,
+                       dryrun: bool = True) -> dict:
+        """Reference RemoveBrokersRunnable: evacuate the given brokers."""
+        state = self._cluster_model(progress)
+        state = _mark_brokers_dead(state, broker_ids)
+        progress.add_step(BatchedOptimization(self.optimizer.config.num_rounds))
+        dest_mask = np.ones(state.shape.B, bool)
+        dest_mask[list(broker_ids)] = False
+        options = OptimizationOptions(
+            excluded_brokers_for_replica_move=~dest_mask,
+            excluded_brokers_for_leadership=~dest_mask,
+        )
+        result = self.optimizer.optimize(state, options=options)
+        out = result.summary()
+        if not dryrun:
+            out["execution"] = self._execute(
+                result, progress, removed=set(broker_ids)
+            )
+        return out
+
+    def demote_brokers(self, progress: OperationProgress, broker_ids: list[int], *,
+                       dryrun: bool = True) -> dict:
+        """Reference DemoteBrokerRunnable: move leadership (only) off brokers."""
+        state = self._cluster_model(progress)
+        proposals = _demotion_proposals(state, set(broker_ids), self.monitor.last_catalog)
+        out = {
+            "numLeaderMovements": len(proposals),
+            "proposals": [p.to_json() for p in proposals[:100]],
+        }
+        if not dryrun and proposals:
+            exec_options = ExecutionOptions(
+                concurrent_leader_movements=self.config.get("num.concurrent.leader.movements"),
+                progress_check_interval_s=0.1,
+            )
+            self.executor.catalog = self.monitor.last_catalog
+            progress.add_step(ExecutingProposals())
+            r = self.executor.execute_proposals(
+                proposals, exec_options, demoted_brokers=set(broker_ids)
+            )
+            out["execution"] = {"completed": r.completed, "dead": r.dead}
+        return out
+
+    def fix_offline_replicas(self, progress: OperationProgress, *, dryrun: bool = True) -> dict:
+        """Reference FixOfflineReplicasRunnable — the OfflineReplicaGoal
+        drives evacuation of dead brokers/disks during a normal optimize."""
+        result = self.proposals(progress, ignore_cache=True)
+        out = result.summary()
+        if not dryrun:
+            out["execution"] = self._execute(result, progress)
+        return out
+
+    def update_topic_replication_factor(
+        self, progress: OperationProgress, topic_rf: dict[str, int], *, dryrun: bool = True
+    ) -> dict:
+        """Reference UpdateTopicConfigurationRunnable (RF change)."""
+        state = self._cluster_model(progress)
+        proposals = _rf_change_proposals(state, topic_rf, self.monitor.last_catalog)
+        out = {
+            "numProposals": len(proposals),
+            "proposals": [p.to_json() for p in proposals[:100]],
+        }
+        if not dryrun and proposals:
+            self.executor.catalog = self.monitor.last_catalog
+            progress.add_step(ExecutingProposals())
+            r = self.executor.execute_proposals(
+                proposals,
+                ExecutionOptions(progress_check_interval_s=0.1),
+            )
+            out["execution"] = {"completed": r.completed, "dead": r.dead}
+        return out
+
+    def stop_proposal_execution(self, *, force: bool = False) -> dict:
+        self.executor.stop_execution(force=force)
+        return {"message": "execution stop requested", "force": force}
+
+    # ------------------------------------------------------------------
+    # state (reference STATE endpoint aggregating all substates)
+    # ------------------------------------------------------------------
+
+    def state(self, substates: list[str] | None = None) -> dict:
+        substates = [s.lower() for s in (substates or ["monitor", "executor", "analyzer", "anomaly_detector"])]
+        out: dict = {"version": 1}
+        if "monitor" in substates:
+            out["MonitorState"] = self.monitor.monitor_state()
+        if "executor" in substates:
+            out["ExecutorState"] = self.executor.executor_state()
+        if "analyzer" in substates:
+            with self._cache_lock:
+                cache = self._cache
+            out["AnalyzerState"] = {
+                "isProposalReady": cache is not None,
+                "readyGoals": self.chain.names() if cache is not None else [],
+                "goalReadiness": self.chain.names(),
+            }
+        if "anomaly_detector" in substates:
+            out["AnomalyDetectorState"] = self.anomaly_detector.detector_state()
+        return out
+
+
+class SelfHealingAdapter:
+    """detector.SelfHealingActions implementation over the facade — anomaly
+    fixes run through the exact user-operation paths (reference: anomaly fix
+    constructors of the runnables)."""
+
+    def __init__(self, cc: CruiseControl):
+        self.cc = cc
+
+    def _guarded(self, fn) -> bool:
+        try:
+            fn()
+            return True
+        except OngoingExecutionError:
+            return False
+        except Exception:  # noqa: BLE001 — fix failure is reported, not fatal
+            return False
+
+    def rebalance(self, reason: str) -> bool:
+        return self._guarded(lambda: self.cc.rebalance(OperationProgress(), dryrun=False))
+
+    def remove_brokers(self, broker_ids, reason: str) -> bool:
+        return self._guarded(
+            lambda: self.cc.remove_brokers(OperationProgress(), list(broker_ids), dryrun=False)
+        )
+
+    def demote_brokers(self, broker_ids, reason: str) -> bool:
+        return self._guarded(
+            lambda: self.cc.demote_brokers(OperationProgress(), list(broker_ids), dryrun=False)
+        )
+
+    def fix_offline_replicas(self, reason: str) -> bool:
+        return self._guarded(
+            lambda: self.cc.fix_offline_replicas(OperationProgress(), dryrun=False)
+        )
+
+    def fix_topic_replication_factor(self, topics, target_rf: int, reason: str) -> bool:
+        return self._guarded(
+            lambda: self.cc.update_topic_replication_factor(
+                OperationProgress(), {t: target_rf for t in topics}, dryrun=False
+            )
+        )
+
+    @property
+    def is_busy(self) -> bool:
+        return self.cc.executor.has_ongoing_execution
+
+
+# ----------------------------------------------------------------------
+# host-side proposal builders
+# ----------------------------------------------------------------------
+
+
+def _mark_brokers_dead(state: ClusterState, broker_ids: list[int]) -> ClusterState:
+    import jax.numpy as jnp
+
+    alive = np.asarray(state.broker_alive).copy()
+    alive[list(broker_ids)] = False
+    offline = np.asarray(state.replica_offline) | np.isin(
+        np.asarray(state.replica_broker), list(broker_ids)
+    )
+    return dataclasses.replace(
+        state,
+        broker_alive=jnp.asarray(alive),
+        replica_offline=jnp.asarray(offline & np.asarray(state.replica_valid)),
+    )
+
+
+def _demotion_proposals(state: ClusterState, demoted: set[int], catalog) -> list[ExecutionProposal]:
+    """Leadership-only proposals moving leaders off demoted brokers
+    (reference DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)
+    brk = np.asarray(state.replica_broker)
+    lead = np.asarray(state.replica_is_leader)
+    pos = np.asarray(state.replica_pos)
+    alive = np.asarray(state.broker_alive)
+    topic = np.asarray(state.replica_topic)
+    proposals = []
+    for p in np.unique(part[valid & lead & np.isin(brk, list(demoted))]):
+        rows = np.nonzero(valid & (part == p))[0]
+        rows = rows[np.argsort(pos[rows])]
+        old_leader = int(brk[rows[lead[rows]]][0])
+        candidates = [
+            int(brk[r]) for r in rows if int(brk[r]) not in demoted and alive[brk[r]]
+        ]
+        if not candidates:
+            continue
+        new_leader = candidates[0]
+        replicas = tuple(int(brk[r]) for r in rows)
+        proposals.append(
+            ExecutionProposal(
+                partition=int(p),
+                topic=int(topic[rows[0]]),
+                old_leader=old_leader,
+                new_leader=new_leader,
+                old_replicas=replicas,
+                new_replicas=replicas,
+            )
+        )
+    return proposals
+
+
+def _rf_change_proposals(
+    state: ClusterState, topic_rf: dict[str, int], catalog
+) -> list[ExecutionProposal]:
+    """Replication-factor change proposals: grow rack-aware onto the least
+    loaded brokers, shrink by dropping the most loaded non-leader replicas
+    (reference TopicReplicationFactorAnomalyFinder fix semantics)."""
+    from cruise_control_tpu.common.resources import Resource
+
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)
+    brk = np.asarray(state.replica_broker)
+    lead = np.asarray(state.replica_is_leader)
+    topic = np.asarray(state.replica_topic)
+    rack = np.asarray(state.broker_rack)
+    alive = np.asarray(state.broker_alive) & np.asarray(state.broker_valid)
+    load = np.zeros(state.shape.B)
+    eff = np.asarray(state.replica_load_leader)[:, Resource.DISK]
+    for r in np.nonzero(valid)[0]:
+        load[brk[r]] += eff[r]
+
+    name_to_tid = {name: i for i, name in enumerate(catalog.topics)} if catalog else {}
+    proposals = []
+    for tname, target in topic_rf.items():
+        tid = name_to_tid.get(tname)
+        if tid is None:
+            continue
+        for p in np.unique(part[valid & (topic == tid)]):
+            rows = np.nonzero(valid & (part == p))[0]
+            replicas = [int(brk[r]) for r in rows]
+            leader_rows = rows[lead[rows]]
+            leader = int(brk[leader_rows[0]]) if leader_rows.size else replicas[0]
+            new = list(replicas)
+            if len(new) < target:
+                used_racks = {int(rack[b]) for b in new}
+                candidates = sorted(
+                    (b for b in np.nonzero(alive)[0] if int(b) not in new),
+                    key=lambda b: (int(rack[b]) in used_racks, load[b]),
+                )
+                for b in candidates[: target - len(new)]:
+                    new.append(int(b))
+                    used_racks.add(int(rack[b]))
+            elif len(new) > target:
+                droppable = sorted(
+                    (b for b in new if b != leader), key=lambda b: -load[b]
+                )
+                for b in droppable[: len(new) - target]:
+                    new.remove(b)
+            if set(new) != set(replicas):
+                proposals.append(
+                    ExecutionProposal(
+                        partition=int(p),
+                        topic=tid,
+                        old_leader=leader,
+                        new_leader=leader,
+                        old_replicas=tuple(replicas),
+                        new_replicas=tuple([leader] + [b for b in new if b != leader]),
+                    )
+                )
+    return proposals

@@ -1,0 +1,132 @@
+"""Service bootstrap — assemble and start a full Cruise Control instance.
+
+Reference: KafkaCruiseControlMain.java:26-40 (parse props file -> start app)
+and KafkaCruiseControlApp.java:36-66.  `build_service` wires the stack for
+any MetadataProvider/ClusterAdmin pair: real Kafka adapters in production,
+the simulated backend in tests/demos (`build_simulated_service`).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from cruise_control_tpu.config.app_config import CruiseControlConfig, load_properties
+from cruise_control_tpu.monitor import (
+    FixedCapacityResolver,
+    KAFKA_METRIC_DEF,
+    LoadMonitor,
+    MetricFetcherManager,
+    WindowedMetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityConfigResolver,
+    FileCapacityResolver,
+)
+from cruise_control_tpu.service.facade import CruiseControl
+from cruise_control_tpu.service.server import CruiseControlApp
+
+
+def build_service(
+    config: CruiseControlConfig,
+    metadata,
+    admin,
+    sampler,
+    *,
+    capacity_resolver: BrokerCapacityConfigResolver | None = None,
+    sample_store=None,
+) -> tuple[CruiseControlApp, MetricFetcherManager]:
+    if capacity_resolver is None:
+        path = config.get("capacity.config.file")
+        capacity_resolver = (
+            FileCapacityResolver(path)
+            if path
+            else FixedCapacityResolver([100.0, 1e5, 1e5, 1e6])
+        )
+    partition_agg = WindowedMetricSampleAggregator(
+        num_windows=config.get("num.partition.metrics.windows"),
+        window_ms=config.get("partition.metrics.window.ms"),
+        min_samples_per_window=config.get("min.samples.per.partition.metrics.window"),
+        metric_def=KAFKA_METRIC_DEF,
+    )
+    broker_agg = WindowedMetricSampleAggregator(
+        num_windows=config.get("num.broker.metrics.windows"),
+        window_ms=config.get("broker.metrics.window.ms"),
+        min_samples_per_window=config.get("min.samples.per.broker.metrics.window"),
+        metric_def=KAFKA_METRIC_DEF,
+    )
+    fetcher = MetricFetcherManager(
+        sampler,
+        partition_agg,
+        broker_agg,
+        sample_store=sample_store,
+        sampling_interval_ms=config.get("metric.sampling.interval.ms"),
+    )
+    monitor = LoadMonitor(metadata, capacity_resolver, partition_agg)
+    cc = CruiseControl(config, monitor, admin)
+    app = CruiseControlApp(cc)
+    return app, fetcher
+
+
+def build_simulated_service(
+    config: CruiseControlConfig | None = None,
+    *,
+    num_brokers: int = 6,
+    topics: dict[str, int] | None = None,
+    seed: int = 0,
+    sampled_windows: int = 3,
+):
+    """Full in-process service against the simulated cluster (the embedded
+    harness analog, reference CruiseControlIntegrationTestHarness)."""
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.testing.synthetic import (
+        SyntheticWorkloadSampler,
+        synthetic_topology,
+    )
+
+    config = config or CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "num.partition.metrics.windows": max(3, sampled_windows),
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,  # ephemeral
+            "tpu.num.candidates": 128,
+            "tpu.leadership.candidates": 32,
+            "tpu.steps.per.round": 16,
+            "tpu.num.rounds": 2,
+        }
+    )
+    topo = synthetic_topology(num_brokers=num_brokers, topics=topics or {"T0": 12, "T1": 12},
+                              seed=seed)
+    metadata = StaticMetadataProvider(topo)
+    admin = SimulatedClusterAdmin(metadata, link_rate_bytes_per_s=1e12)
+    sampler = SyntheticWorkloadSampler(topo, seed=seed)
+    app, fetcher = build_service(config, metadata, admin, sampler)
+    window_ms = config.get("partition.metrics.window.ms")
+    parts = sampler.all_partition_entities()
+    for w in range(sampled_windows + 1):
+        fetcher.fetch_once(parts, w * window_ms, (w + 1) * window_ms - 1)
+    return app, fetcher, admin, sampler
+
+
+def main(argv=None):  # pragma: no cover — manual entry point
+    argv = argv if argv is not None else sys.argv[1:]
+    props = load_properties(argv[0]) if argv else {}
+    config = CruiseControlConfig(props)
+    app, fetcher, admin, sampler = build_simulated_service(config)
+    app.cc.start_up()
+    fetcher.start(lambda: sampler.all_partition_entities())
+    app.start()
+    print(f"cruise-control-tpu listening on {app.host}:{app.port}{app.prefix}")
+    try:
+        import time
+
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        app.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

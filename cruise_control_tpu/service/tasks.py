@@ -1,0 +1,109 @@
+"""User task management for async operations.
+
+Reference: servlet/UserTaskManager.java (UUID per task, `User-Task-ID`
+header, session -> task map, completed-task retention + periodic scan) and
+servlet/handler/async/runnable/OperationFuture.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from cruise_control_tpu.service.progress import OperationProgress, Pending
+
+USER_TASK_ID_HEADER = "User-Task-ID"
+
+
+@dataclasses.dataclass
+class UserTask:
+    task_id: str
+    endpoint: str
+    future: Future
+    progress: OperationProgress
+    created_ms: int
+    request_url: str = ""
+    #: JSON-serializable result once done
+
+    @property
+    def status(self) -> str:
+        if self.future.cancelled():
+            return "Cancelled"
+        if self.future.done():
+            return "Completed" if self.future.exception() is None else "CompletedWithError"
+        return "Active"
+
+    def to_json(self) -> dict:
+        return {
+            "UserTaskId": self.task_id,
+            "RequestURL": self.request_url or self.endpoint,
+            "Status": self.status,
+            "StartMs": self.created_ms,
+        }
+
+
+class UserTaskManager:
+    """Reference servlet/UserTaskManager.java."""
+
+    def __init__(
+        self,
+        *,
+        max_active_tasks: int = 25,
+        max_cached_completed: int = 100,
+        completed_retention_ms: int = 86_400_000,
+        num_threads: int = 3,
+    ):
+        # reference AsyncKafkaCruiseControl uses 3 session threads
+        self._pool = ThreadPoolExecutor(max_workers=num_threads, thread_name_prefix="user-task")
+        self._tasks: dict[str, UserTask] = {}
+        self._lock = threading.RLock()
+        self.max_active_tasks = max_active_tasks
+        self.max_cached_completed = max_cached_completed
+        self.completed_retention_ms = completed_retention_ms
+
+    def submit(self, endpoint: str, fn, *, request_url: str = "", task_id: str | None = None) -> UserTask:
+        """Run fn(progress) on the session pool; returns the UserTask."""
+        with self._lock:
+            active = sum(1 for t in self._tasks.values() if t.status == "Active")
+            if active >= self.max_active_tasks:
+                raise RuntimeError("too many active user tasks")
+            tid = task_id or str(uuid.uuid4())
+            progress = OperationProgress()
+            progress.add_step(Pending())
+            future = self._pool.submit(fn, progress)
+            task = UserTask(
+                task_id=tid,
+                endpoint=endpoint,
+                future=future,
+                progress=progress,
+                created_ms=int(time.time() * 1000),
+                request_url=request_url,
+            )
+            self._tasks[tid] = task
+            self._maybe_evict()
+            return task
+
+    def get(self, task_id: str) -> UserTask | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def all_tasks(self) -> list[UserTask]:
+        with self._lock:
+            return list(self._tasks.values())
+
+    def _maybe_evict(self):
+        now = int(time.time() * 1000)
+        completed = [t for t in self._tasks.values() if t.status != "Active"]
+        completed.sort(key=lambda t: t.created_ms)
+        # retention by age then by count (reference scanner, 5s cadence)
+        for t in completed:
+            expired = now - t.created_ms > self.completed_retention_ms
+            overflow = len([x for x in self._tasks.values() if x.status != "Active"]) > self.max_cached_completed
+            if expired or overflow:
+                del self._tasks[t.task_id]
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)

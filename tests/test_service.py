@@ -1,0 +1,236 @@
+"""Service-layer tests: config system, facade operations, REST API.
+
+Mirrors reference KafkaCruiseControlServletEndpointTest / UserTaskManagerTest
+(SURVEY §4.4) over the in-process simulated service.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.config import ConfigException, CruiseControlConfig
+from cruise_control_tpu.service.main import build_simulated_service
+from cruise_control_tpu.service.progress import OperationProgress
+from cruise_control_tpu.service.purgatory import Purgatory, ReviewStatus
+from cruise_control_tpu.service.server import GET_ENDPOINTS, POST_ENDPOINTS
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_config_defaults_and_overrides():
+    c = CruiseControlConfig({})
+    assert c.get("max.replicas.per.broker") == 10_000
+    assert c.get("num.concurrent.partition.movements.per.broker") == 5
+    c2 = CruiseControlConfig({"cpu.balance.threshold": "1.25"})
+    assert c2.balancing_constraint().balance_threshold[0] == 1.25
+
+
+def test_config_validation():
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"cpu.capacity.threshold": "1.5"})  # > 1.0
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"default.goals": "NoSuchGoal"})
+
+
+def test_purgatory_flow():
+    p = Purgatory()
+    info = p.add("rebalance", {"dryrun": "false"})
+    assert info.status == ReviewStatus.PENDING_REVIEW
+    p.review(info.review_id, approve=True)
+    taken = p.take_approved("rebalance", info.review_id)
+    assert taken.status == ReviewStatus.SUBMITTED
+    with pytest.raises(ValueError):
+        p.take_approved("rebalance", info.review_id)  # already submitted
+
+
+# ----------------------------------------------------------------- service
+
+
+@pytest.fixture(scope="module")
+def service():
+    app, fetcher, admin, sampler = build_simulated_service(seed=3)
+    app.start()
+    yield app
+    app.stop()
+
+
+def _url(app, endpoint, **params):
+    q = "&".join(f"{k}={v}" for k, v in params.items())
+    return f"http://{app.host}:{app.port}{app.prefix}/{endpoint}" + (f"?{q}" if q else "")
+
+
+def _request(app, method, endpoint, headers=None, **params):
+    req = urllib.request.Request(
+        _url(app, endpoint, **params), method=method, headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _poll(app, method, endpoint, **params):
+    """Drive the 202 + User-Task-ID pattern to completion."""
+    status, payload, headers = _request(app, method, endpoint, **params)
+    tid = headers.get("User-Task-ID")
+    deadline = time.time() + 60
+    while status == 202 and time.time() < deadline:
+        time.sleep(0.3)
+        status, payload, headers = _request(
+            app, method, endpoint, headers={"User-Task-ID": tid}, **params
+        )
+    return status, payload
+
+
+def test_state_endpoint(service):
+    status, payload, _ = _request(service, "GET", "state")
+    assert status == 200
+    assert {"MonitorState", "ExecutorState", "AnalyzerState", "AnomalyDetectorState"} <= set(payload)
+    assert payload["MonitorState"]["numValidWindows"] >= 2
+    # substates filter
+    status, payload, _ = _request(service, "GET", "state", substates="monitor")
+    assert "ExecutorState" not in payload
+
+
+def test_kafka_cluster_state(service):
+    status, payload, _ = _request(service, "GET", "kafka_cluster_state")
+    assert status == 200
+    assert payload["KafkaPartitionState"]["numTotalPartitions"] == 24
+    assert len(payload["KafkaBrokerState"]) == 6
+
+
+def test_load_endpoint(service):
+    status, payload = _poll(service, "GET", "load")
+    assert status == 200
+    assert len(payload["brokers"]) == 6
+    assert all("CPUPct" in b for b in payload["brokers"])
+
+
+def test_partition_load_endpoint(service):
+    status, payload = _poll(service, "GET", "partition_load", resource="NW_IN", entries=5)
+    assert status == 200
+    vals = [r["NW_IN"] for r in payload["records"]]
+    assert vals == sorted(vals, reverse=True) and len(vals) <= 5
+
+
+def test_proposals_and_cache(service):
+    status, payload = _poll(service, "GET", "proposals")
+    assert status == 200
+    assert "balancednessAfter" in payload
+    # second call should hit the proposal cache (fast, same result)
+    t0 = time.time()
+    status2, payload2 = _poll(service, "GET", "proposals")
+    assert status2 == 200 and time.time() - t0 < 5
+    assert payload2["balancednessAfter"] == payload["balancednessAfter"]
+
+
+def test_rebalance_dryrun_then_execute(service):
+    status, payload = _poll(service, "POST", "rebalance", dryrun="true")
+    assert status == 200
+    status, payload = _poll(service, "POST", "rebalance", dryrun="false")
+    assert status == 200
+    if "execution" in payload:
+        assert payload["execution"]["dead"] == 0
+    # post-execution: proposals should find (almost) nothing left to move
+    status, after = _poll(service, "GET", "proposals", ignore_proposal_cache="true")
+    assert after["balancednessAfter"] >= payload["balancednessAfter"] - 1e-6
+
+
+def test_user_tasks_listing(service):
+    status, payload, _ = _request(service, "GET", "user_tasks")
+    assert status == 200
+    assert any(t["Status"] in ("Active", "Completed") for t in payload["userTasks"])
+
+
+def test_pause_resume_sampling(service):
+    status, payload, _ = _request(service, "POST", "pause_sampling", reason="test")
+    assert status == 200
+    assert service.cc.monitor.monitor_state()["state"] == "PAUSED"
+    _request(service, "POST", "resume_sampling")
+    assert service.cc.monitor.monitor_state()["state"] == "RUNNING"
+
+
+def test_admin_self_healing_toggle(service):
+    status, payload, _ = _request(
+        service, "POST", "admin", enable_self_healing_for="goal_violation"
+    )
+    assert status == 200 and "GOAL_VIOLATION" in payload["selfHealingEnabled"]
+    _request(service, "POST", "admin", disable_self_healing_for="goal_violation")
+    assert not service.cc.notifier.self_healing_enabled()[
+        __import__("cruise_control_tpu.detector", fromlist=["AnomalyType"]).AnomalyType.GOAL_VIOLATION
+    ]
+
+
+def test_demote_broker(service):
+    status, payload = _poll(service, "POST", "demote_broker", brokerid="0", dryrun="false")
+    assert status == 200
+    topo = service.cc.admin.topology()
+    leaders = {p.leader for p in topo.partitions}
+    assert 0 not in leaders
+
+
+def test_topic_configuration_rf_change(service):
+    status, payload = _poll(
+        service, "POST", "topic_configuration", topic="T0", replication_factor="3",
+        dryrun="false",
+    )
+    assert status == 200
+    topo = service.cc.admin.topology()
+    for p in topo.partitions:
+        if p.topic == "T0":
+            assert len(p.replicas) == 3
+
+
+def test_unknown_endpoint_and_bad_params(service):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "GET", "nonsense")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "POST", "remove_broker")  # missing brokerid
+    assert e.value.code == 400
+
+
+def test_endpoint_surface_complete():
+    """The reference exposes 9 GET + 11 POST endpoints
+    (CruiseControlEndPoint.java:16-37) — all must exist here."""
+    assert set(GET_ENDPOINTS) == {
+        "bootstrap", "train", "load", "partition_load", "proposals", "state",
+        "kafka_cluster_state", "user_tasks", "review_board",
+    }
+    assert set(POST_ENDPOINTS) == {
+        "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
+        "stop_proposal_execution", "pause_sampling", "resume_sampling",
+        "demote_broker", "admin", "review", "topic_configuration",
+    }
+
+
+def test_two_step_verification_flow():
+    config = CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,
+            "two.step.verification.enabled": "true",
+            "tpu.num.candidates": 64,
+            "tpu.leadership.candidates": 16,
+            "tpu.steps.per.round": 8,
+            "tpu.num.rounds": 2,
+        }
+    )
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=4)
+    app.start()
+    try:
+        status, payload, _ = _request(app, "POST", "rebalance", dryrun="true")
+        assert status == 200 and "reviewId" in payload
+        rid = payload["reviewId"]
+        status, board, _ = _request(app, "GET", "review_board")
+        assert any(r["Id"] == rid for r in board["requestInfo"])
+        _request(app, "POST", "review", approve=str(rid))
+        status, payload = _poll(app, "POST", "rebalance", review_id=str(rid))
+        assert status == 200 and "balancednessAfter" in payload
+    finally:
+        app.stop()
