@@ -22,7 +22,7 @@ import numpy as np
 
 
 def build_cluster(scale: str):
-    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
 
     specs = {
         "north_star": RandomClusterSpec(
@@ -53,7 +53,7 @@ def build_cluster(scale: str):
         ),
         "small": RandomClusterSpec(num_brokers=50, num_partitions=5000, skew=0.8),
     }
-    return random_cluster(specs[scale], seed=42), scale
+    return random_cluster_fast(specs[scale], seed=42), scale
 
 
 def main():

@@ -1,0 +1,177 @@
+"""cccli — command-line client for the REST API.
+
+Reference: cruise-control-client/cruisecontrolclient/client/cccli.py:135-176
+(one argparse subparser per endpoint), Endpoint.py (endpoint/parameter
+object model), CCParameter/ (typed parameter validators), Responder.py /
+Query.py (HTTP session + async 202 poll loop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER
+
+
+# ----------------------------------------------------------------------
+# typed parameter validators (reference CCParameter/*)
+# ----------------------------------------------------------------------
+
+
+def boolean_param(value: str) -> str:
+    if value.lower() not in ("true", "false"):
+        raise argparse.ArgumentTypeError(f"{value!r} is not true/false")
+    return value.lower()
+
+def csv_int_param(value: str) -> str:
+    if not re.fullmatch(r"\d+(,\d+)*", value):
+        raise argparse.ArgumentTypeError(f"{value!r} is not a comma-separated id list")
+    return value
+
+
+def positive_int_param(value: str) -> str:
+    if not value.isdigit() or int(value) <= 0:
+        raise argparse.ArgumentTypeError(f"{value!r} is not a positive integer")
+    return value
+
+
+# ----------------------------------------------------------------------
+# endpoint model (reference Endpoint.py)
+# ----------------------------------------------------------------------
+
+
+ENDPOINTS: dict[str, dict] = {
+    # dest -> {method, endpoint, params: {flag: (param, type)}}
+    "state": {"method": "GET", "endpoint": "state",
+              "params": {"--substates": ("substates", str)}},
+    "kafka_cluster_state": {"method": "GET", "endpoint": "kafka_cluster_state", "params": {}},
+    "load": {"method": "GET", "endpoint": "load", "params": {}},
+    "partition_load": {"method": "GET", "endpoint": "partition_load",
+                       "params": {"--resource": ("resource", str),
+                                  "--entries": ("entries", positive_int_param)}},
+    "proposals": {"method": "GET", "endpoint": "proposals",
+                  "params": {"--ignore-proposal-cache": ("ignore_proposal_cache", boolean_param)}},
+    "user_tasks": {"method": "GET", "endpoint": "user_tasks", "params": {}},
+    "review_board": {"method": "GET", "endpoint": "review_board", "params": {}},
+    "bootstrap": {"method": "GET", "endpoint": "bootstrap", "params": {}},
+    "train": {"method": "GET", "endpoint": "train", "params": {}},
+    "rebalance": {"method": "POST", "endpoint": "rebalance",
+                  "params": {"--dryrun": ("dryrun", boolean_param),
+                             "--goals": ("goals", str),
+                             "--destination-broker-ids": ("destination_broker_ids", csv_int_param),
+                             "--excluded-topics": ("excluded_topics", str),
+                             "--review-id": ("review_id", positive_int_param)}},
+    "add_broker": {"method": "POST", "endpoint": "add_broker",
+                   "params": {"--brokers": ("brokerid", csv_int_param),
+                              "--dryrun": ("dryrun", boolean_param)},
+                   "required": ["--brokers"]},
+    "remove_broker": {"method": "POST", "endpoint": "remove_broker",
+                      "params": {"--brokers": ("brokerid", csv_int_param),
+                                 "--dryrun": ("dryrun", boolean_param)},
+                      "required": ["--brokers"]},
+    "demote_broker": {"method": "POST", "endpoint": "demote_broker",
+                      "params": {"--brokers": ("brokerid", csv_int_param),
+                                 "--dryrun": ("dryrun", boolean_param)},
+                      "required": ["--brokers"]},
+    "fix_offline_replicas": {"method": "POST", "endpoint": "fix_offline_replicas",
+                             "params": {"--dryrun": ("dryrun", boolean_param)}},
+    "stop_proposal_execution": {"method": "POST", "endpoint": "stop_proposal_execution",
+                                "params": {"--force": ("force_stop", boolean_param)}},
+    "pause_sampling": {"method": "POST", "endpoint": "pause_sampling",
+                       "params": {"--reason": ("reason", str)}},
+    "resume_sampling": {"method": "POST", "endpoint": "resume_sampling", "params": {}},
+    "topic_configuration": {"method": "POST", "endpoint": "topic_configuration",
+                            "params": {"--topic": ("topic", str),
+                                       "--replication-factor": ("replication_factor", positive_int_param),
+                                       "--dryrun": ("dryrun", boolean_param)},
+                            "required": ["--topic", "--replication-factor"]},
+    "admin": {"method": "POST", "endpoint": "admin",
+              "params": {"--enable-self-healing-for": ("enable_self_healing_for", str),
+                         "--disable-self-healing-for": ("disable_self_healing_for", str),
+                         "--drop-recently-removed-brokers": ("drop_recently_removed_brokers", csv_int_param)}},
+    "review": {"method": "POST", "endpoint": "review",
+               "params": {"--approve": ("approve", csv_int_param),
+                          "--discard": ("discard", csv_int_param),
+                          "--reason": ("reason", str)}},
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cccli", description="cruise-control-tpu command line client"
+    )
+    p.add_argument("-a", "--socket-address", default="http://127.0.0.1:9090",
+                   help="host:port of the cruise-control server")
+    p.add_argument("--prefix", default="/kafkacruisecontrol")
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--json-indent", type=int, default=2)
+    sub = p.add_subparsers(dest="dest", required=True,
+                           metavar="{" + ",".join(sorted(ENDPOINTS)) + "}")
+    for dest, spec in ENDPOINTS.items():
+        sp = sub.add_parser(dest)
+        required = set(spec.get("required", ()))
+        for flag, (param, typ) in spec["params"].items():
+            sp.add_argument(flag, dest=param, type=typ, required=flag in required)
+    return p
+
+
+class Client:
+    """HTTP session with the async 202 poll loop (reference Responder.py)."""
+
+    def __init__(self, base: str, prefix: str, *, poll_interval=1.0, timeout=600.0):
+        if not base.startswith("http"):
+            base = "http://" + base
+        self.base = base.rstrip("/") + prefix
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+
+    def request(self, method: str, endpoint: str, params: dict) -> dict:
+        query = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
+        url = f"{self.base}/{endpoint}" + (f"?{query}" if query else "")
+        headers: dict[str, str] = {}
+        deadline = time.time() + self.timeout
+        while True:
+            req = urllib.request.Request(url, method=method, headers=headers)
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = json.loads(resp.read())
+                if resp.status != 202:
+                    return payload
+                tid = resp.headers.get(USER_TASK_ID_HEADER) or payload.get("_userTaskId")
+                headers[USER_TASK_ID_HEADER] = tid
+            if time.time() > deadline:
+                raise TimeoutError(f"operation still running; resume with {tid}")
+            for step in payload.get("progress", []):
+                print(
+                    f"  [{step['completionPercentage']:5.1f}%] {step['step']}",
+                    file=sys.stderr,
+                )
+            time.sleep(self.poll_interval)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    spec = ENDPOINTS[args.dest]
+    params = {
+        param: getattr(args, param, None)
+        for _, (param, _t) in spec["params"].items()
+    }
+    client = Client(args.socket_address, args.prefix,
+                    poll_interval=args.poll_interval, timeout=args.timeout)
+    try:
+        result = client.request(spec["method"], spec["endpoint"], params)
+    except urllib.error.HTTPError as e:
+        print(json.dumps(json.loads(e.read() or b"{}"), indent=args.json_indent))
+        return 1
+    print(json.dumps(result, indent=args.json_indent))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

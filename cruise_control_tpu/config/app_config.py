@@ -13,8 +13,9 @@ from __future__ import annotations
 
 from typing import Any
 
-from cruise_control_tpu.analyzer.engine import OptimizerConfig
-from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER, GOALS_BY_NAME
+# NOTE: analyzer modules import config.balancing; importing analyzer at
+# module scope here would close an import cycle through the package
+# __init__s, so goal/optimizer symbols are imported lazily inside functions.
 from cruise_control_tpu.config.balancing import BalancingConstraint
 from cruise_control_tpu.config.config_def import (
     AbstractConfig,
@@ -33,6 +34,8 @@ _HARD_GOALS_DEFAULT = (
 
 def _analyzer_defs() -> ConfigDef:
     """Reference config/constants/AnalyzerConfig.java."""
+    from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER
+
     d = ConfigDef()
     g = "analyzer"
     d.define("default.goals", T.LIST, ",".join(DEFAULT_GOAL_ORDER), I.HIGH,
@@ -186,6 +189,8 @@ class CruiseControlConfig(AbstractConfig):
         self._sanity_check_goals()
 
     def _sanity_check_goals(self):
+        from cruise_control_tpu.analyzer.goals import GOALS_BY_NAME
+
         goals = self.get("default.goals")
         hard = set(self.get("hard.goals"))
         unknown = [g for g in goals if g not in GOALS_BY_NAME]
@@ -227,7 +232,9 @@ class CruiseControlConfig(AbstractConfig):
             ),
         )
 
-    def optimizer_config(self) -> OptimizerConfig:
+    def optimizer_config(self):
+        from cruise_control_tpu.analyzer.engine import OptimizerConfig
+
         g = self.get
         return OptimizerConfig(
             num_candidates=g("tpu.num.candidates"),

@@ -1,0 +1,140 @@
+"""Sampler that consumes the metrics-reporter stream.
+
+Reference: monitor/sampling/CruiseControlMetricsReporterSampler.java:41
+(poll loop over __CruiseControlMetrics) +
+CruiseControlMetricsProcessor.java (raw broker/topic/partition metrics ->
+PartitionMetricSample / BrokerMetricSample, including CPU attribution:
+broker CPU is apportioned to leader partitions by their share of the
+broker's produce/fetch bytes).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF, MetricDef
+from cruise_control_tpu.monitor.sampling import (
+    BrokerEntity,
+    MetricSample,
+    PartitionEntity,
+    SamplingResult,
+)
+from cruise_control_tpu.monitor.topology import ClusterTopology
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric,
+    MetricType,
+    PartitionMetric,
+    TopicMetric,
+)
+from cruise_control_tpu.reporter.reporter import InMemoryTransport
+
+# raw broker metric -> aggregate broker metric name (KafkaMetricDef)
+_BROKER_METRIC_MAP = {
+    MetricType.BROKER_PRODUCE_REQUEST_RATE: "BROKER_PRODUCE_REQUEST_RATE",
+    MetricType.BROKER_CONSUMER_FETCH_REQUEST_RATE: "BROKER_CONSUMER_FETCH_REQUEST_RATE",
+    MetricType.BROKER_FOLLOWER_FETCH_REQUEST_RATE: "BROKER_FOLLOWER_FETCH_REQUEST_RATE",
+    MetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT: "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT",
+    MetricType.BROKER_REQUEST_QUEUE_SIZE: "BROKER_REQUEST_QUEUE_SIZE",
+    MetricType.BROKER_RESPONSE_QUEUE_SIZE: "BROKER_RESPONSE_QUEUE_SIZE",
+    MetricType.BROKER_LOG_FLUSH_RATE: "BROKER_LOG_FLUSH_RATE",
+    MetricType.BROKER_LOG_FLUSH_TIME_MS_MAX: "BROKER_LOG_FLUSH_TIME_MS_MAX",
+    MetricType.BROKER_LOG_FLUSH_TIME_MS_MEAN: "BROKER_LOG_FLUSH_TIME_MS_MEAN",
+}
+
+
+class CruiseControlMetricsReporterSampler:
+    """MetricSampler over an InMemoryTransport (Kafka consumer in prod)."""
+
+    def __init__(
+        self,
+        transport: InMemoryTransport,
+        topology_provider,
+        *,
+        metric_def: MetricDef = KAFKA_METRIC_DEF,
+    ):
+        self.transport = transport
+        self.topology_provider = topology_provider
+        self.metric_def = metric_def
+        self._topic_ids: dict[str, int] = {}
+
+    def _topic_id(self, topic: str) -> int:
+        if topic not in self._topic_ids:
+            # dense ids in first-seen order; the monitor's builder re-sorts
+            self._topic_ids[topic] = len(self._topic_ids)
+        return self._topic_ids[topic]
+
+    def get_samples(self, assigned_partitions, start_ms: int, end_ms: int) -> SamplingResult:
+        topo: ClusterTopology = self.topology_provider()
+        raw = self.transport.poll()
+        m = self.metric_def
+        cpu_id = m.metric_id("CPU_USAGE")
+        disk_id = m.metric_id("DISK_USAGE")
+        nwin_id = m.metric_id("LEADER_BYTES_IN")
+        nwout_id = m.metric_id("LEADER_BYTES_OUT")
+
+        part_size: dict[tuple[str, int], float] = {}
+        topic_bytes_in: dict[tuple[int, str], float] = defaultdict(float)
+        topic_bytes_out: dict[tuple[int, str], float] = defaultdict(float)
+        broker_cpu: dict[int, float] = {}
+        broker_values: dict[int, np.ndarray] = {}
+        times: dict[int, int] = {}
+
+        for r in raw:
+            times[r.broker_id] = max(times.get(r.broker_id, 0), r.time_ms)
+            if isinstance(r, PartitionMetric) and r.metric_type == MetricType.PARTITION_SIZE:
+                part_size[(r.topic, r.partition)] = r.value
+            elif isinstance(r, TopicMetric):
+                if r.metric_type == MetricType.TOPIC_BYTES_IN:
+                    topic_bytes_in[(r.broker_id, r.topic)] = r.value
+                elif r.metric_type == MetricType.TOPIC_BYTES_OUT:
+                    topic_bytes_out[(r.broker_id, r.topic)] = r.value
+            elif isinstance(r, BrokerMetric):
+                if r.metric_type == MetricType.BROKER_CPU_UTIL:
+                    broker_cpu[r.broker_id] = r.value
+                else:
+                    name = _BROKER_METRIC_MAP.get(r.metric_type)
+                    if name is not None:
+                        v = broker_values.setdefault(
+                            r.broker_id, np.zeros(m.num_metrics, np.float32)
+                        )
+                        v[m.metric_id(name)] = r.value
+
+        # leader partitions per (broker, topic) for byte attribution
+        leaders: dict[tuple[int, str], list] = defaultdict(list)
+        for p in topo.partitions:
+            leaders[(p.leader, p.topic)].append(p)
+
+        t_mid = (start_ms + end_ms) // 2
+        partition_samples: list[MetricSample] = []
+        for (broker, topic), parts in leaders.items():
+            tb_in = topic_bytes_in.get((broker, topic), 0.0)
+            tb_out = topic_bytes_out.get((broker, topic), 0.0)
+            sizes = np.array([part_size.get((topic, p.partition), 0.0) for p in parts])
+            total = sizes.sum()
+            shares = sizes / total if total > 0 else np.full(len(parts), 1.0 / max(len(parts), 1))
+            # CPU attribution: broker CPU split across leader partitions by
+            # their byte share (reference CruiseControlMetricsProcessor)
+            b_cpu = broker_cpu.get(broker, 0.0)
+            b_total_in = sum(
+                topic_bytes_in.get((broker, t2), 0.0) for (b2, t2) in topic_bytes_in if b2 == broker
+            )
+            for p, share in zip(parts, shares):
+                vals = np.zeros(m.num_metrics, np.float32)
+                vals[disk_id] = part_size.get((topic, p.partition), 0.0)
+                vals[nwin_id] = tb_in * share
+                vals[nwout_id] = tb_out * share
+                if b_total_in > 0:
+                    vals[cpu_id] = b_cpu * (tb_in * share) / b_total_in
+                partition_samples.append(
+                    MetricSample(
+                        PartitionEntity(self._topic_id(topic), p.partition), t_mid, vals
+                    )
+                )
+
+        broker_samples = [
+            MetricSample(BrokerEntity(b), times.get(b, t_mid), v)
+            for b, v in broker_values.items()
+        ]
+        return SamplingResult(partition_samples, broker_samples)

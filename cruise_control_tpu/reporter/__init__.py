@@ -1,0 +1,21 @@
+"""Metrics reporter — broker-side metric emission (plugin analog).
+
+Reference: cruise-control-metrics-reporter/ (CruiseControlMetricsReporter
+runs INSIDE each Kafka broker, samples Yammer/Kafka metrics on an interval
+and produces serialized records to the __CruiseControlMetrics topic).
+"""
+
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric,
+    CruiseControlMetric,
+    MetricSerde,
+    MetricType,
+    PartitionMetric,
+    TopicMetric,
+)
+from cruise_control_tpu.reporter.reporter import (
+    MetricsRegistrySnapshotter,
+    MetricsReporter,
+    MetricTransport,
+    InMemoryTransport,
+)

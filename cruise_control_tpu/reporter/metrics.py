@@ -1,0 +1,164 @@
+"""Raw metric records + wire serialization.
+
+Reference: cruise-control-metrics-reporter metric/RawMetricType.java:27-80
+(~56 types with BROKER/TOPIC/PARTITION scope and versioned serialization),
+metric/CruiseControlMetric.java (classId + version wire format),
+metric/MetricSerde.java (Kafka serde).
+
+The wire format here is a compact little-endian struct mirroring the
+reference's layout idea (class id byte, version byte, then fields) so a
+heterogeneous stream of broker/topic/partition metrics can share one
+topic/transport.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+
+
+class MetricClassId(enum.IntEnum):
+    """Reference CruiseControlMetric.MetricClassId."""
+
+    BROKER_METRIC = 0
+    TOPIC_METRIC = 1
+    PARTITION_METRIC = 2
+
+
+class MetricType(enum.IntEnum):
+    """Raw metric taxonomy (reference metric/RawMetricType.java:27-80).
+
+    Scope encoded by range: 0-39 broker, 40-49 topic, 50+ partition.
+    """
+
+    # broker scope
+    ALL_TOPIC_BYTES_IN = 0
+    ALL_TOPIC_BYTES_OUT = 1
+    ALL_TOPIC_REPLICATION_BYTES_IN = 2
+    ALL_TOPIC_REPLICATION_BYTES_OUT = 3
+    ALL_TOPIC_PRODUCE_REQUEST_RATE = 4
+    ALL_TOPIC_FETCH_REQUEST_RATE = 5
+    ALL_TOPIC_MESSAGES_IN_PER_SEC = 6
+    BROKER_CPU_UTIL = 7
+    BROKER_PRODUCE_REQUEST_RATE = 8
+    BROKER_CONSUMER_FETCH_REQUEST_RATE = 9
+    BROKER_FOLLOWER_FETCH_REQUEST_RATE = 10
+    BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT = 11
+    BROKER_REQUEST_QUEUE_SIZE = 12
+    BROKER_RESPONSE_QUEUE_SIZE = 13
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX = 14
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN = 15
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 16
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 17
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 18
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 19
+    BROKER_PRODUCE_TOTAL_TIME_MS_MAX = 20
+    BROKER_PRODUCE_TOTAL_TIME_MS_MEAN = 21
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MAX = 22
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MEAN = 23
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MAX = 24
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MEAN = 25
+    BROKER_PRODUCE_LOCAL_TIME_MS_MAX = 26
+    BROKER_PRODUCE_LOCAL_TIME_MS_MEAN = 27
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MAX = 28
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MEAN = 29
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MAX = 30
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MEAN = 31
+    BROKER_LOG_FLUSH_RATE = 32
+    BROKER_LOG_FLUSH_TIME_MS_MAX = 33
+    BROKER_LOG_FLUSH_TIME_MS_MEAN = 34
+    # topic scope
+    TOPIC_BYTES_IN = 40
+    TOPIC_BYTES_OUT = 41
+    TOPIC_REPLICATION_BYTES_IN = 42
+    TOPIC_REPLICATION_BYTES_OUT = 43
+    TOPIC_PRODUCE_REQUEST_RATE = 44
+    TOPIC_FETCH_REQUEST_RATE = 45
+    TOPIC_MESSAGES_IN_PER_SEC = 46
+    # partition scope
+    PARTITION_SIZE = 50
+
+    @property
+    def is_broker_scope(self) -> bool:
+        return self < 40
+
+    @property
+    def is_topic_scope(self) -> bool:
+        return 40 <= self < 50
+
+    @property
+    def is_partition_scope(self) -> bool:
+        return self >= 50
+
+
+_VERSION = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CruiseControlMetric:
+    metric_type: MetricType
+    time_ms: int
+    broker_id: int
+    value: float
+
+    class_id = MetricClassId.BROKER_METRIC
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerMetric(CruiseControlMetric):
+    class_id = MetricClassId.BROKER_METRIC
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicMetric(CruiseControlMetric):
+    topic: str = ""
+
+    class_id = MetricClassId.TOPIC_METRIC
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMetric(CruiseControlMetric):
+    topic: str = ""
+    partition: int = -1
+
+    class_id = MetricClassId.PARTITION_METRIC
+
+
+class MetricSerde:
+    """Binary serde (reference metric/MetricSerde.java).
+
+    Layout: class_id u8 | version u8 | metric_type u16 | time_ms i64 |
+    broker_id i32 | value f64 [| topic_len u16 | topic utf8 [| partition i32]]
+    """
+
+    _HEAD = struct.Struct("<BBHqid")
+
+    @classmethod
+    def serialize(cls, m: CruiseControlMetric) -> bytes:
+        head = cls._HEAD.pack(
+            int(m.class_id), _VERSION, int(m.metric_type), m.time_ms, m.broker_id, m.value
+        )
+        if isinstance(m, PartitionMetric):
+            t = m.topic.encode()
+            return head + struct.pack("<H", len(t)) + t + struct.pack("<i", m.partition)
+        if isinstance(m, TopicMetric):
+            t = m.topic.encode()
+            return head + struct.pack("<H", len(t)) + t
+        return head
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> CruiseControlMetric:
+        class_id, version, mtype, time_ms, broker_id, value = cls._HEAD.unpack_from(data)
+        if version > _VERSION:
+            raise ValueError(f"unsupported metric version {version}")
+        rest = data[cls._HEAD.size:]
+        mt = MetricType(mtype)
+        if class_id == MetricClassId.BROKER_METRIC:
+            return BrokerMetric(mt, time_ms, broker_id, value)
+        (tlen,) = struct.unpack_from("<H", rest)
+        topic = rest[2: 2 + tlen].decode()
+        if class_id == MetricClassId.TOPIC_METRIC:
+            return TopicMetric(mt, time_ms, broker_id, value, topic=topic)
+        (partition,) = struct.unpack_from("<i", rest, 2 + tlen)
+        return PartitionMetric(mt, time_ms, broker_id, value, topic=topic, partition=partition)

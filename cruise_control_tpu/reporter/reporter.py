@@ -1,0 +1,136 @@
+"""Broker-side metrics reporter loop.
+
+Reference: CruiseControlMetricsReporter.java (implements Kafka
+MetricsReporter + Runnable: samples the broker's Yammer/Kafka metric
+registries every reportingIntervalMs and produces to the
+__CruiseControlMetrics topic, auto-creating it), metric/YammerMetricProcessor.java
+(+ MetricsUtils.java filter logic).
+
+Transport is an SPI: a real deployment produces to Kafka; in-process runs
+use InMemoryTransport, which the CruiseControlMetricsReporterSampler
+equivalent drains on the monitor side (reference
+monitor/sampling/CruiseControlMetricsReporterSampler.java:41).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Protocol
+
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric,
+    CruiseControlMetric,
+    MetricSerde,
+    MetricType,
+    PartitionMetric,
+    TopicMetric,
+)
+
+
+class MetricTransport(Protocol):
+    """Where serialized metric records go (Kafka producer in production)."""
+
+    def send(self, payload: bytes) -> None:
+        ...
+
+    def flush(self) -> None:
+        ...
+
+
+class InMemoryTransport:
+    """Bounded in-process topic standing in for __CruiseControlMetrics."""
+
+    def __init__(self, max_records: int = 1_000_000):
+        self._records: list[bytes] = []
+        self._lock = threading.Lock()
+        self._max = max_records
+
+    def send(self, payload: bytes) -> None:
+        with self._lock:
+            self._records.append(payload)
+            if len(self._records) > self._max:
+                del self._records[: len(self._records) - self._max]
+
+    def flush(self) -> None:
+        pass
+
+    def poll(self, max_records: int | None = None) -> list[CruiseControlMetric]:
+        """Consumer side (the sampler drains this)."""
+        with self._lock:
+            n = len(self._records) if max_records is None else min(max_records, len(self._records))
+            out, self._records = self._records[:n], self._records[n:]
+        return [MetricSerde.deserialize(r) for r in out]
+
+
+class MetricsRegistrySnapshotter:
+    """Adapter from a metrics source to raw metric records — the
+    YammerMetricProcessor role.  The source is a callable returning
+    {"broker": {MetricType: value}, "topics": {t: {...}}, "partitions":
+    {(t, p): size}} for one broker."""
+
+    def __init__(self, broker_id: int, source: Callable[[], dict]):
+        self.broker_id = broker_id
+        self.source = source
+
+    def snapshot(self, now_ms: int) -> list[CruiseControlMetric]:
+        data = self.source()
+        out: list[CruiseControlMetric] = []
+        for mt, v in data.get("broker", {}).items():
+            out.append(BrokerMetric(MetricType(mt), now_ms, self.broker_id, float(v)))
+        for topic, metrics in data.get("topics", {}).items():
+            for mt, v in metrics.items():
+                out.append(
+                    TopicMetric(MetricType(mt), now_ms, self.broker_id, float(v), topic=topic)
+                )
+        for (topic, part), size in data.get("partitions", {}).items():
+            out.append(
+                PartitionMetric(
+                    MetricType.PARTITION_SIZE, now_ms, self.broker_id, float(size),
+                    topic=topic, partition=int(part),
+                )
+            )
+        return out
+
+
+class MetricsReporter:
+    """The reporter loop (reference CruiseControlMetricsReporter.run)."""
+
+    def __init__(
+        self,
+        snapshotter: MetricsRegistrySnapshotter,
+        transport: MetricTransport,
+        *,
+        reporting_interval_ms: int = 60_000,
+    ):
+        self.snapshotter = snapshotter
+        self.transport = transport
+        self.reporting_interval_ms = reporting_interval_ms
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.reported = 0
+
+    def report_once(self, now_ms: int | None = None) -> int:
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        metrics = self.snapshotter.snapshot(now_ms)
+        for m in metrics:
+            self.transport.send(MetricSerde.serialize(m))
+        self.transport.flush()
+        self.reported += len(metrics)
+        return len(metrics)
+
+    def start(self):
+        def loop():
+            while not self._stop.wait(self.reporting_interval_ms / 1000.0):
+                try:
+                    self.report_once()
+                except Exception:  # noqa: BLE001 — reporter must not kill the broker
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="metrics-reporter")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,62 @@
+"""CLI client tests against a live in-process service (reference
+cruise-control-client has no in-repo tests; we hold ours to the service)."""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.client.cccli import ENDPOINTS, build_parser, main
+from cruise_control_tpu.service.main import build_simulated_service
+from cruise_control_tpu.service.server import GET_ENDPOINTS, POST_ENDPOINTS
+
+
+@pytest.fixture(scope="module")
+def service():
+    app, fetcher, admin, sampler = build_simulated_service(seed=7)
+    app.start()
+    yield app
+    app.stop()
+
+
+def run_cli(service, capsys, *argv):
+    rc = main(["-a", f"http://{service.host}:{service.port}", *argv])
+    out = capsys.readouterr().out
+    return rc, json.loads(out)
+
+
+def test_cli_covers_every_endpoint():
+    covered = {spec["endpoint"] for spec in ENDPOINTS.values()}
+    assert set(GET_ENDPOINTS) <= covered
+    assert set(POST_ENDPOINTS) <= covered
+
+
+def test_cli_parameter_validation():
+    p = build_parser()
+    with pytest.raises(SystemExit):
+        p.parse_args(["remove_broker", "--brokers", "abc"])  # not a csv int list
+    with pytest.raises(SystemExit):
+        p.parse_args(["rebalance", "--dryrun", "maybe"])  # not boolean
+    args = p.parse_args(["add_broker", "--brokers", "1,2,3", "--dryrun", "true"])
+    assert args.brokerid == "1,2,3"
+
+
+def test_cli_state(service, capsys):
+    rc, payload = run_cli(service, capsys, "state")
+    assert rc == 0 and "MonitorState" in payload
+
+
+def test_cli_async_proposals(service, capsys):
+    rc, payload = run_cli(service, capsys, "proposals")
+    assert rc == 0 and "balancednessAfter" in payload
+
+
+def test_cli_rebalance_dryrun(service, capsys):
+    rc, payload = run_cli(service, capsys, "rebalance", "--dryrun", "true")
+    assert rc == 0 and "proposals" in payload
+
+
+def test_cli_error_reporting(service, capsys):
+    rc, payload = run_cli(service, capsys, "topic_configuration",
+                          "--topic", "NoSuchTopic", "--replication-factor", "3")
+    assert rc == 0  # unknown topic -> zero proposals, not an error
+    assert payload["numProposals"] == 0

@@ -180,3 +180,19 @@ def test_excluded_brokers_receive_nothing():
         np.asarray(before.replica_broker) != np.asarray(after.replica_broker)
     ) & np.asarray(before.replica_valid)
     assert not (np.asarray(after.replica_broker)[moved] == 0).any()
+
+
+def test_tpu_beats_or_matches_greedy_oracle():
+    """SURVEY §7 hard part (a): the batched annealer must match or beat the
+    reference-style sequential greedy on the aggregate weighted objective."""
+    from cruise_control_tpu.analyzer.greedy import greedy_optimize
+
+    state = random_cluster(RandomClusterSpec(num_brokers=8, num_partitions=80, skew=1.5), seed=21)
+    chain = DEFAULT_CHAIN
+    greedy_final = greedy_optimize(
+        state, chain, max_moves_per_goal=12, candidate_dests=6, seed=21
+    )
+    obj_greedy, _, _ = chain.evaluate(greedy_final)
+
+    res = GoalOptimizer(config=FAST).optimize(state)
+    assert res.objective_after <= float(obj_greedy) * (1 + 1e-4) + 1e-9
