@@ -112,6 +112,10 @@ class CruiseControlMetricsReporterSampler:
             tb_in = topic_bytes_in.get((broker, topic), 0.0)
             tb_out = topic_bytes_out.get((broker, topic), 0.0)
             sizes = np.array([part_size.get((topic, p.partition), 0.0) for p in parts])
+            if tb_in == 0.0 and tb_out == 0.0 and sizes.sum() == 0.0:
+                # nothing reported for this (broker, topic): emitting zero
+                # samples would poison the windows as real measurements
+                continue
             total = sizes.sum()
             shares = sizes / total if total > 0 else np.full(len(parts), 1.0 / max(len(parts), 1))
             # CPU attribution: broker CPU split across leader partitions by
