@@ -221,6 +221,15 @@ class Engine:
         self.total_disk_cap = float((np.asarray(state.disk_capacity) * dmask).sum() + 1e-12)
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
         self._scan = jax.jit(self._make_scan())
+        # Everything per-round must be jitted: eager dispatch of large-array
+        # ops dominates wall-clock on TPU (especially under remote-compile
+        # tunnels) — the scan itself is a few ms/step.
+        self._jit_refresh = jax.jit(self._refresh_aggregates_impl)
+        self._jit_objective = jax.jit(
+            lambda carry: self.chain.evaluate(
+                self.carry_to_state(carry), constraint=self.constraint
+            )[0]
+        )
 
     # ------------------------------------------------------------------
     # state <-> carry
@@ -228,26 +237,23 @@ class Engine:
 
     def init_carry(self, key: jax.Array) -> EngineCarry:
         st = self.state
-        agg = compute_aggregates(st)
-        hseg = jnp.where(st.broker_valid, st.broker_host, st.shape.num_hosts)
-        host_load = jax.ops.segment_sum(
-            agg.broker_load, hseg, num_segments=st.shape.num_hosts + 1
-        )[: st.shape.num_hosts]
-        return EngineCarry(
+        B = st.shape.B
+        zeros = EngineCarry(
             replica_broker=st.replica_broker,
             replica_is_leader=st.replica_is_leader,
             replica_disk=st.replica_disk,
-            broker_load=agg.broker_load,
-            broker_replica_count=agg.broker_replica_count,
-            broker_leader_count=agg.broker_leader_count,
-            broker_potential_nw_out=agg.broker_potential_nw_out,
-            broker_leader_bytes_in=agg.broker_leader_bytes_in,
-            broker_topic_count=agg.broker_topic_count,
-            part_rack_count=agg.part_rack_count,
-            disk_load=agg.disk_load,
-            host_load=host_load,
+            broker_load=jnp.zeros((B, NUM_RESOURCES), jnp.float32),
+            broker_replica_count=jnp.zeros(B, jnp.int32),
+            broker_leader_count=jnp.zeros(B, jnp.int32),
+            broker_potential_nw_out=jnp.zeros(B, jnp.float32),
+            broker_leader_bytes_in=jnp.zeros(B, jnp.float32),
+            broker_topic_count=jnp.zeros((st.shape.num_topics, B), jnp.int32),
+            part_rack_count=jnp.zeros((st.shape.P, st.shape.num_racks), jnp.int32),
+            disk_load=jnp.zeros((B, st.shape.max_disks_per_broker), jnp.float32),
+            host_load=jnp.zeros((st.shape.num_hosts, NUM_RESOURCES), jnp.float32),
             key=key,
         )
+        return self._jit_refresh(zeros)
 
     def carry_to_state(self, carry: EngineCarry) -> ClusterState:
         st = self.state
@@ -858,41 +864,39 @@ class Engine:
         key = jax.random.PRNGKey(cfg.seed)
         carry = self.init_carry(key)
 
-        obj0, _, _ = self.chain.evaluate(self.state)
-        t0 = float(obj0) * cfg.init_temperature_scale
+        t0_obj = float(self._jit_objective(carry)) * cfg.init_temperature_scale
         history = []
         for rnd in range(cfg.num_rounds):
             if rnd == cfg.num_rounds - 1:
                 t_round = 0.0
             else:
-                t_round = t0 * (cfg.temperature_decay**rnd)
+                t_round = t0_obj * (cfg.temperature_decay**rnd)
             temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
             carry, stats = self._scan(carry, temps)
             # re-derive aggregates from placement to wash out float drift
-            carry = self._refresh_aggregates(carry)
+            carry = self._jit_refresh(carry)
             accepted = int(jax.device_get(stats["accepted"]).sum())
             history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
             if verbose:
-                obj, _, _ = self.chain.evaluate(self.carry_to_state(carry))
-                history[-1]["objective"] = float(obj)
+                history[-1]["objective"] = float(self._jit_objective(carry))
         return self.carry_to_state(carry), history
 
-    def _refresh_aggregates(self, carry: EngineCarry) -> EngineCarry:
+    def _refresh_aggregates_impl(self, carry: EngineCarry) -> EngineCarry:
         state = self.carry_to_state(carry)
-        fresh_engine_state = compute_aggregates(state)
+        agg = compute_aggregates(state)
         hseg = jnp.where(state.broker_valid, state.broker_host, state.shape.num_hosts)
         host_load = jax.ops.segment_sum(
-            fresh_engine_state.broker_load, hseg, num_segments=state.shape.num_hosts + 1
+            agg.broker_load, hseg, num_segments=state.shape.num_hosts + 1
         )[: state.shape.num_hosts]
         return dataclasses.replace(
             carry,
-            broker_load=fresh_engine_state.broker_load,
-            broker_replica_count=fresh_engine_state.broker_replica_count,
-            broker_leader_count=fresh_engine_state.broker_leader_count,
-            broker_potential_nw_out=fresh_engine_state.broker_potential_nw_out,
-            broker_leader_bytes_in=fresh_engine_state.broker_leader_bytes_in,
-            broker_topic_count=fresh_engine_state.broker_topic_count,
-            part_rack_count=fresh_engine_state.part_rack_count,
-            disk_load=fresh_engine_state.disk_load,
+            broker_load=agg.broker_load,
+            broker_replica_count=agg.broker_replica_count,
+            broker_leader_count=agg.broker_leader_count,
+            broker_potential_nw_out=agg.broker_potential_nw_out,
+            broker_leader_bytes_in=agg.broker_leader_bytes_in,
+            broker_topic_count=agg.broker_topic_count,
+            part_rack_count=agg.part_rack_count,
+            disk_load=agg.disk_load,
             host_load=host_load,
         )

@@ -98,6 +98,8 @@ class GoalOptimizer:
         verbose: bool = False,
         config: OptimizerConfig | None = None,
     ) -> OptimizerResult:
+        import jax
+
         t0 = time.monotonic()
         validate(state)
         engine = Engine(
@@ -107,9 +109,18 @@ class GoalOptimizer:
             options=options,
             config=config or self.config,
         )
-        obj_b, viol_b, _ = self.chain.evaluate(state, constraint=self.constraint)
+        # one jitted program for objective+violations+stats: eager per-op
+        # dispatch on large models costs orders of magnitude more than the
+        # computation itself
+        report = jax.jit(
+            lambda s: (
+                self.chain.evaluate(s, constraint=self.constraint)[:2],
+                compute_stats(s),
+            )
+        )
+        (obj_b, viol_b), stats_b = report(state)
         final, history = engine.run(verbose=verbose)
-        obj_a, viol_a, _ = self.chain.evaluate(final, constraint=self.constraint)
+        (obj_a, viol_a), stats_a = report(final)
         validate(final)
         viol_b = np.asarray(viol_b)
         viol_a = np.asarray(viol_a)
@@ -118,8 +129,8 @@ class GoalOptimizer:
             proposals=extract_proposals(state, final),
             state_before=state,
             state_after=final,
-            stats_before=compute_stats(state),
-            stats_after=compute_stats(final),
+            stats_before=stats_b,
+            stats_after=stats_a,
             goal_names=self.chain.names(),
             violations_before=viol_b,
             violations_after=viol_a,

@@ -54,59 +54,77 @@ class ExecutionProposal:
 
 def extract_proposals(before: ClusterState, after: ClusterState) -> list[ExecutionProposal]:
     """Diff two placements into per-partition proposals
-    (reference analyzer/AnalyzerUtils.getDiff:50-117)."""
+    (reference analyzer/AnalyzerUtils.getDiff:50-117).
+
+    Vectorized over a padded [P, max_rf] partition-replica table: at
+    LinkedIn scale a rebalance touches >100k partitions and per-partition
+    numpy slicing would dominate the optimizer wall-clock.
+    """
+    from cruise_control_tpu.analyzer.engine import partition_replica_table
+
     valid = np.asarray(before.replica_valid)
-    part = np.asarray(before.replica_partition)[valid]
-    topic = np.asarray(before.replica_topic)[valid]
-    pos = np.asarray(before.replica_pos)[valid]
-    b_old = np.asarray(before.replica_broker)[valid]
-    b_new = np.asarray(after.replica_broker)[valid]
-    l_old = np.asarray(before.replica_is_leader)[valid]
-    l_new = np.asarray(after.replica_is_leader)[valid]
-    d_old = np.asarray(before.replica_disk)[valid]
-    d_new = np.asarray(after.replica_disk)[valid]
-    disk_bytes = np.asarray(before.replica_load_leader)[valid][:, int(Resource.DISK)]
+    topic = np.asarray(before.replica_topic)
+    b_old = np.asarray(before.replica_broker)
+    b_new = np.asarray(after.replica_broker)
+    l_old = np.asarray(before.replica_is_leader)
+    l_new = np.asarray(after.replica_is_leader)
+    d_old = np.asarray(before.replica_disk)
+    d_new = np.asarray(after.replica_disk)
+    disk_bytes = np.asarray(before.replica_load_leader)[:, int(Resource.DISK)]
 
-    changed = (b_old != b_new) | (l_old != l_new) | (d_old != d_new)
-    touched = np.unique(part[changed])
-    if touched.size == 0:
+    changed = valid & ((b_old != b_new) | (l_old != l_new) | (d_old != d_new))
+    if not changed.any():
         return []
+    touched = np.unique(np.asarray(before.replica_partition)[changed])
 
-    # group replica rows by partition
-    order = np.argsort(part, kind="stable")
+    # padded per-partition replica rows, already in preferred (pos) order
+    table = partition_replica_table(before)[touched]  # [N, max_rf]
+    R = before.shape.R
+    mask = table < R  # [N, max_rf]
+    rows = np.minimum(table, R - 1)
+
+    tb_old = np.where(mask, b_old[rows], -1)
+    tb_new = np.where(mask, b_new[rows], -1)
+    tl_old = np.where(mask, l_old[rows], False)
+    tl_new = np.where(mask, l_new[rows], False)
+    td_old = np.where(mask, d_old[rows], 0)
+    td_new = np.where(mask, d_new[rows], 0)
+    old_leader = np.where(
+        tl_old.any(1), tb_old[np.arange(len(touched)), tl_old.argmax(1)], -1
+    )
+    new_leader = np.where(
+        tl_new.any(1), tb_new[np.arange(len(touched)), tl_new.argmax(1)], -1
+    )
+    moved = mask & (tb_old != tb_new)
+    data = np.where(moved, disk_bytes[rows], 0.0).sum(1)
+    disk_changed = mask & (tb_old == tb_new) & (td_old != td_new)
+    t_topic = topic[rows[:, 0]]
+
+    def ordered(brokers, leader):
+        lst = [int(x) for x in brokers if x >= 0]
+        if leader in lst:
+            lst.remove(leader)
+            lst.insert(0, leader)
+        return tuple(lst)
+
     proposals: list[ExecutionProposal] = []
-    bounds = np.searchsorted(part[order], [touched, touched + 1])
     for k, p in enumerate(touched):
-        rows = order[bounds[0][k]: bounds[1][k]]
-        rows = rows[np.argsort(pos[rows], kind="stable")]  # preferred order
-        ol = rows[l_old[rows]]
-        nl = rows[l_new[rows]]
-        old_leader = int(b_old[ol[0]]) if ol.size else -1
-        new_leader = int(b_new[nl[0]]) if nl.size else -1
-
-        def ordered(brokers, leader):
-            lst = [int(x) for x in brokers]
-            if leader in lst:
-                lst.remove(leader)
-                lst.insert(0, leader)
-            return tuple(lst)
-
-        moved = rows[b_old[rows] != b_new[rows]]
-        disk_moves = tuple(
-            (int(b_new[r]), int(d_old[r]), int(d_new[r]))
-            for r in rows
-            if b_old[r] == b_new[r] and d_old[r] != d_new[r]
-        )
+        disk_moves = ()
+        if disk_changed[k].any():
+            disk_moves = tuple(
+                (int(tb_new[k, j]), int(td_old[k, j]), int(td_new[k, j]))
+                for j in np.nonzero(disk_changed[k])[0]
+            )
         proposals.append(
             ExecutionProposal(
                 partition=int(p),
-                topic=int(topic[rows[0]]),
-                old_leader=old_leader,
-                new_leader=new_leader,
-                old_replicas=ordered(b_old[rows], old_leader),
-                new_replicas=ordered(b_new[rows], new_leader),
+                topic=int(t_topic[k]),
+                old_leader=int(old_leader[k]),
+                new_leader=int(new_leader[k]),
+                old_replicas=ordered(tb_old[k], int(old_leader[k])),
+                new_replicas=ordered(tb_new[k], int(new_leader[k])),
                 disk_moves=disk_moves,
-                inter_broker_data_to_move=float(disk_bytes[moved].sum()),
+                inter_broker_data_to_move=float(data[k]),
             )
         )
     return proposals

@@ -1,0 +1,58 @@
+"""Profile the SA engine step on the current jax backend."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import DEFAULT_CHAIN, Engine, OptimizerConfig
+from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+NORTH = RandomClusterSpec(
+    num_brokers=2600, num_racks=52, num_topics=200, num_partitions=200_000,
+    min_replication=2, max_replication=3, skew=0.5,
+    broker_capacity=(100.0, 500_000.0, 500_000.0, 5_000_000.0),
+    mean_cpu=0.15, mean_nw_in=400.0, mean_nw_out=500.0, mean_disk=4000.0,
+)
+MID = RandomClusterSpec(
+    num_brokers=500, num_racks=20, num_topics=100, num_partitions=50_000, skew=0.5,
+    broker_capacity=(100.0, 300_000.0, 300_000.0, 3_000_000.0),
+    mean_cpu=0.2, mean_nw_in=500.0, mean_nw_out=600.0, mean_disk=5000.0,
+)
+
+
+def timed_scan(state, K, Kl, steps, label):
+    cfg = OptimizerConfig(num_candidates=K, leadership_candidates=Kl,
+                          steps_per_round=steps, num_rounds=1)
+    t0 = time.time()
+    eng = Engine(state, DEFAULT_CHAIN, config=cfg)
+    carry = eng.init_carry(jax.random.PRNGKey(0))
+    jax.block_until_ready(carry.broker_load)
+    t_init = time.time() - t0
+    temps = jnp.zeros((steps,), jnp.float32)
+    t0 = time.time()
+    carry2, stats = eng._scan(carry, temps)
+    jax.block_until_ready(carry2.broker_load)
+    t_compile_and_run = time.time() - t0
+    t0 = time.time()
+    carry3, stats = eng._scan(carry, temps)
+    jax.block_until_ready(carry3.broker_load)
+    t_run = time.time() - t0
+    print(f"{label}: init={t_init:.2f}s compile+run={t_compile_and_run:.1f}s "
+          f"run={t_run:.3f}s per_step={1000*t_run/steps:.2f}ms "
+          f"accepted={int(jax.device_get(stats['accepted']).sum())}")
+    return t_run / steps
+
+
+print("device:", jax.devices()[0])
+mid = random_cluster_fast(MID, seed=42)
+north = random_cluster_fast(NORTH, seed=42)
+
+timed_scan(mid, 4096, 1024, 16, "mid   K=4096")
+timed_scan(mid, 1024, 256, 16, "mid   K=1024")
+timed_scan(north, 4096, 1024, 16, "north K=4096")
+timed_scan(north, 1024, 256, 16, "north K=1024")
+timed_scan(north, 16384, 4096, 16, "north K=16384")
