@@ -69,20 +69,19 @@ def main():
             state, sc = build_cluster(sc)
             gen_s = time.monotonic() - t_gen
             cfg = OptimizerConfig(
-                num_candidates=4096,
-                leadership_candidates=1024,
+                num_candidates=16384,
+                leadership_candidates=4096,
                 steps_per_round=64,
                 num_rounds=8,
                 seed=0,
             )
             opt = GoalOptimizer(config=cfg)
             # warm-up run compiles the engine for this cluster shape; the
-            # measured run reflects steady-state service behavior, where the
-            # proposal precompute loop reuses the compiled program
-            # (reference GoalOptimizer proposal cache, analyzer/GoalOptimizer.java:276).
-            warm = opt.optimize(state, config=OptimizerConfig(
-                num_candidates=4096, leadership_candidates=1024,
-                steps_per_round=64, num_rounds=1, seed=0))
+            # measured run rebinds the cached engine (zero recompilation) —
+            # steady-state service behavior, where the proposal precompute
+            # loop reuses the compiled program (reference GoalOptimizer
+            # proposal cache, analyzer/GoalOptimizer.java:276).
+            warm = opt.optimize(state, config=cfg)
             t0 = time.monotonic()
             res = opt.optimize(state)
             wall = time.monotonic() - t0

@@ -16,12 +16,21 @@ with O(#goals) veto checks) with a TPU-shaped search:
 Objective semantics match GoalChain (analyzer/objective.py): weighted
 lexicographic goal violations + a dispersion tiebreaker.  The delta path
 and the full-eval path (goal classes) are kept consistent by unit test
-(tests/test_engine.py).
+(tests/test_optimizer.py).
 
 Simulated annealing: a candidate is accepted if delta < -T·log(u) — at
 T=0 this is pure greedy improvement; early rounds use T>0 to escape the
 local optima the reference needs explicit swap moves for (reference
 ResourceDistributionGoal.java:502-599; SURVEY §7 hard part (b)).
+
+Compilation model: all cluster data rides in an `EngineStatics` pytree
+passed as a runtime ARGUMENT to the jitted programs — never closed over.
+Closure-captured arrays become XLA constants, which (a) forces a
+recompile per model generation and (b) makes those compiles pathologically
+slow at 500k-replica scale.  With statics-as-arguments one Engine per
+ClusterShape serves every model generation; `rebind()` swaps in fresh
+data with zero recompilation (the TPU analog of the reference's proposal
+precompute amortization, GoalOptimizer.java:124-175).
 """
 
 from __future__ import annotations
@@ -37,8 +46,8 @@ from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
-from cruise_control_tpu.models.aggregates import BrokerAggregates, compute_aggregates
-from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.models.aggregates import compute_aggregates
+from cruise_control_tpu.models.state import ClusterShape, ClusterState
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +101,42 @@ class EngineCarry:
     key: jax.Array
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "state",
+        "part_replicas",
+        "alive",
+        "dest_ids",
+        "lead_ok",
+        "topic_movable",
+        "host_multi",
+        "host_cap",
+        "total_cap",
+        "n_alive",
+        "n_valid",
+        "total_disk_cap",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class EngineStatics:
+    """Per-model-generation inputs, passed (not closed over) into jit."""
+
+    state: ClusterState
+    part_replicas: jax.Array  # i32[P, max_rf]
+    alive: jax.Array  # bool[B] valid & alive
+    dest_ids: jax.Array  # i32[B] allowed destination ids, cyclically padded
+    lead_ok: jax.Array  # bool[B]
+    topic_movable: jax.Array  # bool[T]
+    host_multi: jax.Array  # bool[H]
+    host_cap: jax.Array  # f32[H, 4]
+    total_cap: jax.Array  # f32[4]
+    n_alive: jax.Array  # f32 scalar
+    n_valid: jax.Array  # f32 scalar
+    total_disk_cap: jax.Array  # f32 scalar
+
+
 def partition_replica_table(state: ClusterState) -> np.ndarray:
     """i32[P, max_rf] replica indices per partition, padded with R.
 
@@ -112,6 +157,46 @@ def partition_replica_table(state: ClusterState) -> np.ndarray:
     slot = np.minimum(pos[idx], max_rf - 1)
     table[part[idx], slot] = idx
     return table
+
+
+def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineStatics:
+    """Host-side (numpy) preprocessing of one model generation."""
+    s = state.shape
+    alive = np.asarray(state.broker_valid) & np.asarray(state.broker_alive)
+    cap = np.asarray(state.broker_capacity)
+    dest = alive & options.dest_allowed(state)
+    dest_idx = np.nonzero(dest)[0].astype(np.int32)
+    if dest_idx.size == 0:
+        dest_idx = np.nonzero(alive)[0].astype(np.int32)
+    if dest_idx.size == 0:
+        dest_idx = np.zeros(1, np.int32)
+    # cyclic pad to [B]: uniform sampling over the padded list stays uniform
+    # over the allowed set while the array shape stays generation-invariant
+    dest_pad = dest_idx[np.arange(s.B) % dest_idx.size]
+    host = np.asarray(state.broker_host)
+    valid_b = np.asarray(state.broker_valid)
+    bph = np.bincount(host[valid_b], minlength=s.num_hosts)
+    host_cap = np.zeros((s.num_hosts, NUM_RESOURCES), np.float32)
+    np.add.at(host_cap, host[valid_b & alive], cap[valid_b & alive])
+    dmask = np.asarray(state.disk_alive) & alive[:, None]
+    return EngineStatics(
+        state=state,
+        part_replicas=jnp.asarray(partition_replica_table(state)),
+        alive=jnp.asarray(alive),
+        dest_ids=jnp.asarray(dest_pad),
+        lead_ok=jnp.asarray(alive & options.leadership_allowed(state)),
+        topic_movable=jnp.asarray(options.topic_movable(state)),
+        host_multi=jnp.asarray(bph > 1),
+        host_cap=jnp.asarray(host_cap),
+        total_cap=jnp.asarray((cap * alive[:, None]).sum(0) + 1e-12, dtype=jnp.float32),
+        n_alive=jnp.asarray(max(1.0, float(alive.sum())), jnp.float32),
+        n_valid=jnp.asarray(
+            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
+        ),
+        total_disk_cap=jnp.asarray(
+            float((np.asarray(state.disk_capacity) * dmask).sum() + 1e-12), jnp.float32
+        ),
+    )
 
 
 def _weights_by_name(chain: GoalChain) -> dict[str, float]:
@@ -177,12 +262,12 @@ def _relu(x):
 
 
 class Engine:
-    """Compiled optimization engine bound to one cluster shape.
+    """Compiled optimization engine bound to one ClusterShape.
 
-    Construction precomputes static topology tensors; `run` executes the
-    annealing schedule and returns final placement.  Rebinding per model
-    generation is cheap relative to one XLA compile, and recompilation only
-    happens when the padded ClusterShape changes (pad-and-mask, SURVEY §7).
+    Trace-static: shape, goal weights, constraint thresholds, search
+    config.  Runtime: EngineStatics (cluster data) + EngineCarry.  Reuse
+    the same Engine across model generations via `rebind(state)`; only a
+    changed ClusterShape (padded sizes) triggers recompilation.
     """
 
     def __init__(
@@ -193,51 +278,40 @@ class Engine:
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
     ):
-        self.state = state
         self.chain = chain
         self.constraint = constraint
-        self.options = options
         self.config = config
         self.w = _Weights.from_chain(chain)
-        s = state.shape
-
-        # --- static host-side precomputation ---
-        self.part_replicas = jnp.asarray(partition_replica_table(state))  # [P, max_rf]
-        alive = np.asarray(state.broker_valid) & np.asarray(state.broker_alive)
-        self.alive = jnp.asarray(alive)
-        self.n_alive = max(1, int(alive.sum()))
-        cap = np.asarray(state.broker_capacity)
-        self.total_cap = jnp.asarray((cap * alive[:, None]).sum(0) + 1e-12)  # [4]
-        self.n_valid = max(1, int(np.asarray(state.replica_valid).sum()))
-        dest = alive & options.dest_allowed(state)
-        self.dest_ids = jnp.asarray(np.nonzero(dest)[0].astype(np.int32))
-        lead_ok = alive & options.leadership_allowed(state)
-        self.lead_ok = jnp.asarray(lead_ok)
-        self.topic_movable = jnp.asarray(options.topic_movable(state))
-        host = np.asarray(state.broker_host)
-        bph = np.bincount(host[np.asarray(state.broker_valid)], minlength=s.num_hosts)
-        self.host_multi = jnp.asarray(bph > 1)
-        dmask = np.asarray(state.disk_alive) & alive[:, None]
-        self.total_disk_cap = float((np.asarray(state.disk_capacity) * dmask).sum() + 1e-12)
+        self.shape: ClusterShape = state.shape
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
-        self._scan = jax.jit(self._make_scan())
-        # Everything per-round must be jitted: eager dispatch of large-array
-        # ops dominates wall-clock on TPU (especially under remote-compile
-        # tunnels) — the scan itself is a few ms/step.
-        self._jit_refresh = jax.jit(self._refresh_aggregates_impl)
-        self._jit_objective = jax.jit(
-            lambda carry: self.chain.evaluate(
-                self.carry_to_state(carry), constraint=self.constraint
-            )[0]
-        )
+        self.statics = build_statics(state, options)
+        self._scan = jax.jit(self._scan_impl)
+        self._jit_refresh = jax.jit(self._refresh_impl)
+        self._jit_objective = jax.jit(self._objective_impl)
+
+    # convenience for call sites that held `engine.state`
+    @property
+    def state(self) -> ClusterState:
+        return self.statics.state
+
+    def rebind(
+        self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS
+    ) -> "Engine":
+        """Swap in a new model generation without recompiling."""
+        if state.shape != self.shape:
+            raise ValueError(
+                f"shape changed {self.shape} -> {state.shape}; build a new Engine"
+            )
+        self.statics = build_statics(state, options)
+        return self
 
     # ------------------------------------------------------------------
     # state <-> carry
     # ------------------------------------------------------------------
 
     def init_carry(self, key: jax.Array) -> EngineCarry:
-        st = self.state
-        B = st.shape.B
+        st = self.statics.state
+        B = self.shape.B
         zeros = EngineCarry(
             replica_broker=st.replica_broker,
             replica_is_leader=st.replica_is_leader,
@@ -247,16 +321,16 @@ class Engine:
             broker_leader_count=jnp.zeros(B, jnp.int32),
             broker_potential_nw_out=jnp.zeros(B, jnp.float32),
             broker_leader_bytes_in=jnp.zeros(B, jnp.float32),
-            broker_topic_count=jnp.zeros((st.shape.num_topics, B), jnp.int32),
-            part_rack_count=jnp.zeros((st.shape.P, st.shape.num_racks), jnp.int32),
-            disk_load=jnp.zeros((B, st.shape.max_disks_per_broker), jnp.float32),
-            host_load=jnp.zeros((st.shape.num_hosts, NUM_RESOURCES), jnp.float32),
+            broker_topic_count=jnp.zeros((self.shape.num_topics, B), jnp.int32),
+            part_rack_count=jnp.zeros((self.shape.P, self.shape.num_racks), jnp.int32),
+            disk_load=jnp.zeros((B, self.shape.max_disks_per_broker), jnp.float32),
+            host_load=jnp.zeros((self.shape.num_hosts, NUM_RESOURCES), jnp.float32),
             key=key,
         )
-        return self._jit_refresh(zeros)
+        return self._jit_refresh(self.statics, zeros)
 
-    def carry_to_state(self, carry: EngineCarry) -> ClusterState:
-        st = self.state
+    def carry_to_state(self, carry: EngineCarry, sx: EngineStatics | None = None) -> ClusterState:
+        st = (sx or self.statics).state
         offline = ~(
             st.broker_alive[carry.replica_broker]
             & st.disk_alive[carry.replica_broker, carry.replica_disk]
@@ -269,17 +343,43 @@ class Engine:
             replica_offline=offline & st.replica_valid,
         )
 
+    def _refresh_impl(self, sx: EngineStatics, carry: EngineCarry) -> EngineCarry:
+        state = self.carry_to_state(carry, sx)
+        agg = compute_aggregates(state)
+        hseg = jnp.where(state.broker_valid, state.broker_host, self.shape.num_hosts)
+        host_load = jax.ops.segment_sum(
+            agg.broker_load, hseg, num_segments=self.shape.num_hosts + 1
+        )[: self.shape.num_hosts]
+        return dataclasses.replace(
+            carry,
+            broker_load=agg.broker_load,
+            broker_replica_count=agg.broker_replica_count,
+            broker_leader_count=agg.broker_leader_count,
+            broker_potential_nw_out=agg.broker_potential_nw_out,
+            broker_leader_bytes_in=agg.broker_leader_bytes_in,
+            broker_topic_count=agg.broker_topic_count,
+            part_rack_count=agg.part_rack_count,
+            disk_load=agg.disk_load,
+            host_load=host_load,
+        )
+
+    def _objective_impl(self, sx: EngineStatics, carry: EngineCarry):
+        obj, _, _ = self.chain.evaluate(
+            self.carry_to_state(carry, sx), constraint=self.constraint
+        )
+        return obj
+
     # ------------------------------------------------------------------
     # objective terms
     # ------------------------------------------------------------------
 
-    def _globals(self, carry: EngineCarry):
+    def _globals(self, sx: EngineStatics, carry: EngineCarry):
         """Per-step frozen global scalars, O(B + T·B) from aggregates."""
-        st = self.state
-        am = self.alive
+        st = sx.state
+        am = sx.alive
         load = jnp.where(am[:, None], carry.broker_load, 0.0)
         total_load = load.sum(0)  # [4]
-        avg_pct = total_load / self.total_cap
+        avg_pct = total_load / sx.total_cap
         counts = jnp.where(am, carry.broker_replica_count, 0)
         total_count = counts.sum()
         lcounts = jnp.where(am, carry.broker_leader_count, 0)
@@ -294,19 +394,19 @@ class Engine:
         return dict(
             total_load=total_load,
             avg_pct=avg_pct,
-            avg_count=total_count.astype(jnp.float32) / self.n_alive,
+            avg_count=total_count.astype(jnp.float32) / sx.n_alive,
             total_count=jnp.maximum(total_count.astype(jnp.float32), 1.0),
-            avg_lcount=total_lcount.astype(jnp.float32) / self.n_alive,
+            avg_lcount=total_lcount.astype(jnp.float32) / sx.n_alive,
             total_lcount=jnp.maximum(total_lcount.astype(jnp.float32), 1.0),
-            avg_lbin=total_lbin / self.n_alive,
+            avg_lbin=total_lbin / sx.n_alive,
             total_lbin=total_lbin + 1e-12,
-            topic_avg=topic_total.astype(jnp.float32) / self.n_alive,
+            topic_avg=topic_total.astype(jnp.float32) / sx.n_alive,
             total_disk_load=total_disk_load + 1e-12,
             pct_sum=pct.sum(0),  # [4]
             pct_sumsq=(pct * pct).sum(0),  # [4]
         )
 
-    def _broker_terms(self, b, load, rcount, lcount, pot, lbin, g):
+    def _broker_terms(self, sx, b, load, rcount, lcount, pot, lbin, g):
         """Weighted objective contribution of broker(s) b given hypothetical
         per-broker stats.  All inputs may carry a leading candidate axis.
 
@@ -316,31 +416,31 @@ class Engine:
         LeaderBytesInDistributionGoal — see the goal classes for the
         reference citations.
         """
-        st = self.state
+        st = sx.state
         w = self.w
         c = self.constraint
         cap = st.broker_capacity[b]  # [..., 4]
-        alive = self.alive[b]
+        alive = sx.alive[b]
         out = jnp.zeros(jnp.shape(b), jnp.float32)
 
         # capacity goals (broker granularity; host granularity handled in
         # _host_terms for multi-broker hosts)
-        single = ~self.host_multi[st.broker_host[b]]
+        single = ~sx.host_multi[st.broker_host[b]]
         for r in range(NUM_RESOURCES):
             thresh = c.capacity_threshold[r]
             excess = _relu(load[..., r] - thresh * cap[..., r])
             host_res = Resource(r).is_host_resource
             use_broker = single if host_res else jnp.ones_like(single)
-            out += w.cap[r] * jnp.where(alive & use_broker, excess, 0.0) / self.total_cap[r]
+            out += w.cap[r] * jnp.where(alive & use_broker, excess, 0.0) / sx.total_cap[r]
 
         # replica capacity
         exc = _relu((rcount - c.max_replicas_per_broker).astype(jnp.float32))
-        out += w.replica_cap * jnp.where(alive, exc, 0.0) / self.n_valid
+        out += w.replica_cap * jnp.where(alive, exc, 0.0) / sx.n_valid
 
         # potential nw out
         r = int(Resource.NW_OUT)
         exc = _relu(pot - c.capacity_threshold[r] * cap[..., r])
-        out += w.pot_nw_out * jnp.where(alive, exc, 0.0) / self.total_cap[r]
+        out += w.pot_nw_out * jnp.where(alive, exc, 0.0) / sx.total_cap[r]
 
         # resource distribution bands
         for r in range(NUM_RESOURCES):
@@ -373,49 +473,38 @@ class Engine:
 
         return out
 
-    def _host_terms(self, h, hload):
+    def _host_terms(self, sx, h, hload):
         """Host-granularity capacity terms for multi-broker hosts
         (reference CapacityGoal host/broker split)."""
-        st = self.state
         c = self.constraint
-        w = self.w
-        # host capacity: sum of alive member broker capacities — static
-        if not hasattr(self, "_host_cap"):
-            cap = jnp.where(self.alive[:, None], self.state.broker_capacity, 0.0)
-            hseg = jnp.where(
-                st.broker_valid, st.broker_host, st.shape.num_hosts
-            )
-            self._host_cap = jax.ops.segment_sum(
-                cap, hseg, num_segments=st.shape.num_hosts + 1
-            )[: st.shape.num_hosts]
-        hcap = self._host_cap[h]
-        multi = self.host_multi[h]
+        hcap = sx.host_cap[h]
+        multi = sx.host_multi[h]
         out = jnp.zeros(jnp.shape(h), jnp.float32)
         for r in range(NUM_RESOURCES):
             if not Resource(r).is_host_resource:
                 continue
             excess = _relu(hload[..., r] - c.capacity_threshold[r] * hcap[..., r])
-            out += self.w.cap[r] * jnp.where(multi, excess, 0.0) / self.total_cap[r]
+            out += self.w.cap[r] * jnp.where(multi, excess, 0.0) / sx.total_cap[r]
         return out
 
-    def _disk_terms(self, b, disk_row, broker_disk_load, g):
+    def _disk_terms(self, sx, b, disk_row, broker_disk_load, g):
         """Intra-broker disk goal terms for broker(s) b.
 
         disk_row: hypothetical f32[..., D] per-logdir load of broker b.
         broker_disk_load: its sum (for the per-broker distribution band).
         """
-        st = self.state
+        st = sx.state
         w = self.w
         if w.intra_cap == 0.0 and w.intra_dist == 0.0:
             return jnp.zeros(jnp.shape(b), jnp.float32)
         dcap = st.disk_capacity[b]  # [..., D]
-        dalive = st.disk_alive[b] & self.alive[b][..., None]
+        dalive = st.disk_alive[b] & sx.alive[b][..., None]
         out = jnp.zeros(jnp.shape(b), jnp.float32)
         # IntraBrokerDiskCapacityGoal
         cap_term = jnp.where(
             dalive, _relu(disk_row - self.d_thresh * dcap), disk_row
         ).sum(-1)
-        out += w.intra_cap * cap_term / self.total_disk_cap
+        out += w.intra_cap * cap_term / sx.total_disk_cap
         # IntraBrokerDiskUsageDistributionGoal
         bcap = jnp.where(dalive, dcap, 0.0).sum(-1, keepdims=True)
         avg_pct = broker_disk_load[..., None] / (bcap + 1e-12)
@@ -426,9 +515,9 @@ class Engine:
         out += w.intra_dist * dist / g["total_disk_load"]
         return out
 
-    def _tie_term(self, pct_sum, pct_sumsq):
+    def _tie_term(self, sx, pct_sum, pct_sumsq):
         """Dispersion tiebreaker: sum over resources of std of utilization pct."""
-        n = self.n_alive
+        n = sx.n_alive
         var = _relu(pct_sumsq / n - (pct_sum / n) ** 2)
         return self.w.tie * jnp.sqrt(var + 1e-18).sum()
 
@@ -436,14 +525,14 @@ class Engine:
     # candidate generation + delta evaluation
     # ------------------------------------------------------------------
 
-    def _replica_candidates(self, carry: EngineCarry, key: jax.Array, g):
+    def _replica_candidates(self, sx, carry: EngineCarry, key: jax.Array, g):
         """K_r replica-move candidates -> (delta, src, dst, part, payload)."""
-        st = self.state
+        st = sx.state
         cfg = self.config
         K = cfg.num_candidates - cfg.leadership_candidates
         k1, k2 = jax.random.split(key)
-        r = jax.random.randint(k1, (K,), 0, st.shape.R)
-        dst = self.dest_ids[jax.random.randint(k2, (K,), 0, self.dest_ids.shape[0])]
+        r = jax.random.randint(k1, (K,), 0, self.shape.R)
+        dst = sx.dest_ids[jax.random.randint(k2, (K,), 0, sx.dest_ids.shape[0])]
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
 
@@ -451,13 +540,15 @@ class Engine:
         offline = ~(
             st.broker_alive[src] & st.disk_alive[src, carry.replica_disk[r]]
         )
-        movable = self.topic_movable[st.replica_topic[r]] | offline
+        movable = sx.topic_movable[st.replica_topic[r]] | offline
         feasible = st.replica_valid[r] & movable & (src != dst)
         # no second replica of the partition on dst (reference
         # ClusterModel.relocateReplica precondition)
-        members = self.part_replicas[part]  # [K, max_rf]
+        members = sx.part_replicas[part]  # [K, max_rf]
         member_broker = jnp.where(
-            members < st.shape.R, carry.replica_broker[jnp.minimum(members, st.shape.R - 1)], -1
+            members < self.shape.R,
+            carry.replica_broker[jnp.minimum(members, self.shape.R - 1)],
+            -1,
         )
         feasible &= ~(member_broker == dst[:, None]).any(axis=1)
 
@@ -479,6 +570,7 @@ class Engine:
         dlcount = is_lead.astype(jnp.int32)
 
         delta = self._move_delta(
+            sx,
             carry,
             g,
             src=src,
@@ -499,7 +591,7 @@ class Engine:
         c_s = carry.part_rack_count[part, rack_s].astype(jnp.float32)
         c_d = carry.part_rack_count[part, rack_d].astype(jnp.float32)
         drack = (_relu(c_s - 2.0) - _relu(c_s - 1.0)) + (_relu(c_d) - _relu(c_d - 1.0))
-        delta += self.w.rack * jnp.where(rack_s != rack_d, drack, 0.0) / self.n_valid
+        delta += self.w.rack * jnp.where(rack_s != rack_d, drack, 0.0) / sx.n_valid
 
         # topic cells (reference TopicReplicaDistributionGoal)
         if self.w.topic_dist != 0.0:
@@ -519,7 +611,7 @@ class Engine:
         # offline-replica term (reference OptimizationVerifier BROKEN_BROKERS)
         dst_ok = st.broker_alive[dst] & st.disk_alive[dst, d_dst]
         doff = (~dst_ok).astype(jnp.float32) - offline.astype(jnp.float32)
-        delta += self.w.offline * doff / self.n_valid
+        delta += self.w.offline * doff / sx.n_valid
 
         # preferred-leader eligibility shift (reference PreferredLeaderElectionGoal)
         if self.w.pref_leader != 0.0:
@@ -529,22 +621,23 @@ class Engine:
             delta += (
                 self.w.pref_leader
                 * (now.astype(jnp.float32) - was.astype(jnp.float32))
-                / max(1, st.shape.P)
+                / max(1, self.shape.P)
             )
 
         payload = dict(kind=0, r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
                        pot=pot, lbin=lbin, d_src=d_src)
         return delta, feasible, src, dst, part, payload
 
-    def _leadership_candidates(self, carry: EngineCarry, key: jax.Array, g):
+    def _leadership_candidates(self, sx, carry: EngineCarry, key: jax.Array, g):
         """K_l leadership-transfer candidates (reference relocateLeadership:374)."""
-        st = self.state
+        st = sx.state
         K = self.config.leadership_candidates
-        rt = jax.random.randint(key, (K,), 0, st.shape.R)
+        R = self.shape.R
+        rt = jax.random.randint(key, (K,), 0, R)
         part = st.replica_partition[rt]
-        members = self.part_replicas[part]  # [K, max_rf]
-        m_valid = members < st.shape.R
-        m_idx = jnp.minimum(members, st.shape.R - 1)
+        members = sx.part_replicas[part]  # [K, max_rf]
+        m_valid = members < R
+        m_idx = jnp.minimum(members, R - 1)
         m_lead = carry.replica_is_leader[m_idx] & m_valid
         rf = m_idx[jnp.arange(K), jnp.argmax(m_lead, axis=1)]
 
@@ -555,7 +648,7 @@ class Engine:
             & ~carry.replica_is_leader[rt]
             & m_lead.any(axis=1)
             & dst_ok
-            & self.lead_ok[dst]
+            & sx.lead_ok[dst]
         )
 
         # load shift: rf leader->follower on src, rt follower->leader on dst
@@ -564,6 +657,7 @@ class Engine:
         dlbin = st.replica_load_leader[rt, int(Resource.NW_IN)]  # gained by dst
         # NOTE: src loses rf's leader NW_IN; handled via asymmetric lbin deltas
         delta = self._move_delta(
+            sx,
             carry,
             g,
             src=src,
@@ -588,7 +682,7 @@ class Engine:
             delta += (
                 self.w.pref_leader
                 * (pref_f.astype(jnp.float32) - pref_t.astype(jnp.float32))
-                / max(1, st.shape.P)
+                / max(1, self.shape.P)
             )
 
         payload = dict(kind=1, rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
@@ -598,6 +692,7 @@ class Engine:
 
     def _move_delta(
         self,
+        sx,
         carry,
         g,
         *,
@@ -621,7 +716,7 @@ class Engine:
         load); dload_dst is added to dst.  dcount/dlcount/dpot/dlbin move
         from src to dst unless an asymmetric *_src override is given.
         """
-        st = self.state
+        st = sx.state
         if dlbin_src is None:
             dlbin_src = dlbin
         if ddisk_src is None:
@@ -638,13 +733,13 @@ class Engine:
 
         ls, rs, lcs, ps, lbs = gather(src)
         ld, rd, lcd, pd, lbd = gather(dst)
-        old = self._broker_terms(src, ls, rs, lcs, ps, lbs, g) + self._broker_terms(
-            dst, ld, rd, lcd, pd, lbd, g
+        old = self._broker_terms(sx, src, ls, rs, lcs, ps, lbs, g) + self._broker_terms(
+            sx, dst, ld, rd, lcd, pd, lbd, g
         )
         new = self._broker_terms(
-            src, ls + dload_src, rs - dcount, lcs - dlcount, ps - dpot, lbs - dlbin_src, g
+            sx, src, ls + dload_src, rs - dcount, lcs - dlcount, ps - dpot, lbs - dlbin_src, g
         ) + self._broker_terms(
-            dst, ld + dload_dst, rd + dcount, lcd + dlcount, pd + dpot, lbd + dlbin, g
+            sx, dst, ld + dload_dst, rd + dcount, lcd + dlcount, pd + dpot, lbd + dlbin, g
         )
         delta = new - old
 
@@ -652,27 +747,27 @@ class Engine:
         h_s, h_d = st.broker_host[src], st.broker_host[dst]
         hl_s, hl_d = carry.host_load[h_s], carry.host_load[h_d]
         dh = (
-            self._host_terms(h_s, hl_s + dload_src)
-            - self._host_terms(h_s, hl_s)
-            + self._host_terms(h_d, hl_d + dload_dst)
-            - self._host_terms(h_d, hl_d)
+            self._host_terms(sx, h_s, hl_s + dload_src)
+            - self._host_terms(sx, h_s, hl_s)
+            + self._host_terms(sx, h_d, hl_d + dload_dst)
+            - self._host_terms(sx, h_d, hl_d)
         )
         delta += jnp.where(h_s != h_d, dh, 0.0)
 
         # intra-broker disk goals
         if self.w.intra_cap != 0.0 or self.w.intra_dist != 0.0:
             row_s, row_d = carry.disk_load[src], carry.disk_load[dst]
-            D = st.shape.max_disks_per_broker
+            D = self.shape.max_disks_per_broker
             oh_s = jax.nn.one_hot(d_src, D, dtype=jnp.float32)
             oh_d = jax.nn.one_hot(d_dst, D, dtype=jnp.float32)
             row_s2 = row_s - oh_s * ddisk_src[:, None]
             row_d2 = row_d + oh_d * ddisk[:, None]
             bsum_s, bsum_d = row_s.sum(-1), row_d.sum(-1)
             delta += (
-                self._disk_terms(src, row_s2, bsum_s - ddisk_src, g)
-                - self._disk_terms(src, row_s, bsum_s, g)
-                + self._disk_terms(dst, row_d2, bsum_d + ddisk, g)
-                - self._disk_terms(dst, row_d, bsum_d, g)
+                self._disk_terms(sx, src, row_s2, bsum_s - ddisk_src, g)
+                - self._disk_terms(sx, src, row_s, bsum_s, g)
+                + self._disk_terms(sx, dst, row_d2, bsum_d + ddisk, g)
+                - self._disk_terms(sx, dst, row_d, bsum_d, g)
             )
 
         # dispersion tiebreaker via sufficient statistics
@@ -680,27 +775,25 @@ class Engine:
         cap_d = st.broker_capacity[dst] + 1e-12
         p_s, p_d = ls / cap_s, ld / cap_d
         p_s2, p_d2 = (ls + dload_src) / cap_s, (ld + dload_dst) / cap_d
-        a_s = self.alive[src][:, None].astype(jnp.float32)
-        a_d = self.alive[dst][:, None].astype(jnp.float32)
+        a_s = sx.alive[src][:, None].astype(jnp.float32)
+        a_d = sx.alive[dst][:, None].astype(jnp.float32)
         dsum = a_s * (p_s2 - p_s) + a_d * (p_d2 - p_d)
         dsumsq = a_s * (p_s2**2 - p_s**2) + a_d * (p_d2**2 - p_d**2)
-        delta += self._tie_term(g["pct_sum"] + dsum, g["pct_sumsq"] + dsumsq) - self._tie_term(
-            g["pct_sum"], g["pct_sumsq"]
-        )
+        delta += self._tie_term(
+            sx, g["pct_sum"] + dsum, g["pct_sumsq"] + dsumsq
+        ) - self._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
         return delta
 
     # ------------------------------------------------------------------
     # step: propose -> evaluate -> select -> apply
     # ------------------------------------------------------------------
 
-    def _step(self, carry: EngineCarry, temperature):
-        st = self.state
-        cfg = self.config
+    def _step(self, sx: EngineStatics, carry: EngineCarry, temperature):
         key, k_r, k_l, k_u = jax.random.split(carry.key, 4)
-        g = self._globals(carry)
+        g = self._globals(sx, carry)
 
-        dr, fr, sr, tr, pr, payr = self._replica_candidates(carry, k_r, g)
-        dl, fl, sl, tl, pl, payl = self._leadership_candidates(carry, k_l, g)
+        dr, fr, sr, tr, pr, payr = self._replica_candidates(sx, carry, k_r, g)
+        dl, fl, sl, tl, pl, payl = self._leadership_candidates(sx, carry, k_l, g)
 
         delta = jnp.concatenate([dr, dl])
         feas = jnp.concatenate([fr, fl])
@@ -708,7 +801,7 @@ class Engine:
         dst = jnp.concatenate([tr, tl])
         part = jnp.concatenate([pr, pl])
         K = delta.shape[0]
-        B, P = st.shape.B, st.shape.P
+        B, P = self.shape.B, self.shape.P
 
         # Metropolis acceptance: delta < -T log u  (greedy at T=0)
         u = jax.random.uniform(k_u, (K,), minval=1e-12, maxval=1.0)
@@ -731,7 +824,7 @@ class Engine:
         sv_r = survive[: dr.shape[0]]
         sv_l = survive[dr.shape[0]:]
 
-        carry = self._apply(carry, sv_r, payr, sv_l, payl)
+        carry = self._apply(sx, carry, sv_r, payr, sv_l, payl)
         carry = dataclasses.replace(carry, key=key)
         stats = dict(
             accepted=survive.sum(),
@@ -740,9 +833,9 @@ class Engine:
         )
         return carry, stats
 
-    def _apply(self, carry: EngineCarry, sv_r, payr, sv_l, payl) -> EngineCarry:
-        st = self.state
-        B, R, D = st.shape.B, st.shape.R, st.shape.max_disks_per_broker
+    def _apply(self, sx, carry: EngineCarry, sv_r, payr, sv_l, payl) -> EngineCarry:
+        st = sx.state
+        B, R, D = self.shape.B, self.shape.R, self.shape.max_disks_per_broker
         drop = dict(mode="drop")
 
         # ---- replica moves ----
@@ -772,7 +865,7 @@ class Engine:
             dlb, **drop
         )
         t = st.replica_topic[jnp.minimum(payr["r"], R - 1)]
-        T = st.shape.num_topics
+        T = self.shape.num_topics
         tc = (
             carry.broker_topic_count.at[jnp.where(sv_r, t, T), src_idx].add(-ones, **drop)
             .at[jnp.where(sv_r, t, T), dst_idx].add(ones, **drop)
@@ -781,8 +874,8 @@ class Engine:
         rack_s = st.broker_rack[src]
         rack_d = st.broker_rack[dst]
         prc = (
-            carry.part_rack_count.at[jnp.where(sv_r, p, st.shape.P), rack_s].add(-ones, **drop)
-            .at[jnp.where(sv_r, p, st.shape.P), rack_d].add(ones, **drop)
+            carry.part_rack_count.at[jnp.where(sv_r, p, self.shape.P), rack_s].add(-ones, **drop)
+            .at[jnp.where(sv_r, p, self.shape.P), rack_d].add(ones, **drop)
         )
         ddisk = load[:, int(Resource.DISK)]
         dl_ = (
@@ -791,7 +884,7 @@ class Engine:
         )
         h_s = st.broker_host[src]
         h_d = st.broker_host[dst]
-        H = st.shape.num_hosts
+        H = self.shape.num_hosts
         hl = (
             carry.host_load.at[jnp.where(sv_r, h_s, H)].add(-load, **drop)
             .at[jnp.where(sv_r, h_d, H)].add(load, **drop)
@@ -844,15 +937,16 @@ class Engine:
             host_load=hl,
         )
 
+    def _scan_impl(self, sx: EngineStatics, carry: EngineCarry, temps: jax.Array):
+        def body(c, t):
+            return self._step(sx, c, t)
+
+        return jax.lax.scan(body, carry, temps)
+
     def _make_scan(self):
-        def run_round(carry: EngineCarry, temps: jax.Array):
-            def body(c, t):
-                return self._step(c, t)
-
-            carry, stats = jax.lax.scan(body, carry, temps)
-            return carry, stats
-
-        return run_round
+        """(statics, carry, temps) -> (carry, stats); for external composition
+        (portfolio sharding, graft entry)."""
+        return self._scan_impl
 
     # ------------------------------------------------------------------
     # driver
@@ -861,10 +955,10 @@ class Engine:
     def run(self, *, verbose: bool = False):
         """Execute the annealing schedule; returns (final_state, history)."""
         cfg = self.config
-        key = jax.random.PRNGKey(cfg.seed)
-        carry = self.init_carry(key)
+        sx = self.statics
+        carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
 
-        t0_obj = float(self._jit_objective(carry)) * cfg.init_temperature_scale
+        t0_obj = float(self._jit_objective(sx, carry)) * cfg.init_temperature_scale
         history = []
         for rnd in range(cfg.num_rounds):
             if rnd == cfg.num_rounds - 1:
@@ -872,31 +966,11 @@ class Engine:
             else:
                 t_round = t0_obj * (cfg.temperature_decay**rnd)
             temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            carry, stats = self._scan(carry, temps)
+            carry, stats = self._scan(sx, carry, temps)
             # re-derive aggregates from placement to wash out float drift
-            carry = self._jit_refresh(carry)
+            carry = self._jit_refresh(sx, carry)
             accepted = int(jax.device_get(stats["accepted"]).sum())
             history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
             if verbose:
-                history[-1]["objective"] = float(self._jit_objective(carry))
+                history[-1]["objective"] = float(self._jit_objective(sx, carry))
         return self.carry_to_state(carry), history
-
-    def _refresh_aggregates_impl(self, carry: EngineCarry) -> EngineCarry:
-        state = self.carry_to_state(carry)
-        agg = compute_aggregates(state)
-        hseg = jnp.where(state.broker_valid, state.broker_host, state.shape.num_hosts)
-        host_load = jax.ops.segment_sum(
-            agg.broker_load, hseg, num_segments=state.shape.num_hosts + 1
-        )[: state.shape.num_hosts]
-        return dataclasses.replace(
-            carry,
-            broker_load=agg.broker_load,
-            broker_replica_count=agg.broker_replica_count,
-            broker_leader_count=agg.broker_leader_count,
-            broker_potential_nw_out=agg.broker_potential_nw_out,
-            broker_leader_bytes_in=agg.broker_leader_bytes_in,
-            broker_topic_count=agg.broker_topic_count,
-            part_rack_count=agg.part_rack_count,
-            disk_load=agg.disk_load,
-            host_load=host_load,
-        )

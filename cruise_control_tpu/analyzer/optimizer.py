@@ -86,9 +86,38 @@ class GoalOptimizer:
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         config: OptimizerConfig = OptimizerConfig(),
     ):
+        import jax
+
         self.chain = chain
         self.constraint = constraint
         self.config = config
+        #: engines cached per (ClusterShape, search config) — rebinding data
+        #: is free, recompiling is not (reference amortizes the same way via
+        #: its proposal precompute loop, GoalOptimizer.java:124-175)
+        self._engines: dict = {}
+        # one persistent jitted program for objective+violations+stats:
+        # eager per-op dispatch on large models costs orders of magnitude
+        # more than the computation itself
+        self._report = jax.jit(
+            lambda s: (
+                self.chain.evaluate(s, constraint=self.constraint)[:2],
+                compute_stats(s),
+            )
+        )
+
+    def _engine_for(
+        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
+    ) -> Engine:
+        key = (state.shape, config)
+        engine = self._engines.get(key)
+        if engine is None:
+            engine = Engine(
+                state, self.chain, constraint=self.constraint, options=options, config=config
+            )
+            self._engines[key] = engine
+        else:
+            engine.rebind(state, options)
+        return engine
 
     def optimize(
         self,
@@ -98,29 +127,12 @@ class GoalOptimizer:
         verbose: bool = False,
         config: OptimizerConfig | None = None,
     ) -> OptimizerResult:
-        import jax
-
         t0 = time.monotonic()
         validate(state)
-        engine = Engine(
-            state,
-            self.chain,
-            constraint=self.constraint,
-            options=options,
-            config=config or self.config,
-        )
-        # one jitted program for objective+violations+stats: eager per-op
-        # dispatch on large models costs orders of magnitude more than the
-        # computation itself
-        report = jax.jit(
-            lambda s: (
-                self.chain.evaluate(s, constraint=self.constraint)[:2],
-                compute_stats(s),
-            )
-        )
-        (obj_b, viol_b), stats_b = report(state)
+        engine = self._engine_for(state, options, config or self.config)
+        (obj_b, viol_b), stats_b = self._report(state)
         final, history = engine.run(verbose=verbose)
-        (obj_a, viol_a), stats_a = report(final)
+        (obj_a, viol_a), stats_a = self._report(final)
         validate(final)
         viol_b = np.asarray(viol_b)
         viol_a = np.asarray(viol_a)

@@ -101,7 +101,7 @@ def test_incremental_aggregates_stay_consistent():
     eng = Engine(state, DEFAULT_CHAIN, config=FAST)
     carry = eng.init_carry(jax.random.PRNGKey(0))
     temps = jnp.full((24,), 0.0, jnp.float32)
-    carry, stats = eng._scan(carry, temps)
+    carry, stats = eng._scan(eng.statics, carry, temps)
     assert int(stats["accepted"].sum()) > 0
 
     fresh = compute_aggregates(eng.carry_to_state(carry))
@@ -148,7 +148,7 @@ def test_greedy_never_worsens_objective():
     obj_prev = float(obj_prev)
     for _ in range(4):
         temps = jnp.full((8,), 0.0, jnp.float32)
-        carry, _ = eng._scan(carry, temps)
+        carry, _ = eng._scan(eng.statics, carry, temps)
         obj, _, _ = chain.evaluate(eng.carry_to_state(carry))
         assert float(obj) <= obj_prev + max(1e-5, abs(obj_prev) * 1e-3)
         obj_prev = float(obj)
