@@ -30,6 +30,10 @@ from cruise_control_tpu.analyzer.goals.topology import (
     IntraBrokerDiskUsageDistributionGoal,
     RackAwareGoal,
 )
+from cruise_control_tpu.analyzer.goals.kafkaassigner import (
+    KafkaAssignerDiskUsageDistributionGoal,
+    KafkaAssignerEvenRackAwareGoal,
+)
 
 _ALL_GOALS: list[Goal] = [
     OfflineReplicaGoal(),
@@ -51,6 +55,15 @@ _ALL_GOALS: list[Goal] = [
     PreferredLeaderElectionGoal(),
     IntraBrokerDiskCapacityGoal(),
     IntraBrokerDiskUsageDistributionGoal(),
+    # kafka-assigner compatibility mode (reference analyzer/kafkaassigner/)
+    KafkaAssignerEvenRackAwareGoal(),
+    KafkaAssignerDiskUsageDistributionGoal(),
+]
+
+#: the two-goal kafka-assigner mode list (reference KafkaAssigner mode)
+KAFKA_ASSIGNER_GOAL_ORDER: list[str] = [
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
 ]
 
 GOALS_BY_NAME: dict[str, Goal] = {g.name: g for g in _ALL_GOALS}

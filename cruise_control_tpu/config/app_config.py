@@ -165,6 +165,8 @@ def _webserver_defs() -> ConfigDef:
     d.define("webserver.security.enable", T.BOOLEAN, False, I.MEDIUM, "", group=g)
     d.define("basic.auth.credentials.file", T.STRING, None, I.MEDIUM,
              "htpasswd-style user:password[:role] lines", group=g)
+    d.define("jwt.secret.key", T.STRING, None, I.MEDIUM,
+             "enables HS256 bearer-token auth when set", group=g)
     d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
              "POSTs park in the review purgatory first", group=g)
     return d

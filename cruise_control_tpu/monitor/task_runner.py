@@ -1,0 +1,149 @@
+"""Load monitor task runner — sampling/bootstrap/training scheduling.
+
+Reference: monitor/task/LoadMonitorTaskRunner.java:33,56 (state machine
+NOT_STARTED/RUNNING/SAMPLING/PAUSED/BOOTSTRAPPING/TRAINING/LOADING),
+BootstrapTask.java (3 bootstrap modes: RANGE, SINCE, RECENT),
+TrainingTask.java (feeds LinearRegressionModelParameters),
+SampleLoadingTask.java (warm restart from the sample store).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from cruise_control_tpu.monitor.cpu_model import LinearRegressionModelParameters
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor, MonitorState
+from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+from cruise_control_tpu.monitor.sampling import MetricFetcherManager
+
+
+class LoadMonitorTaskRunner:
+    """Coordinates the sampling loop with one-shot bootstrap/train/load
+    tasks, enforcing the reference's exclusive-state rules (a bootstrap
+    cannot start while training, etc.)."""
+
+    def __init__(
+        self,
+        monitor: LoadMonitor,
+        fetcher: MetricFetcherManager,
+        partitions_fn: Callable[[], list],
+        *,
+        window_ms: int,
+        regression: LinearRegressionModelParameters | None = None,
+    ):
+        self.monitor = monitor
+        self.fetcher = fetcher
+        self.partitions_fn = partitions_fn
+        self.window_ms = window_ms
+        self.regression = regression or LinearRegressionModelParameters()
+        self._lock = threading.Lock()
+        self._bootstrap_progress = 0.0
+
+    # ------------------------------------------------------------------
+
+    def _enter(self, state: MonitorState):
+        with self._lock:
+            if self.monitor.state in (
+                MonitorState.BOOTSTRAPPING,
+                MonitorState.TRAINING,
+                MonitorState.LOADING,
+            ):
+                raise RuntimeError(f"monitor busy: {self.monitor.state.value}")
+            self._prev_state = self.monitor.state
+            self.monitor._state = state
+
+    def _exit(self):
+        with self._lock:
+            self.monitor._state = self._prev_state
+
+    # ------------------------------------------------------------------
+
+    def start(self, *, interval_s: float | None = None):
+        self.monitor.start()
+        self.fetcher.start(self.partitions_fn, interval_s=interval_s)
+
+    def stop(self):
+        self.fetcher.stop()
+
+    def load_samples(self) -> int:
+        """Warm restart (reference SampleLoadingTask)."""
+        self._enter(MonitorState.LOADING)
+        try:
+            return self.fetcher.load_samples()
+        finally:
+            self._exit()
+
+    def bootstrap_range(self, start_ms: int, end_ms: int, clear_metrics: bool = False) -> int:
+        """RANGE bootstrap: replay samples for [start, end)
+        (reference BootstrapTask RANGE mode; LoadMonitor.bootstrap:325-345)."""
+        return self._bootstrap(start_ms, end_ms, clear_metrics)
+
+    def bootstrap_since(self, start_ms: int, clear_metrics: bool = False) -> int:
+        """SINCE bootstrap: from start to now."""
+        return self._bootstrap(start_ms, int(time.time() * 1000), clear_metrics)
+
+    def bootstrap_recent(self, clear_metrics: bool = True) -> int:
+        """RECENT bootstrap: enough trailing windows to satisfy completeness."""
+        now = int(time.time() * 1000)
+        span = self.window_ms * (self.monitor.partition_aggregator.num_windows + 1)
+        return self._bootstrap(now - span, now, clear_metrics)
+
+    def _bootstrap(self, start_ms: int, end_ms: int, clear_metrics: bool) -> int:
+        self._enter(MonitorState.BOOTSTRAPPING)
+        try:
+            if clear_metrics:
+                agg = self.monitor.partition_aggregator
+                fresh = type(agg)(
+                    num_windows=agg.num_windows,
+                    window_ms=agg.window_ms,
+                    min_samples_per_window=agg.min_samples,
+                    metric_def=agg.metric_def,
+                )
+                self.monitor.partition_aggregator = fresh
+                self.fetcher.partition_aggregator = fresh
+            total = 0
+            parts = self.partitions_fn()
+            n_windows = max(1, (end_ms - start_ms) // self.window_ms)
+            for i in range(n_windows):
+                w_start = start_ms + i * self.window_ms
+                w_end = min(w_start + self.window_ms - 1, end_ms)
+                total += self.fetcher.fetch_once(parts, w_start, w_end)
+                self._bootstrap_progress = (i + 1) / n_windows
+            return total
+        finally:
+            self._exit()
+
+    def train(self, start_ms: int, end_ms: int) -> dict:
+        """Reference TrainingTask: harvest (bytes-in, bytes-out, follower
+        bytes-in, cpu) tuples from broker samples into the regression."""
+        self._enter(MonitorState.TRAINING)
+        try:
+            agg = self.fetcher.broker_aggregator
+            if agg is not None and agg.num_entities():
+                res = agg.aggregate()
+                m = KAFKA_METRIC_DEF
+                for e_idx in range(res.values.shape[0]):
+                    for w in range(res.values.shape[1]):
+                        if not res.window_valid[e_idx, w]:
+                            continue
+                        v = res.values[e_idx, w]
+                        self.regression.add_sample(
+                            float(v[m.metric_id("LEADER_BYTES_IN")]),
+                            float(v[m.metric_id("LEADER_BYTES_OUT")]),
+                            float(v[m.metric_id("REPLICATION_BYTES_IN_RATE")]),
+                            float(v[m.metric_id("CPU_USAGE")]),
+                        )
+            trained = self.regression.train()
+            return {"trained": trained, **self.regression.state()}
+        finally:
+            self._exit()
+
+    def state(self) -> dict:
+        return {
+            "monitorState": self.monitor.state.value,
+            "bootstrapProgressPct": round(100.0 * self._bootstrap_progress, 1),
+            "trainingState": self.regression.state(),
+            "totalSamples": self.fetcher.total_samples,
+        }

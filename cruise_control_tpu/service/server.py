@@ -64,6 +64,13 @@ class CruiseControlApp:
     """Server wrapper (reference KafkaCruiseControlApp.java)."""
 
     def __init__(self, cc: CruiseControl, *, port: int | None = None, host: str | None = None):
+        from cruise_control_tpu.service.security import (
+            AllowAllSecurityProvider,
+            BasicSecurityProvider,
+            JwtSecurityProvider,
+            SessionManager,
+        )
+
         self.cc = cc
         self.config = cc.config
         self.user_tasks = UserTaskManager(
@@ -72,39 +79,23 @@ class CruiseControlApp:
         )
         self.purgatory = Purgatory()
         self.two_step = cc.config.get("two.step.verification.enabled")
-        self._credentials = self._load_credentials()
+        self.sessions = SessionManager(
+            max_expiry_ms=cc.config.get("webserver.session.maxExpiryPeriodMs")
+        )
+        # security provider selection (reference webserver.security.provider)
+        if not cc.config.get("webserver.security.enable"):
+            self.security = AllowAllSecurityProvider()
+        elif cc.config.get("jwt.secret.key"):
+            self.security = JwtSecurityProvider(cc.config.get("jwt.secret.key"))
+        else:
+            self.security = BasicSecurityProvider(
+                cc.config.get("basic.auth.credentials.file")
+            )
         self.prefix = cc.config.get("webserver.api.urlprefix").rstrip("/")
         self.host = host or cc.config.get("webserver.http.address")
         self.port = port if port is not None else cc.config.get("webserver.http.port")
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
-
-    # ------------------------------------------------------------------
-
-    def _load_credentials(self) -> dict[str, str] | None:
-        if not self.config.get("webserver.security.enable"):
-            return None
-        path = self.config.get("basic.auth.credentials.file")
-        creds: dict[str, str] = {}
-        if path:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line and not line.startswith("#"):
-                        user, _, rest = line.partition(":")
-                        creds[user] = rest.split(":")[0].split(",")[0].strip()
-        return creds
-
-    def check_auth(self, header: str | None) -> bool:
-        if self._credentials is None:
-            return True
-        if not header or not header.startswith("Basic "):
-            return False
-        try:
-            user, _, pw = base64.b64decode(header[6:]).decode().partition(":")
-        except Exception:  # noqa: BLE001
-            return False
-        return self._credentials.get(user) == pw
 
     # ------------------------------------------------------------------
     # endpoint handlers; each returns (status, payload)
@@ -122,6 +113,11 @@ class CruiseControlApp:
             task = self.user_tasks.get(tid)
             if task is not None:
                 return self._task_response(task)
+        # header lost: rebind via session key (reference SessionManager)
+        self._session_key = self.sessions.session_key(
+            headers.get("X-Client", ""), method, endpoint,
+            "&".join(f"{k}={v[0]}" for k, v in sorted(params.items())),
+        )
 
         # two-step verification parks POSTs in the purgatory first
         if (
