@@ -56,11 +56,25 @@ class OptimizerConfig:
 
     num_candidates: int = 2048  # K sampled moves per step
     leadership_candidates: int = 512  # of which leadership transfers
+    swap_candidates: int = 512  # of which replica swaps (escape local optima,
+    # reference ResourceDistributionGoal.java:502-599; clamped so at least
+    # one plain relocation candidate remains)
     steps_per_round: int = 64  # jitted scan length
     num_rounds: int = 10  # python-level rounds (aggregates re-derived each round)
     init_temperature_scale: float = 1e-2  # T0 = scale * initial objective
     temperature_decay: float = 0.5  # per-round geometric decay; last round T=0
     seed: int = 0
+    #: movement pricing — the reference only moves what a goal demands and
+    #: its executor caps concurrent moves (executor/Executor.java:485-510,
+    #: ExecutionProposal data-to-move).  SA needs movement priced into the
+    #: objective or it random-walks placement for free.  A move away from a
+    #: replica's ORIGINAL broker/leader pays the cost; moving back refunds it.
+    replica_move_cost: float = 0.5  # per relocated replica, /n_valid
+    leadership_move_cost: float = 1.0  # per relocated partition leadership, /n_valid
+    #: fraction of replica-move candidates importance-sampled from brokers
+    #: with the largest objective contribution (rest stay uniform); the
+    #: sampling plan is refreshed every round
+    importance_fraction: float = 0.5
 
 
 @partial(
@@ -108,6 +122,7 @@ class EngineCarry:
         "part_replicas",
         "alive",
         "dest_ids",
+        "dest_ok",
         "lead_ok",
         "topic_movable",
         "host_multi",
@@ -127,6 +142,7 @@ class EngineStatics:
     part_replicas: jax.Array  # i32[P, max_rf]
     alive: jax.Array  # bool[B] valid & alive
     dest_ids: jax.Array  # i32[B] allowed destination ids, cyclically padded
+    dest_ok: jax.Array  # bool[B] allowed-destination mask (swap feasibility)
     lead_ok: jax.Array  # bool[B]
     topic_movable: jax.Array  # bool[T]
     host_multi: jax.Array  # bool[H]
@@ -135,6 +151,36 @@ class EngineStatics:
     n_alive: jax.Array  # f32 scalar
     n_valid: jax.Array  # f32 scalar
     total_disk_cap: jax.Array  # f32 scalar
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["broker_cdf", "order", "start", "count", "replica_cost", "lead_cost"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class SamplingPlan:
+    """Per-round step context: importance sampling + movement pricing.
+
+    Sampling: uniform source sampling over 500k replicas wastes almost the
+    whole candidate budget near convergence (nearly all candidates touch
+    already-balanced brokers).  Instead: sample a source broker from a
+    categorical proportional to its current objective contribution, then a
+    replica uniformly on that broker via a grouped index (order/start/count),
+    all frozen at round start so the scan stays a fixed program.
+
+    Pricing: per-move costs scale with the round-start objective — early
+    rounds only accept moves with substantial gains; as the objective falls
+    the price falls with it, so fine-grained fixes (and refunds for strayed
+    replicas returning home) still go through.
+    """
+
+    broker_cdf: jax.Array  # f32[B] inclusive cumsum of broker probabilities
+    order: jax.Array  # i32[R] replica ids grouped by broker (invalid last)
+    start: jax.Array  # i32[B] group offsets into order
+    count: jax.Array  # i32[B] replicas per broker
+    replica_cost: jax.Array  # f32 scalar: objective price per strayed replica
+    lead_cost: jax.Array  # f32 scalar: price per strayed partition leadership
 
 
 def partition_replica_table(state: ClusterState) -> np.ndarray:
@@ -184,6 +230,7 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
         part_replicas=jnp.asarray(partition_replica_table(state)),
         alive=jnp.asarray(alive),
         dest_ids=jnp.asarray(dest_pad),
+        dest_ok=jnp.asarray(dest),
         lead_ok=jnp.asarray(alive & options.leadership_allowed(state)),
         topic_movable=jnp.asarray(options.topic_movable(state)),
         host_multi=jnp.asarray(bph > 1),
@@ -283,11 +330,20 @@ class Engine:
         self.config = config
         self.w = _Weights.from_chain(chain)
         self.shape: ClusterShape = state.shape
+        # effective candidate split (leadership + swap carved out of K);
+        # swaps never take more than half the non-leadership budget so plain
+        # relocations — the workhorse moves — keep a healthy share
+        self.K_l = min(config.leadership_candidates, config.num_candidates - 1)
+        self.K_s = min(
+            config.swap_candidates, max(0, (config.num_candidates - self.K_l) // 2)
+        )
+        self.K_r = config.num_candidates - self.K_l - self.K_s
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
         self.statics = build_statics(state, options)
         self._scan = jax.jit(self._scan_impl)
         self._jit_refresh = jax.jit(self._refresh_impl)
         self._jit_objective = jax.jit(self._objective_impl)
+        self._jit_plan = jax.jit(self._plan_impl)
 
     # convenience for call sites that held `engine.state`
     @property
@@ -368,6 +424,82 @@ class Engine:
             self.carry_to_state(carry, sx), constraint=self.constraint
         )
         return obj
+
+    def carry_objective(self, sx: EngineStatics, carry: EngineCarry):
+        """Scalar SA objective from carry aggregates (traceable, collective-free).
+
+        Matches the delta-decomposed objective the step optimizes (broker
+        terms + rack + offline + tie), NOT the full goal-chain evaluation.
+        """
+        g = self._globals(sx, carry)
+        b = jnp.arange(self.shape.B)
+        terms = self._broker_terms(
+            sx,
+            b,
+            carry.broker_load,
+            carry.broker_replica_count,
+            carry.broker_leader_count,
+            carry.broker_potential_nw_out,
+            carry.broker_leader_bytes_in,
+            g,
+        ).sum()
+        rack = jnp.maximum(carry.part_rack_count - 1, 0).sum().astype(jnp.float32)
+        terms += self.w.rack * rack / sx.n_valid
+        st = sx.state
+        offline = (
+            st.replica_valid
+            & ~(
+                st.broker_alive[carry.replica_broker]
+                & st.disk_alive[carry.replica_broker, carry.replica_disk]
+            )
+        ).sum()
+        terms += self.w.offline * offline.astype(jnp.float32) / sx.n_valid
+        terms += self._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
+        return terms
+
+    def _plan_impl(self, sx: EngineStatics, carry: EngineCarry) -> SamplingPlan:
+        """Importance-sampling + movement-pricing plan from current aggregates."""
+        st = sx.state
+        B, R = self.shape.B, self.shape.R
+        g = self._globals(sx, carry)
+        b = jnp.arange(B)
+        w = self._broker_terms(
+            sx,
+            b,
+            carry.broker_load,
+            carry.broker_replica_count,
+            carry.broker_leader_count,
+            carry.broker_potential_nw_out,
+            carry.broker_leader_bytes_in,
+            g,
+        )
+        # stranded replicas on dead brokers/disks carry the offline-goal mass
+        dead = st.broker_valid & ~sx.alive
+        w = w + self.w.offline * jnp.where(
+            dead, carry.broker_replica_count.astype(jnp.float32), 0.0
+        ) / sx.n_valid
+        w = jnp.maximum(jnp.where(st.broker_valid, w, 0.0), 0.0)
+        total = w.sum()
+        uni = jnp.where(st.broker_valid, 1.0, 0.0)
+        uni = uni / jnp.maximum(uni.sum(), 1.0)
+        probs = jnp.where(total > 1e-12, w / jnp.maximum(total, 1e-12), uni)
+        seg = jnp.where(st.replica_valid, carry.replica_broker, B)
+        count = jax.ops.segment_sum(
+            jnp.ones(R, jnp.int32), seg, num_segments=B + 1
+        )[:B]
+        start = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(count)[:-1].astype(jnp.int32)]
+        )
+        obj = self.carry_objective(sx, carry)
+        unit = obj / sx.n_valid
+        return SamplingPlan(
+            broker_cdf=jnp.cumsum(probs),
+            order=jnp.argsort(seg).astype(jnp.int32),
+            start=start,
+            count=count,
+            replica_cost=self.config.replica_move_cost * unit,
+            lead_cost=self.config.leadership_move_cost * unit,
+        )
 
     # ------------------------------------------------------------------
     # objective terms
@@ -516,22 +648,47 @@ class Engine:
         return out
 
     def _tie_term(self, sx, pct_sum, pct_sumsq):
-        """Dispersion tiebreaker: sum over resources of std of utilization pct."""
+        """Dispersion tiebreaker: sum over resources of std of utilization pct.
+
+        Inputs may carry a leading candidate axis — reduce ONLY the trailing
+        resource axis, or every candidate's delta absorbs the whole batch's
+        variance as a constant offset that vetoes small improvements.
+        """
         n = sx.n_alive
         var = _relu(pct_sumsq / n - (pct_sum / n) ** 2)
-        return self.w.tie * jnp.sqrt(var + 1e-18).sum()
+        return self.w.tie * jnp.sqrt(var + 1e-18).sum(-1)
 
     # ------------------------------------------------------------------
     # candidate generation + delta evaluation
     # ------------------------------------------------------------------
 
-    def _replica_candidates(self, sx, carry: EngineCarry, key: jax.Array, g):
+    def _sample_sources(self, key: jax.Array, n: int, plan) -> jax.Array:
+        """n source replica ids; `importance_fraction` of them drawn by a
+        two-stage plan draw (broker ~ categorical(objective contribution),
+        then a replica uniformly on that broker), the rest uniform."""
+        k1, k3, k4, k5 = jax.random.split(key, 4)
+        n_imp = (
+            int(round(n * self.config.importance_fraction)) if plan is not None else 0
+        )
+        r = jax.random.randint(k1, (n - n_imp,), 0, self.shape.R)
+        if n_imp:
+            u = jax.random.uniform(k3, (n_imp,))
+            bsel = jnp.clip(
+                jnp.searchsorted(plan.broker_cdf, u, side="right"), 0, self.shape.B - 1
+            ).astype(jnp.int32)
+            j = (jax.random.uniform(k4, (n_imp,)) * plan.count[bsel]).astype(jnp.int32)
+            r_imp = plan.order[jnp.clip(plan.start[bsel] + j, 0, self.shape.R - 1)]
+            fallback = jax.random.randint(k5, (n_imp,), 0, self.shape.R)
+            r_imp = jnp.where(plan.count[bsel] > 0, r_imp, fallback)
+            r = jnp.concatenate([r, r_imp])
+        return r
+
+    def _replica_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
         """K_r replica-move candidates -> (delta, src, dst, part, payload)."""
         st = sx.state
-        cfg = self.config
-        K = cfg.num_candidates - cfg.leadership_candidates
+        K = self.K_r
         k1, k2 = jax.random.split(key)
-        r = jax.random.randint(k1, (K,), 0, self.shape.R)
+        r = self._sample_sources(k1, K, plan)
         dst = sx.dest_ids[jax.random.randint(k2, (K,), 0, sx.dest_ids.shape[0])]
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
@@ -624,14 +781,194 @@ class Engine:
                 / max(1, self.shape.P)
             )
 
+        # movement pricing: cost to stray from the ORIGINAL broker (statics
+        # hold the pre-optimization placement), refunded when moving home —
+        # keeps the plan executable (reference ExecutionProposal data-to-move)
+        if plan is not None and self.config.replica_move_cost:
+            orig = st.replica_broker[r]
+            stray = (dst != orig).astype(jnp.float32) - (src != orig).astype(jnp.float32)
+            delta += plan.replica_cost * stray
+
         payload = dict(kind=0, r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
                        pot=pot, lbin=lbin, d_src=d_src)
         return delta, feasible, src, dst, part, payload
 
-    def _leadership_candidates(self, sx, carry: EngineCarry, key: jax.Array, g):
+    def _swap_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+        """K_s replica-swap candidates: r <-> q exchange brokers (and disk
+        slots).  Escapes local optima single relocations cannot leave through
+        a feasible intermediate (reference AbstractGoal.maybeApplySwapAction:236,
+        ResourceDistributionGoal swap-in/out :502-599; SURVEY §7 hard part (b)).
+
+        Returns (delta, feasible, src, dst, part_r, part_q, payload); the
+        surviving swaps are applied as two linked relocation payload rows.
+        """
+        st = sx.state
+        K = self.K_s
+        if K == 0:
+            z = jnp.zeros((0,), jnp.float32)
+            zi = jnp.zeros((0,), jnp.int32)
+            zb = jnp.zeros((0,), bool)
+            payload = dict(
+                r=zi, q=zi, load_r=jnp.zeros((0, NUM_RESOURCES)), load_q=jnp.zeros((0, NUM_RESOURCES)),
+                lead_r=zb, lead_q=zb, pot_r=z, pot_q=z, lbin_r=z, lbin_q=z,
+                d_r=zi, d_q=zi,
+            )
+            return z, zb, zi, zi, zi, zi, payload
+        k1, k2 = jax.random.split(key)
+        r = self._sample_sources(k1, K, plan)
+        q = jax.random.randint(k2, (K,), 0, self.shape.R)
+        src = carry.replica_broker[r]
+        dst = carry.replica_broker[q]
+        part_r = st.replica_partition[r]
+        part_q = st.replica_partition[q]
+
+        d_r = carry.replica_disk[r]
+        d_q = carry.replica_disk[q]
+        off_r = ~(st.broker_alive[src] & st.disk_alive[src, d_r])
+        off_q = ~(st.broker_alive[dst] & st.disk_alive[dst, d_q])
+        movable_r = sx.topic_movable[st.replica_topic[r]] | off_r
+        movable_q = sx.topic_movable[st.replica_topic[q]] | off_q
+        feasible = (
+            st.replica_valid[r]
+            & st.replica_valid[q]
+            & movable_r
+            & movable_q
+            & (src != dst)
+            & (part_r != part_q)
+            # both ends must be allowed destinations (each receives a replica)
+            & sx.dest_ok[src]
+            & sx.dest_ok[dst]
+        )
+        # neither partition may end up duplicated on its new broker
+        mem_r = sx.part_replicas[part_r]  # [K, max_rf]
+        mem_r_broker = jnp.where(
+            mem_r < self.shape.R,
+            carry.replica_broker[jnp.minimum(mem_r, self.shape.R - 1)],
+            -1,
+        )
+        feasible &= ~(mem_r_broker == dst[:, None]).any(axis=1)
+        mem_q = sx.part_replicas[part_q]
+        mem_q_broker = jnp.where(
+            mem_q < self.shape.R,
+            carry.replica_broker[jnp.minimum(mem_q, self.shape.R - 1)],
+            -1,
+        )
+        feasible &= ~(mem_q_broker == src[:, None]).any(axis=1)
+
+        lead_r = carry.replica_is_leader[r]
+        lead_q = carry.replica_is_leader[q]
+        load_r = jnp.where(
+            lead_r[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+        )
+        load_r = jnp.where(st.replica_valid[r][:, None], load_r, 0.0)
+        load_q = jnp.where(
+            lead_q[:, None], st.replica_load_leader[q], st.replica_load_follower[q]
+        )
+        load_q = jnp.where(st.replica_valid[q][:, None], load_q, 0.0)
+        pot_r = st.replica_load_leader[r, int(Resource.NW_OUT)]
+        pot_q = st.replica_load_leader[q, int(Resource.NW_OUT)]
+        lbin_r = jnp.where(lead_r, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0)
+        lbin_q = jnp.where(lead_q, st.replica_load_leader[q, int(Resource.NW_IN)], 0.0)
+
+        rdisk = int(Resource.DISK)
+        # r -> (dst, q's disk slot), q -> (src, r's disk slot)
+        delta = self._move_delta(
+            sx,
+            carry,
+            g,
+            src=src,
+            dst=dst,
+            dload_src=load_q - load_r,
+            dload_dst=load_r - load_q,
+            dcount=jnp.zeros((K,), jnp.int32),
+            dlcount=lead_r.astype(jnp.int32) - lead_q.astype(jnp.int32),
+            dpot=pot_r - pot_q,
+            dlbin=lbin_r - lbin_q,
+            d_src=d_r,
+            d_dst=d_q,
+            ddisk=load_r[:, rdisk] - load_q[:, rdisk],
+        )
+
+        # rack cells for both partitions (reference RackAwareGoal)
+        rack_s, rack_d = st.broker_rack[src], st.broker_rack[dst]
+
+        def rack_delta(part, rack_from, rack_to):
+            c_f = carry.part_rack_count[part, rack_from].astype(jnp.float32)
+            c_t = carry.part_rack_count[part, rack_to].astype(jnp.float32)
+            d = (_relu(c_f - 2.0) - _relu(c_f - 1.0)) + (_relu(c_t) - _relu(c_t - 1.0))
+            return jnp.where(rack_from != rack_to, d, 0.0)
+
+        delta += self.w.rack * (
+            rack_delta(part_r, rack_s, rack_d) + rack_delta(part_q, rack_d, rack_s)
+        ) / sx.n_valid
+
+        # topic cells for both topics (reference TopicReplicaDistributionGoal)
+        if self.w.topic_dist != 0.0:
+            tt = self.constraint.topic_replica_count_balance_threshold
+
+            def topic_delta(t, b_from, b_to):
+                upper = jnp.ceil(g["topic_avg"][t] * tt)
+                lower = jnp.floor(g["topic_avg"][t] * max(0.0, 2.0 - tt))
+
+                def cell(cnt):
+                    return _relu(cnt - upper) + _relu(lower - cnt)
+
+                ct_f = carry.broker_topic_count[t, b_from].astype(jnp.float32)
+                ct_t = carry.broker_topic_count[t, b_to].astype(jnp.float32)
+                return (cell(ct_f - 1.0) - cell(ct_f)) + (cell(ct_t + 1.0) - cell(ct_t))
+
+            delta += self.w.topic_dist * (
+                topic_delta(st.replica_topic[r], src, dst)
+                + topic_delta(st.replica_topic[q], dst, src)
+            ) / g["total_count"]
+
+        # offline-replica shifts for both replicas
+        r_ok = st.broker_alive[dst] & st.disk_alive[dst, d_q]
+        q_ok = st.broker_alive[src] & st.disk_alive[src, d_r]
+        doff = (
+            (~r_ok).astype(jnp.float32)
+            - off_r.astype(jnp.float32)
+            + (~q_ok).astype(jnp.float32)
+            - off_q.astype(jnp.float32)
+        )
+        delta += self.w.offline * doff / sx.n_valid
+
+        # preferred-leader eligibility shifts
+        if self.w.pref_leader != 0.0:
+            def pref_delta(x, was_off, now_ok, lead):
+                pref = (st.replica_pos[x] == 0) & st.replica_valid[x] & ~lead
+                was = pref & ~was_off
+                now = pref & now_ok
+                return now.astype(jnp.float32) - was.astype(jnp.float32)
+
+            delta += (
+                self.w.pref_leader
+                * (pref_delta(r, off_r, r_ok, lead_r) + pref_delta(q, off_q, q_ok, lead_q))
+                / max(1, self.shape.P)
+            )
+
+        # movement pricing for both strays
+        if plan is not None and self.config.replica_move_cost:
+            orig_r = st.replica_broker[r]
+            orig_q = st.replica_broker[q]
+            stray = (
+                (dst != orig_r).astype(jnp.float32)
+                - (src != orig_r).astype(jnp.float32)
+                + (src != orig_q).astype(jnp.float32)
+                - (dst != orig_q).astype(jnp.float32)
+            )
+            delta += plan.replica_cost * stray
+
+        payload = dict(
+            r=r, q=q, load_r=load_r, load_q=load_q, lead_r=lead_r, lead_q=lead_q,
+            pot_r=pot_r, pot_q=pot_q, lbin_r=lbin_r, lbin_q=lbin_q, d_r=d_r, d_q=d_q,
+        )
+        return delta, feasible, src, dst, part_r, part_q, payload
+
+    def _leadership_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
         """K_l leadership-transfer candidates (reference relocateLeadership:374)."""
         st = sx.state
-        K = self.config.leadership_candidates
+        K = self.K_l
         R = self.shape.R
         rt = jax.random.randint(key, (K,), 0, R)
         part = st.replica_partition[rt]
@@ -684,6 +1021,17 @@ class Engine:
                 * (pref_f.astype(jnp.float32) - pref_t.astype(jnp.float32))
                 / max(1, self.shape.P)
             )
+
+        # movement pricing: a transfer whose new leader is not the partition's
+        # ORIGINAL leader pays; restoring the original leader refunds
+        # (the executor applies each as a preferred-leader election batch,
+        # reference executor/Executor.java:1091)
+        if plan is not None and self.config.leadership_move_cost:
+            orig_lead = st.replica_is_leader
+            stray = (~orig_lead[rt]).astype(jnp.float32) - (~orig_lead[rf]).astype(
+                jnp.float32
+            )
+            delta += plan.lead_cost * stray
 
         payload = dict(kind=1, rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
                        dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
@@ -788,18 +1136,22 @@ class Engine:
     # step: propose -> evaluate -> select -> apply
     # ------------------------------------------------------------------
 
-    def _step(self, sx: EngineStatics, carry: EngineCarry, temperature):
-        key, k_r, k_l, k_u = jax.random.split(carry.key, 4)
+    def _step(self, sx: EngineStatics, carry: EngineCarry, temperature, plan=None):
+        key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
         g = self._globals(sx, carry)
 
-        dr, fr, sr, tr, pr, payr = self._replica_candidates(sx, carry, k_r, g)
-        dl, fl, sl, tl, pl, payl = self._leadership_candidates(sx, carry, k_l, g)
+        dr, fr, sr, tr, pr, payr = self._replica_candidates(sx, carry, k_r, g, plan)
+        ds, fs, ss, ts, ps1, ps2, pays = self._swap_candidates(sx, carry, k_s, g, plan)
+        dl, fl, sl, tl, pl, payl = self._leadership_candidates(sx, carry, k_l, g, plan)
 
-        delta = jnp.concatenate([dr, dl])
-        feas = jnp.concatenate([fr, fl])
-        src = jnp.concatenate([sr, sl])
-        dst = jnp.concatenate([tr, tl])
-        part = jnp.concatenate([pr, pl])
+        delta = jnp.concatenate([dr, ds, dl])
+        feas = jnp.concatenate([fr, fs, fl])
+        src = jnp.concatenate([sr, ss, sl])
+        dst = jnp.concatenate([tr, ts, tl])
+        # two partition lanes: swaps touch two partitions; other kinds
+        # duplicate their single partition (harmless)
+        part1 = jnp.concatenate([pr, ps1, pl])
+        part2 = jnp.concatenate([pr, ps2, pl])
         K = delta.shape[0]
         B, P = self.shape.B, self.shape.P
 
@@ -809,22 +1161,39 @@ class Engine:
         accept = feas & (delta < thresh - 1e-12)
 
         # conflict resolution: unique ranks; a candidate survives iff it is
-        # the best-ranked touching each of its brokers and its partition
+        # the best-ranked touching each of its brokers and its partition(s)
         big = jnp.where(accept, delta, jnp.inf)
         rank = jnp.argsort(jnp.argsort(big)).astype(jnp.int32)
-        seg = jnp.concatenate([src, dst, B + part])
-        ranks3 = jnp.concatenate([rank, rank, rank])
-        min_rank = jax.ops.segment_min(ranks3, seg, num_segments=B + P)
+        seg = jnp.concatenate([src, dst, B + part1, B + part2])
+        ranks4 = jnp.concatenate([rank, rank, rank, rank])
+        min_rank = jax.ops.segment_min(ranks4, seg, num_segments=B + P)
         survive = (
             accept
             & (min_rank[src] == rank)
             & (min_rank[dst] == rank)
-            & (min_rank[B + part] == rank)
+            & (min_rank[B + part1] == rank)
+            & (min_rank[B + part2] == rank)
         )
-        sv_r = survive[: dr.shape[0]]
-        sv_l = survive[dr.shape[0]:]
+        nr, ns = dr.shape[0], ds.shape[0]
+        sv_r = survive[:nr]
+        sv_s = survive[nr: nr + ns]
+        sv_l = survive[nr + ns:]
 
-        carry = self._apply(sx, carry, sv_r, payr, sv_l, payl)
+        # a surviving swap applies as two linked relocations: r -> (dst, q's
+        # disk) and q -> (src, r's disk) — the scatter path is shared
+        payr_ext = dict(
+            r=jnp.concatenate([payr["r"], pays["r"], pays["q"]]),
+            dst=jnp.concatenate([payr["dst"], ts, ss]),
+            d_dst=jnp.concatenate([payr["d_dst"], pays["d_q"], pays["d_r"]]),
+            load=jnp.concatenate([payr["load"], pays["load_r"], pays["load_q"]]),
+            is_lead=jnp.concatenate([payr["is_lead"], pays["lead_r"], pays["lead_q"]]),
+            pot=jnp.concatenate([payr["pot"], pays["pot_r"], pays["pot_q"]]),
+            lbin=jnp.concatenate([payr["lbin"], pays["lbin_r"], pays["lbin_q"]]),
+            d_src=jnp.concatenate([payr["d_src"], pays["d_r"], pays["d_q"]]),
+        )
+        sv_r_ext = jnp.concatenate([sv_r, sv_s, sv_s])
+
+        carry = self._apply(sx, carry, sv_r_ext, payr_ext, sv_l, payl)
         carry = dataclasses.replace(carry, key=key)
         stats = dict(
             accepted=survive.sum(),
@@ -937,15 +1306,17 @@ class Engine:
             host_load=hl,
         )
 
-    def _scan_impl(self, sx: EngineStatics, carry: EngineCarry, temps: jax.Array):
+    def _scan_impl(
+        self, sx: EngineStatics, carry: EngineCarry, temps: jax.Array, plan=None
+    ):
         def body(c, t):
-            return self._step(sx, c, t)
+            return self._step(sx, c, t, plan)
 
         return jax.lax.scan(body, carry, temps)
 
     def _make_scan(self):
-        """(statics, carry, temps) -> (carry, stats); for external composition
-        (portfolio sharding, graft entry)."""
+        """(statics, carry, temps, plan=None) -> (carry, stats); for external
+        composition (portfolio sharding, graft entry)."""
         return self._scan_impl
 
     # ------------------------------------------------------------------
@@ -966,7 +1337,8 @@ class Engine:
             else:
                 t_round = t0_obj * (cfg.temperature_decay**rnd)
             temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            carry, stats = self._scan(sx, carry, temps)
+            plan = self._jit_plan(sx, carry)
+            carry, stats = self._scan(sx, carry, temps, plan)
             # re-derive aggregates from placement to wash out float drift
             carry = self._jit_refresh(sx, carry)
             accepted = int(jax.device_get(stats["accepted"]).sum())

@@ -2,19 +2,28 @@
 
 Mirrors reference analyzer/goals/AbstractGoal.optimize:66-107: goals are
 optimized strictly in priority order; for each goal, brokers are visited
-and single replica/leadership moves are applied when they (a) help the
-current goal and (b) do not regress any previously-optimized goal
-(reference AnalyzerUtils.isProposalAcceptableForOptimizedGoals:119).
+and moves are applied when they (a) help the current goal and (b) do not
+regress any previously-optimized goal (reference
+AnalyzerUtils.isProposalAcceptableForOptimizedGoals:119).  The move
+neighborhood matches the reference's: single replica relocations
+(AbstractGoal.maybeApplyBalancingAction:179), leadership transfers
+(ActionType.LEADERSHIP_MOVEMENT; LeaderBytesInDistributionGoal), and
+replica swaps (AbstractGoal.maybeApplySwapAction:236,
+ResourceDistributionGoal.java:502-599).
 
 This exists for TESTS AND BENCHMARKS ONLY: it is the quality baseline the
 batched TPU engine must match or beat (SURVEY §7 "equal-or-better on the
 aggregate score"), the role OptimizationVerifier's greedy runs play in the
-reference test suite.  numpy, single-threaded, deliberately simple.
+reference test suite.  Single-threaded; candidate evaluation goes through
+one jitted violation function so large fixtures stay tractable, and a
+wall-clock budget caps total work the way the reference's minutes-long
+runs would be capped in practice.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -24,11 +33,17 @@ from cruise_control_tpu.models.aggregates import compute_aggregates
 from cruise_control_tpu.models.state import ClusterState
 
 
-def _violations(state: ClusterState, chain: GoalChain, constraint) -> np.ndarray:
-    agg = compute_aggregates(state)
-    return np.asarray(
-        [float(g.violation(state, agg, constraint)) for g in chain.goals], np.float64
-    )
+def _make_eval(chain: GoalChain, constraint):
+    """One jitted program evaluating all goal violations for a state."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def eval_fn(s: ClusterState):
+        agg = compute_aggregates(s)
+        return jnp.stack([g.violation(s, agg, constraint) for g in chain.goals])
+
+    return lambda s: np.asarray(eval_fn(s), np.float64)
 
 
 def greedy_optimize(
@@ -39,59 +54,102 @@ def greedy_optimize(
     max_moves_per_goal: int = 200,
     candidate_dests: int = 10,
     seed: int = 0,
+    time_budget_s: float | None = None,
 ) -> ClusterState:
     """Sequential greedy search over single moves, reference-style.
 
     For tractability the oracle samples `candidate_dests` destinations per
     source replica instead of scanning all brokers (the reference prunes
     similarly via sorted candidate lists, model/SortedReplicas.java:47).
+    `time_budget_s` bounds wall-clock: when exhausted, the best state so
+    far is returned (the reference search at LinkedIn scale runs minutes;
+    benchmarks cap it to keep rounds bounded).
     """
     rng = np.random.default_rng(seed)
+    eval_fn = _make_eval(chain, constraint)
     cur = state
-    viol = _violations(cur, chain, constraint)
+    viol = eval_fn(cur)
+    deadline = time.monotonic() + time_budget_s if time_budget_s else None
 
     for gi in range(len(chain.goals)):
         for _ in range(max_moves_per_goal):
             if viol[gi] <= 1e-12:
                 break
-            improved = False
+            if deadline is not None and time.monotonic() > deadline:
+                return cur
             move = _find_improving_move(
-                cur, chain, constraint, viol, gi, rng, candidate_dests
+                cur, eval_fn, viol, gi, rng, candidate_dests, deadline
             )
-            if move is not None:
-                cur, viol = move
-                improved = True
-            if not improved:
+            if move is None:
                 break
+            cur, viol = move
     return cur
 
 
-def _find_improving_move(cur, chain, constraint, viol, gi, rng, candidate_dests):
-    """One accepted move: improves goal gi without regressing goals < gi."""
+def _find_improving_move(cur, eval_fn, viol, gi, rng, candidate_dests, deadline):
+    """One accepted move: improves goal gi without regressing goals < gi.
+
+    Tries, in the reference's order, relocation -> leadership transfer ->
+    swap for each sampled source replica.
+    """
     valid = np.asarray(cur.replica_valid)
     brokers = np.asarray(cur.replica_broker)
+    is_leader = np.asarray(cur.replica_is_leader)
     alive = np.asarray(cur.broker_alive) & np.asarray(cur.broker_valid)
     alive_ids = np.nonzero(alive)[0]
     part = np.asarray(cur.replica_partition)
 
-    # candidate source replicas: prefer replicas on dead or overloaded brokers
+    def accepted(nxt):
+        nviol = eval_fn(nxt)
+        if nviol[gi] < viol[gi] - 1e-12 and not (nviol[:gi] > viol[:gi] + 1e-9).any():
+            return nxt, nviol
+        return None
+
     ridx = np.nonzero(valid)[0]
     rng.shuffle(ridx)
     for r in ridx[:64]:
+        if deadline is not None and time.monotonic() > deadline:
+            return None
         src = brokers[r]
-        dests = rng.choice(alive_ids, size=min(candidate_dests, alive_ids.size), replace=False)
+        dests = rng.choice(
+            alive_ids, size=min(candidate_dests, alive_ids.size), replace=False
+        )
+
+        # 1. relocation (reference maybeApplyBalancingAction)
         for dst in dests:
             if dst == src:
                 continue
-            # no duplicate replica of the partition on dst
             if ((part == part[r]) & (brokers == dst) & valid).any():
                 continue
-            nxt = _apply_move(cur, int(r), int(dst))
-            nviol = _violations(nxt, chain, constraint)
-            if nviol[gi] < viol[gi] - 1e-12 and not (
-                nviol[:gi] > viol[:gi] + 1e-9
-            ).any():
-                return nxt, nviol
+            got = accepted(_apply_move(cur, int(r), int(dst)))
+            if got is not None:
+                return got
+
+        # 2. leadership transfer (reference ActionType.LEADERSHIP_MOVEMENT)
+        if not is_leader[r] and alive[src]:
+            leader_mask = (part == part[r]) & is_leader & valid
+            if leader_mask.any():
+                got = accepted(_apply_leadership(cur, int(r), int(leader_mask.argmax())))
+                if got is not None:
+                    return got
+
+        # 3. swap with a replica on a destination broker (reference
+        #    maybeApplySwapAction:236, ResourceDistributionGoal swap-in/out)
+        for dst in dests:
+            if dst == src:
+                continue
+            on_dst = np.nonzero(valid & (brokers == dst) & (part != part[r]))[0]
+            if on_dst.size == 0:
+                continue
+            q = int(on_dst[rng.integers(on_dst.size)])
+            # neither partition may end up duplicated
+            if ((part == part[r]) & (brokers == dst) & valid).any():
+                continue
+            if ((part == part[q]) & (brokers == src) & valid).any():
+                continue
+            got = accepted(_apply_swap(cur, int(r), int(q)))
+            if got is not None:
+                return got
     return None
 
 
@@ -102,6 +160,33 @@ def _apply_move(cur: ClusterState, r: int, dst: int) -> ClusterState:
     rb[r] = dst
     offline = np.asarray(cur.replica_offline).copy()
     offline[r] = not bool(np.asarray(cur.broker_alive)[dst])
+    return dataclasses.replace(
+        cur,
+        replica_broker=jnp.asarray(rb),
+        replica_offline=jnp.asarray(offline),
+    )
+
+
+def _apply_leadership(cur: ClusterState, rt: int, rf: int) -> ClusterState:
+    """Transfer leadership of a partition from replica rf to replica rt."""
+    import jax.numpy as jnp
+
+    lead = np.asarray(cur.replica_is_leader).copy()
+    lead[rf] = False
+    lead[rt] = True
+    return dataclasses.replace(cur, replica_is_leader=jnp.asarray(lead))
+
+
+def _apply_swap(cur: ClusterState, r: int, q: int) -> ClusterState:
+    """Swap the brokers of replicas r and q (different partitions)."""
+    import jax.numpy as jnp
+
+    rb = np.asarray(cur.replica_broker).copy()
+    rb[r], rb[q] = rb[q], rb[r]
+    alive = np.asarray(cur.broker_alive)
+    offline = np.asarray(cur.replica_offline).copy()
+    offline[r] = not bool(alive[rb[r]])
+    offline[q] = not bool(alive[rb[q]])
     return dataclasses.replace(
         cur,
         replica_broker=jnp.asarray(rb),

@@ -68,12 +68,24 @@ def _analyzer_defs() -> ConfigDef:
              "candidate moves evaluated per optimization step", in_range(lo=16), group=g)
     d.define("tpu.leadership.candidates", T.INT, 512, I.MEDIUM,
              "of which leadership transfers", in_range(lo=0), group=g)
+    d.define("tpu.swap.candidates", T.INT, 512, I.MEDIUM,
+             "of which replica swaps (clamped to half the non-leadership budget)",
+             in_range(lo=0), group=g)
     d.define("tpu.steps.per.round", T.INT, 64, I.MEDIUM, "scan length per round",
              in_range(lo=1), group=g)
     d.define("tpu.num.rounds", T.INT, 10, I.MEDIUM, "annealing rounds", in_range(lo=1), group=g)
     d.define("tpu.init.temperature.scale", T.DOUBLE, 1e-2, I.LOW,
              "T0 as fraction of initial objective", group=g)
     d.define("tpu.temperature.decay", T.DOUBLE, 0.5, I.LOW, "per-round decay", group=g)
+    d.define("tpu.replica.move.cost", T.DOUBLE, 0.5, I.MEDIUM,
+             "objective price per replica moved off its original broker",
+             in_range(lo=0.0), group=g)
+    d.define("tpu.leadership.move.cost", T.DOUBLE, 1.0, I.MEDIUM,
+             "objective price per partition leadership moved off its original leader",
+             in_range(lo=0.0), group=g)
+    d.define("tpu.importance.fraction", T.DOUBLE, 0.5, I.LOW,
+             "fraction of candidates importance-sampled toward violating brokers",
+             in_range(lo=0.0, hi=1.0), group=g)
     return d
 
 
@@ -241,10 +253,14 @@ class CruiseControlConfig(AbstractConfig):
         return OptimizerConfig(
             num_candidates=g("tpu.num.candidates"),
             leadership_candidates=g("tpu.leadership.candidates"),
+            swap_candidates=g("tpu.swap.candidates"),
             steps_per_round=g("tpu.steps.per.round"),
             num_rounds=g("tpu.num.rounds"),
             init_temperature_scale=g("tpu.init.temperature.scale"),
             temperature_decay=g("tpu.temperature.decay"),
+            replica_move_cost=g("tpu.replica.move.cost"),
+            leadership_move_cost=g("tpu.leadership.move.cost"),
+            importance_fraction=g("tpu.importance.fraction"),
         )
 
 

@@ -182,11 +182,18 @@ class WindowedMetricSampleAggregator:
             return
         if window_index <= self._current_window:
             return
-        for w in range(self._current_window + 1, window_index + 1):
-            slot = self._slot(w)
-            self._acc[:, slot] = 0.0
-            self._latest_ts[:, slot] = -1
-            self._counts[:, slot] = 0
+        if window_index - self._current_window >= self._W:
+            # the jump recycles every slot (e.g. bootstrap after a long gap):
+            # clear the whole ring at once instead of window-by-window
+            self._acc[:] = 0.0
+            self._latest_ts[:] = -1
+            self._counts[:] = 0
+        else:
+            for w in range(self._current_window + 1, window_index + 1):
+                slot = self._slot(w)
+                self._acc[:, slot] = 0.0
+                self._latest_ts[:, slot] = -1
+                self._counts[:, slot] = 0
         self._current_window = window_index
         self._oldest_window = max(
             self._oldest_window or 0, window_index - self.num_windows

@@ -93,6 +93,18 @@ class LinearRegressionModelParameters:
             self.coefficients @ np.array([leader_bytes_in, leader_bytes_out, follower_bytes_in])
         )
 
+    def follower_cpu_array(self, leader_loads: np.ndarray) -> np.ndarray:
+        """Trained follower-CPU estimate for [N, 4] leader loads: a follower
+        ingests the partition's bytes-in as replication traffic, so its CPU
+        is the regression's follower-bytes-in coefficient applied to NW_IN
+        (reference ModelUtils.java:84 switches to the trained estimator once
+        LinearRegressionModelParameters has converged)."""
+        from cruise_control_tpu.common.resources import Resource
+
+        if self.coefficients is None:
+            raise ValueError("model not trained")
+        return (self.coefficients[2] * leader_loads[:, Resource.NW_IN]).astype(np.float32)
+
     def state(self) -> dict:
         return {
             "trained": self.trained,

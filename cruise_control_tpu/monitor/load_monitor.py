@@ -77,11 +77,16 @@ class LoadMonitor:
         metric_def: MetricDef = KAFKA_METRIC_DEF,
         max_concurrent_model_generations: int = 1,
         replica_capacity: int | None = None,
+        regression=None,
     ):
         self.metadata = metadata
         self.capacity_resolver = capacity_resolver
         self.partition_aggregator = partition_aggregator
         self.metric_def = metric_def
+        #: optional LinearRegressionModelParameters — once trained (via the
+        #: task runner's /train flow) it replaces the static-coefficient
+        #: follower-CPU estimate (reference ModelUtils.java:84)
+        self.regression = regression
         self._state = MonitorState.NOT_STARTED
         # reference acquireForModelGeneration():390 — semaphore bounding
         # concurrent model generations
@@ -252,7 +257,10 @@ class LoadMonitor:
             )
 
         leader_cpu = loads[:, Resource.CPU]
-        follower_cpu = follower_cpu_util_array(loads, leader_cpu)
+        if self.regression is not None and self.regression.trained:
+            follower_cpu = self.regression.follower_cpu_array(loads)
+        else:
+            follower_cpu = follower_cpu_util_array(loads, leader_cpu)
         alive = topology.alive_broker_ids()
         for p in topology.partitions:
             tid = topic_ids[p.topic]

@@ -232,9 +232,10 @@ class MetricFetcherManager:
                 s.entity, s.time_ms, s.values, group=getattr(s.entity, "group", None)
             ):
                 n += 1
-        for s in result.broker_samples:
-            if self.broker_aggregator.add_sample(s.entity, s.time_ms, s.values):
-                n += 1
+        if self.broker_aggregator is not None:
+            for s in result.broker_samples:
+                if self.broker_aggregator.add_sample(s.entity, s.time_ms, s.values):
+                    n += 1
         self.total_samples += n
         return n
 

@@ -105,7 +105,12 @@ class LoadMonitorTaskRunner:
                 self.fetcher.partition_aggregator = fresh
             total = 0
             parts = self.partitions_fn()
-            n_windows = max(1, (end_ms - start_ms) // self.window_ms)
+            # replay at most the windows the aggregation ring can retain —
+            # older samples would immediately roll out again (reference
+            # BootstrapTask replays only what the sample store covers)
+            max_windows = self.monitor.partition_aggregator.num_windows + 1
+            n_windows = max(1, min((end_ms - start_ms) // self.window_ms, max_windows))
+            start_ms = max(start_ms, end_ms - n_windows * self.window_ms)
             for i in range(n_windows):
                 w_start = start_ms + i * self.window_ms
                 w_end = min(w_start + self.window_ms - 1, end_ms)
@@ -122,7 +127,13 @@ class LoadMonitorTaskRunner:
         try:
             agg = self.fetcher.broker_aggregator
             if agg is not None and agg.num_entities():
-                res = agg.aggregate()
+                try:
+                    res = agg.aggregate()
+                except ValueError:  # no completed broker windows yet
+                    res = None
+            else:
+                res = None
+            if res is not None:
                 m = KAFKA_METRIC_DEF
                 for e_idx in range(res.values.shape[0]):
                     for w in range(res.values.shape[1]):

@@ -53,11 +53,11 @@ def portfolio_run(
     run_round = engine._make_scan()
     statics = engine.statics
 
-    def chain_fn(key, sx, carry: EngineCarry):
+    def chain_fn(key, sx, carry: EngineCarry, plan):
         # per-device chain: same initial carry, device-specific key
         key = key.reshape(-1)[0:2].reshape(2)  # shard_map passes [1, 2]
         carry = dataclasses.replace(carry, key=key)
-        carry, stats = run_round(sx, carry, temps)
+        carry, stats = run_round(sx, carry, temps, plan)
         obj = _sa_objective(engine, sx, carry)
         # race resolution: gather objectives, broadcast the winner's placement
         objs = jax.lax.all_gather(obj, RESTART_AXIS)  # [n]
@@ -79,7 +79,7 @@ def portfolio_run(
         smap = shard_map(
             chain_fn,
             mesh=mesh,
-            in_specs=(P(RESTART_AXIS), P(), P()),
+            in_specs=(P(RESTART_AXIS), P(), P(), P()),
             out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
             check_vma=False,
         )
@@ -89,13 +89,14 @@ def portfolio_run(
         smap = shard_map(
             chain_fn,
             mesh=mesh,
-            in_specs=(P(RESTART_AXIS), P(), P()),
+            in_specs=(P(RESTART_AXIS), P(), P(), P()),
             out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
             check_rep=False,
         )
     sharded = jax.jit(smap)
     carry0 = engine.init_carry(jax.random.PRNGKey(seed))
-    winners, objs = sharded(keys, statics, carry0)
+    plan0 = engine._jit_plan(statics, carry0)
+    winners, objs = sharded(keys, statics, carry0, plan0)
     # out axis stacks each device's all_gather copy: [n_dev, n_chains]
     objs = np.asarray(objs).reshape(n, n)[0]
     # every device computed the same winner; take device 0's copy
@@ -112,30 +113,4 @@ def portfolio_run(
 
 def _sa_objective(engine: Engine, sx, carry: EngineCarry):
     """Scalar SA objective from carry aggregates (traceable, collective-free)."""
-    g = engine._globals(sx, carry)
-    B = engine.shape.B
-    b = jnp.arange(B)
-    terms = engine._broker_terms(
-        sx,
-        b,
-        carry.broker_load,
-        carry.broker_replica_count,
-        carry.broker_leader_count,
-        carry.broker_potential_nw_out,
-        carry.broker_leader_bytes_in,
-        g,
-    ).sum()
-    # rack + offline cell terms (the remaining hard-goal mass)
-    rack = jnp.maximum(carry.part_rack_count - 1, 0).sum().astype(jnp.float32)
-    terms += engine.w.rack * rack / sx.n_valid
-    st = sx.state
-    offline = (
-        st.replica_valid
-        & ~(
-            st.broker_alive[carry.replica_broker]
-            & st.disk_alive[carry.replica_broker, carry.replica_disk]
-        )
-    ).sum()
-    terms += engine.w.offline * offline.astype(jnp.float32) / sx.n_valid
-    terms += engine._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
-    return terms
+    return engine.carry_objective(sx, carry)

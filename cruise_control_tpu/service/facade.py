@@ -106,6 +106,8 @@ class CruiseControl:
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
         self._stop_precompute = threading.Event()
+        #: LoadMonitorTaskRunner attached by build_service (bootstrap/train)
+        self.task_runner = None
 
     def _wire_detectors(self):
         """Reference AnomalyDetector.java:63-68 wiring."""
@@ -412,6 +414,12 @@ class CruiseControl:
         out: dict = {"version": 1}
         if "monitor" in substates:
             out["MonitorState"] = self.monitor.monitor_state()
+            runner = getattr(self, "task_runner", None)
+            if runner is not None:
+                out["MonitorState"]["trainingState"] = runner.regression.state()
+                out["MonitorState"]["bootstrapProgressPct"] = runner.state()[
+                    "bootstrapProgressPct"
+                ]
         if "executor" in substates:
             out["ExecutorState"] = self.executor.executor_state()
         if "analyzer" in substates:

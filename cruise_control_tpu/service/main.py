@@ -34,6 +34,7 @@ def build_service(
     *,
     capacity_resolver: BrokerCapacityConfigResolver | None = None,
     sample_store=None,
+    partitions_fn=None,
 ) -> tuple[CruiseControlApp, MetricFetcherManager]:
     if capacity_resolver is None:
         path = config.get("capacity.config.file")
@@ -61,8 +62,36 @@ def build_service(
         sample_store=sample_store,
         sampling_interval_ms=config.get("metric.sampling.interval.ms"),
     )
-    monitor = LoadMonitor(metadata, capacity_resolver, partition_agg)
+    from cruise_control_tpu.monitor.cpu_model import LinearRegressionModelParameters
+    from cruise_control_tpu.monitor.sampling import PartitionEntity
+    from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+
+    regression = LinearRegressionModelParameters()
+    monitor = LoadMonitor(metadata, capacity_resolver, partition_agg, regression=regression)
+
+    if partitions_fn is None:
+        if hasattr(sampler, "all_partition_entities"):
+            partitions_fn = sampler.all_partition_entities
+        else:
+            # derive entities from metadata, with the same first-appearance
+            # topic-id mapping LoadMonitor._build_state uses
+            def partitions_fn():
+                topo = metadata.topology()
+                tids: dict = {}
+                return [
+                    PartitionEntity(tids.setdefault(p.topic, len(tids)), p.partition)
+                    for p in topo.partitions
+                ]
+
+    task_runner = LoadMonitorTaskRunner(
+        monitor,
+        fetcher,
+        partitions_fn,
+        window_ms=config.get("partition.metrics.window.ms"),
+        regression=regression,
+    )
     cc = CruiseControl(config, monitor, admin)
+    cc.task_runner = task_runner
     app = CruiseControlApp(cc)
     return app, fetcher
 

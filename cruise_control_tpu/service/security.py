@@ -58,13 +58,22 @@ class BasicSecurityProvider:
     def __init__(self, credentials_path: str):
         self._users: dict[str, tuple[str, str]] = {}
         with open(credentials_path) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 line = line.strip()
                 if not line or line.startswith("#"):
                     continue
-                parts = line.split(":")
+                parts = line.split(":", 2)
+                if len(parts) < 2:
+                    raise ValueError(
+                        f"{credentials_path}:{lineno}: expected user:password[:role]"
+                    )
                 user, pw = parts[0], parts[1]
                 role = parts[2].strip().upper() if len(parts) > 2 else ADMIN
+                if role not in _ROLE_RANK:
+                    raise ValueError(
+                        f"{credentials_path}:{lineno}: unknown role {role!r} "
+                        f"(expected one of {sorted(_ROLE_RANK)})"
+                    )
                 self._users[user] = (pw, role)
 
     def authenticate(self, headers):

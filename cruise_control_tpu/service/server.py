@@ -16,7 +16,6 @@ I/O; no framework dependency is warranted.
 
 from __future__ import annotations
 
-import base64
 import json
 import threading
 import urllib.parse
@@ -96,6 +95,8 @@ class CruiseControlApp:
         self.port = port if port is not None else cc.config.get("webserver.http.port")
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        # per-request context (each request runs on its own handler thread)
+        self._local = threading.local()
 
     # ------------------------------------------------------------------
     # endpoint handlers; each returns (status, payload)
@@ -111,12 +112,23 @@ class CruiseControlApp:
         tid = headers.get(USER_TASK_ID_HEADER)
         if tid:
             task = self.user_tasks.get(tid)
-            if task is not None:
-                return self._task_response(task)
-        # header lost: rebind via session key (reference SessionManager)
-        self._session_key = self.sessions.session_key(
-            headers.get("X-Client", ""), method, endpoint,
-            "&".join(f"{k}={v[0]}" for k, v in sorted(params.items())),
+            if task is None:
+                # reference UserTaskManager rejects unknown task ids rather
+                # than silently re-executing the operation
+                return 404, {"errorMessage": f"unknown user task id {tid}"}
+            return self._task_response(task)
+        # header lost: rebind via session key (reference SessionManager).
+        # Binding needs a client identity (reference: the HTTP session) —
+        # anonymous requests must NOT share one namespace, or client B's
+        # identical POST would silently resume client A's operation.
+        client = headers.get("X-Client")
+        self._local.session_key = (
+            self.sessions.session_key(
+                client, method, endpoint,
+                "&".join(f"{k}={v[0]}" for k, v in sorted(params.items())),
+            )
+            if client
+            else None
         )
 
         # two-step verification parks POSTs in the purgatory first
@@ -151,8 +163,27 @@ class CruiseControlApp:
             return 500, {"errorMessage": str(e), "_userTaskId": task.task_id}
 
     def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
-        task = self.user_tasks.submit(endpoint, fn)
-        return self._task_response(task)
+        key = getattr(self._local, "session_key", None)
+        if key is None:
+            task = self.user_tasks.submit(endpoint, fn)
+            return self._task_response(task)
+        # bind the session to the submitted task so a client that lost the
+        # User-Task-ID header resumes the same operation instead of
+        # re-executing it (reference servlet/SessionManager.java)
+        tid = self.sessions.get_or_bind(
+            key, lambda: self.user_tasks.submit(endpoint, fn).task_id
+        )
+        task = self.user_tasks.get(tid)
+        if task is None:  # bound task evicted; start fresh
+            self.sessions.release(key)
+            tid = self.sessions.get_or_bind(
+                key, lambda: self.user_tasks.submit(endpoint, fn).task_id
+            )
+            task = self.user_tasks.get(tid)
+        status, payload = self._task_response(task)
+        if status != 202:  # response delivered -> close the session
+            self.sessions.release(key)
+        return status, payload
 
     # --- GET ---
 
@@ -266,13 +297,39 @@ class CruiseControlApp:
         return 200, {"requestInfo": self.purgatory.board()}
 
     def _ep_bootstrap(self, params) -> tuple[int, dict]:
-        # reference LoadMonitor.bootstrap:325-345 — here: reload persisted samples
-        return 200, {"message": "bootstrap started (sample store reload)"}
+        """Reference LoadMonitor.bootstrap:325-345 + BootstrapTask's 3 modes:
+        RANGE (start+end), SINCE (start only), RECENT (neither)."""
+        runner = getattr(self.cc, "task_runner", None)
+        if runner is None:
+            raise BadRequest("no task runner configured")
+        start = params.get("start", [None])[0]
+        end = params.get("end", [None])[0]
+        clear = _parse_bool(params, "clearmetrics", start is None and end is None)
+
+        def op(progress):
+            if start is not None and end is not None:
+                mode, n = "RANGE", runner.bootstrap_range(int(start), int(end), clear)
+            elif start is not None:
+                mode, n = "SINCE", runner.bootstrap_since(int(start), clear)
+            else:
+                mode, n = "RECENT", runner.bootstrap_recent(clear)
+            return {"mode": mode, "samplesAbsorbed": n, **runner.state()}
+
+        return self._async_op("bootstrap", op)
 
     def _ep_train(self, params) -> tuple[int, dict]:
-        return 200, {"message": "training not required: CPU estimation uses static "
-                                "coefficients until a LinearRegressionModelParameters "
-                                "instance is configured"}
+        """Reference LoadMonitor.train:354 -> TrainingTask -> regression."""
+        runner = getattr(self.cc, "task_runner", None)
+        if runner is None:
+            raise BadRequest("no task runner configured")
+        import time as _time
+
+        now = int(_time.time() * 1000)
+        start = int(params.get("start", [str(now - 3_600_000)])[0])
+        end = int(params.get("end", [str(now)])[0])
+        return self._async_op(
+            "train", lambda progress: runner.train(start, end)
+        )
 
     # --- POST ---
 
@@ -402,10 +459,21 @@ class CruiseControlApp:
                 if method == "POST" and int(self.headers.get("Content-Length") or 0):
                     body = self.rfile.read(int(self.headers["Content-Length"])).decode()
                     params.update(urllib.parse.parse_qs(body))
-                if not app.check_auth(self.headers.get("Authorization")):
+                auth = app.security.authenticate(self.headers)
+                if auth is None:
+                    body = json.dumps({"errorMessage": "authentication required"}).encode()
                     self.send_response(401)
                     self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
+                    self.wfile.write(body)
+                    return
+                principal, role = auth
+                if not app.security.authorize(role, method, endpoint):
+                    self._send(403, {
+                        "errorMessage": f"role {role} of {principal} may not {method} {endpoint}"
+                    })
                     return
                 try:
                     status, payload = app.handle(method, endpoint, params, self.headers)

@@ -101,6 +101,7 @@ class RandomClusterSpec:
     num_new_brokers: int = 0
     skew: float = 0.0  # 0 = uniform placement; >0 biases placement to low-id brokers
     replica_capacity: int | None = None  # pad replica axis to this
+    disks_per_broker: int = 1  # >1 = JBOD (reference config/capacityJBOD.json)
 
 
 def random_cluster(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
@@ -201,6 +202,7 @@ def random_cluster_fast(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
     r_leader = r_pos == 0
     r_offline = ~alive[r_broker]
 
+    D = max(1, spec.disks_per_broker)
     shape = ClusterShape(
         num_replicas=R,
         num_brokers=B,
@@ -208,9 +210,14 @@ def random_cluster_fast(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
         num_topics=T,
         num_racks=spec.num_racks,
         num_hosts=B,
-        max_disks_per_broker=1,
+        max_disks_per_broker=D,
     )
-    disk_cap = cap[:, Resource.DISK:Resource.DISK + 1].copy()
+    # JBOD: split broker disk capacity evenly across D logdirs and place
+    # replicas on random disks (reference config/capacityJBOD.json semantics)
+    disk_cap = np.tile(cap[:, Resource.DISK:Resource.DISK + 1] / D, (1, D)).copy()
+    r_disk = (
+        rng.integers(0, D, R).astype(np.int32) if D > 1 else np.zeros(R, np.int32)
+    )
     return ClusterState(
         replica_broker=jnp.asarray(r_broker),
         replica_partition=jnp.asarray(r_part),
@@ -220,7 +227,7 @@ def random_cluster_fast(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
         replica_valid=jnp.ones(R, bool),
         replica_orig_broker=jnp.asarray(r_broker.copy()),
         replica_offline=jnp.asarray(r_offline),
-        replica_disk=jnp.zeros(R, jnp.int32),
+        replica_disk=jnp.asarray(r_disk),
         replica_load_leader=jnp.asarray(r_ll),
         replica_load_follower=jnp.asarray(r_fl),
         broker_capacity=jnp.asarray(cap),
@@ -230,6 +237,6 @@ def random_cluster_fast(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
         broker_new=jnp.asarray(new),
         broker_valid=jnp.ones(B, bool),
         disk_capacity=jnp.asarray(disk_cap),
-        disk_alive=jnp.asarray(alive[:, None].copy()),
+        disk_alive=jnp.asarray(np.tile(alive[:, None], (1, D)).copy()),
         shape=shape,
     )

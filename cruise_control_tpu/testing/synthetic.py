@@ -15,6 +15,7 @@ import numpy as np
 
 from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF, MetricDef
 from cruise_control_tpu.monitor.sampling import (
+    BrokerEntity,
     MetricSample,
     PartitionEntity,
     SamplingResult,
@@ -71,6 +72,7 @@ class SyntheticWorkloadSampler:
         nwin = m.metric_id("LEADER_BYTES_IN")
         nwout = m.metric_id("LEADER_BYTES_OUT")
         disk = m.metric_id("DISK_USAGE")
+        rep_in = m.metric_id("REPLICATION_BYTES_IN_RATE")
         t = (start_ms + end_ms) // 2
         samples = []
         for e in assigned_partitions:
@@ -84,7 +86,37 @@ class SyntheticWorkloadSampler:
             vals[nwout] = base[2] * noise[2]
             vals[disk] = base[3] * noise[3]
             samples.append(MetricSample(e, t, vals))
-        return SamplingResult(samples, [])
+        # per-broker samples with CPU linear in byte rates — gives the
+        # /train regression a learnable ground truth (reference: the broker
+        # reporter emits BrokerMetricSamples the TrainingTask harvests).
+        # Only the ASSIGNED partitions contribute, so sub-batch fetches
+        # don't double-count broker rates.
+        assigned = {(e.topic, e.partition) for e in assigned_partitions}
+        broker_samples = []
+        per_broker: dict[int, np.ndarray] = {}
+        for p in self.topology.partitions:
+            key = (self._topic_ids[p.topic], p.partition)
+            if key not in assigned:
+                continue
+            base = self._base.get(key)
+            if base is None:
+                continue
+            for b in p.replicas:
+                row = per_broker.setdefault(b, np.zeros(3, np.float64))
+                if b == p.leader:
+                    row[0] += base[1]  # leader bytes in
+                    row[1] += base[2]  # leader bytes out
+                else:
+                    row[2] += base[1]  # replication (follower) bytes in
+        for b, (lbin, lbout, fbin) in sorted(per_broker.items()):
+            vals = np.zeros(m.num_metrics, np.float32)
+            noise = float(np.exp(self._rng.normal(0.0, self.spec.jitter)))
+            vals[nwin] = lbin
+            vals[nwout] = lbout
+            vals[rep_in] = fbin
+            vals[cpu] = (2e-4 * lbin + 5e-5 * lbout + 1e-4 * fbin) * noise
+            broker_samples.append(MetricSample(BrokerEntity(b), t, vals))
+        return SamplingResult(samples, broker_samples)
 
     def all_partition_entities(self) -> list[PartitionEntity]:
         return [

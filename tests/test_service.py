@@ -207,6 +207,168 @@ def test_endpoint_surface_complete():
     }
 
 
+# ----------------------------------------------------------------- security
+
+
+def _basic(user, pw):
+    import base64
+
+    return {"Authorization": "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+
+def _service_config(**extra):
+    return CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,
+            **extra,
+        }
+    )
+
+
+def test_basic_auth_credentials_parsing(tmp_path):
+    from cruise_control_tpu.service.security import BasicSecurityProvider
+
+    creds = tmp_path / "creds"
+    creds.write_text("admin:secret\nviewer:vpw:VIEWER\n")
+    p = BasicSecurityProvider(str(creds))
+    assert p.authenticate({"Authorization": _basic("admin", "secret")["Authorization"]}) == (
+        "admin", "ADMIN"
+    )
+    assert p.authenticate({"Authorization": _basic("viewer", "vpw")["Authorization"]}) == (
+        "viewer", "VIEWER"
+    )
+    assert p.authenticate({"Authorization": _basic("admin", "wrong")["Authorization"]}) is None
+    assert p.authenticate({}) is None
+    # malformed lines must fail loudly, not create broken users
+    bad_role = tmp_path / "bad_role"
+    bad_role.write_text("user:pw:WIZARD\n")
+    with pytest.raises(ValueError):
+        BasicSecurityProvider(str(bad_role))
+    no_pw = tmp_path / "no_pw"
+    no_pw.write_text("loneuser\n")
+    with pytest.raises(ValueError):
+        BasicSecurityProvider(str(no_pw))
+
+
+@pytest.fixture(scope="module")
+def basic_auth_service(tmp_path_factory):
+    creds = tmp_path_factory.mktemp("auth") / "credentials"
+    creds.write_text("admin:adminpw:ADMIN\nviewer:viewerpw:VIEWER\n")
+    config = _service_config(**{
+        "webserver.security.enable": "true",
+        "basic.auth.credentials.file": str(creds),
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=5)
+    app.start()
+    yield app
+    app.stop()
+
+
+def test_unauthenticated_request_gets_401(basic_auth_service):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(basic_auth_service, "GET", "state")
+    assert e.value.code == 401
+    assert "WWW-Authenticate" in e.value.headers
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(basic_auth_service, "GET", "state", headers=_basic("admin", "nope"))
+    assert e.value.code == 401
+
+
+def test_role_enforcement(basic_auth_service):
+    # VIEWER may GET but not POST (reference DefaultRoleSecurityProvider)
+    status, _, _ = _request(
+        basic_auth_service, "GET", "state", headers=_basic("viewer", "viewerpw")
+    )
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(
+            basic_auth_service, "POST", "pause_sampling",
+            headers=_basic("viewer", "viewerpw"),
+        )
+    assert e.value.code == 403
+    status, _, _ = _request(
+        basic_auth_service, "POST", "pause_sampling", headers=_basic("admin", "adminpw")
+    )
+    assert status == 200
+    _request(basic_auth_service, "POST", "resume_sampling", headers=_basic("admin", "adminpw"))
+
+
+def test_jwt_auth_and_expiry():
+    from cruise_control_tpu.service.security import JwtSecurityProvider, jwt_encode
+
+    config = _service_config(**{
+        "webserver.security.enable": "true",
+        "jwt.secret.key": "test-secret",
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=6)
+    app.start()
+    try:
+        provider = app.security
+        assert isinstance(provider, JwtSecurityProvider)
+        admin_tok = provider.issue("ops", role="ADMIN")
+        viewer_tok = provider.issue("watcher", role="VIEWER")
+        expired_tok = jwt_encode(
+            {"sub": "late", "role": "ADMIN", "exp": time.time() - 10}, "test-secret"
+        )
+        forged_tok = provider.issue("mallory", role="ADMIN")[:-4] + "AAAA"
+
+        hdr = lambda t: {"Authorization": f"Bearer {t}"}  # noqa: E731
+        status, _, _ = _request(app, "GET", "state", headers=hdr(admin_tok))
+        assert status == 200
+        status, _, _ = _request(
+            app, "POST", "pause_sampling", headers=hdr(admin_tok)
+        )
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _request(app, "POST", "resume_sampling", headers=hdr(viewer_tok))
+        assert e.value.code == 403
+        for tok in (expired_tok, forged_tok, "garbage"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _request(app, "GET", "state", headers=hdr(tok))
+            assert e.value.code == 401
+        _request(app, "POST", "resume_sampling", headers=hdr(admin_tok))
+    finally:
+        app.stop()
+
+
+def test_unknown_user_task_id_rejected(service):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(
+            service, "GET", "proposals", headers={"User-Task-ID": "no-such-task"}
+        )
+    assert e.value.code == 404
+
+
+def test_session_rebind_resumes_same_task():
+    """A client that lost the User-Task-ID header but repeats the identical
+    request must resume the in-flight task, not start a second one
+    (reference servlet/SessionManager.java)."""
+    config = _service_config(**{
+        "tpu.num.candidates": 64,
+        "tpu.leadership.candidates": 16,
+        "tpu.steps.per.round": 8,
+        "tpu.num.rounds": 2,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=7)
+    app.start()
+    try:
+        headers = {"X-Client": "c1"}
+        status, payload, _ = _request(app, "GET", "proposals", headers=headers)
+        n0 = len(app.user_tasks.all_tasks())
+        deadline = time.time() + 60
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.3)
+            status, payload, _ = _request(app, "GET", "proposals", headers=headers)
+        assert status == 200
+        assert len(app.user_tasks.all_tasks()) == n0  # no duplicate task spawned
+        assert app.sessions.num_active() == 0  # released once delivered
+    finally:
+        app.stop()
+
+
 def test_two_step_verification_flow():
     config = CruiseControlConfig(
         {

@@ -1,0 +1,150 @@
+"""Load-monitor task runner tests: bootstrap modes, training, state machine.
+
+Mirrors reference LoadMonitorTaskRunnerTest (SURVEY §4.5) over the
+simulated backend: BOOTSTRAPPING/TRAINING/LOADING transitions, the three
+bootstrap modes (BootstrapTask.java), and the /train -> regression ->
+CPU-estimator flip (TrainingTask.java, LinearRegressionModelParameters).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.config import CruiseControlConfig
+from cruise_control_tpu.monitor.load_monitor import MonitorState
+from cruise_control_tpu.service.main import build_simulated_service
+
+
+def _fresh_service(seed=11, **extra):
+    config = CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "broker.metrics.window.ms": 1000,
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,
+            **extra,
+        }
+    )
+    return build_simulated_service(config, seed=seed)
+
+
+def test_bootstrap_range_fills_windows():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    assert runner is not None
+    before = fetcher.total_samples
+    n = runner.bootstrap_range(0, 3000, clear_metrics=False)
+    assert n > 0
+    assert fetcher.total_samples == before + n
+    # state machine returned to its pre-bootstrap state
+    assert app.cc.monitor.state not in (MonitorState.BOOTSTRAPPING,)
+    assert runner.state()["bootstrapProgressPct"] == 100.0
+
+
+def test_bootstrap_clear_metrics_resets_aggregator():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    agg_before = app.cc.monitor.partition_aggregator
+    runner.bootstrap_range(0, 2000, clear_metrics=True)
+    assert app.cc.monitor.partition_aggregator is not agg_before
+    assert fetcher.partition_aggregator is app.cc.monitor.partition_aggregator
+
+
+def test_bootstrap_recent_and_since():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    assert runner.bootstrap_recent() > 0
+    now = int(time.time() * 1000)
+    assert runner.bootstrap_since(now - 2000) > 0
+
+
+def test_busy_state_is_exclusive():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    runner._enter(MonitorState.BOOTSTRAPPING)
+    try:
+        with pytest.raises(RuntimeError):
+            runner.train(0, 1000)
+        with pytest.raises(RuntimeError):
+            runner.load_samples()
+    finally:
+        runner._exit()
+    # after exit, training is allowed again
+    runner.train(0, int(time.time() * 1000))
+
+
+def test_training_flips_cpu_estimator():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    runner.regression.min_samples_to_train = 10
+    # feed several windows of broker samples
+    parts = sampler.all_partition_entities()
+    for w in range(4, 10):
+        fetcher.fetch_once(parts, w * 1000, (w + 1) * 1000 - 1)
+    out = runner.train(0, int(time.time() * 1000))
+    assert out["trained"] is True
+    coef = np.asarray(runner.regression.coefficients)
+    # synthetic broker CPU = 2e-4*lbin + 5e-5*lbout + 1e-4*fbin (+noise):
+    # the closed-form fit must recover the follower-bytes-in weight
+    assert coef[2] == pytest.approx(1e-4, rel=0.25)
+    # the monitor now uses the trained estimator for follower CPU
+    assert app.cc.monitor.regression is runner.regression
+    assert app.cc.monitor.regression.trained
+    loads = np.tile(np.array([[1.0, 100.0, 120.0, 500.0]], np.float32), (3, 1))
+    est = runner.regression.follower_cpu_array(loads)
+    assert est == pytest.approx(coef[2] * 100.0, rel=1e-5)
+
+
+def test_train_without_enough_samples_reports_untrained():
+    app, fetcher, admin, sampler = _fresh_service()
+    runner = app.cc.task_runner
+    runner.regression.min_samples_to_train = 10_000
+    out = runner.train(0, int(time.time() * 1000))
+    assert out["trained"] is False
+    assert app.cc.monitor.regression.trained is False
+
+
+def test_bootstrap_and_train_endpoints():
+    import json
+    import urllib.request
+
+    app, fetcher, admin, sampler = _fresh_service()
+    app.cc.task_runner.regression.min_samples_to_train = 5
+    app.start()
+    try:
+        def poll(endpoint, **params):
+            q = "&".join(f"{k}={v}" for k, v in params.items())
+            url = f"http://{app.host}:{app.port}{app.prefix}/{endpoint}" + (
+                f"?{q}" if q else ""
+            )
+            req = urllib.request.Request(url, method="GET")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+                tid = resp.headers.get("User-Task-ID")
+                status = resp.status
+            deadline = time.time() + 30
+            while status == 202 and time.time() < deadline:
+                time.sleep(0.2)
+                req = urllib.request.Request(
+                    url, method="GET", headers={"User-Task-ID": tid}
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    payload = json.loads(resp.read())
+                    status = resp.status
+            return status, payload
+
+        status, payload = poll("bootstrap", start="0", end="3000")
+        assert status == 200
+        assert payload["mode"] == "RANGE" and payload["samplesAbsorbed"] > 0
+        status, payload = poll("bootstrap", start="0")
+        assert status == 200 and payload["mode"] == "SINCE"
+        status, payload = poll("train")
+        assert status == 200
+        assert payload["trained"] is True
+        # /state surfaces the training state
+        status, payload = poll("state", substates="monitor")
+        assert payload["MonitorState"]["trainingState"]["trained"] is True
+    finally:
+        app.stop()
