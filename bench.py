@@ -312,6 +312,12 @@ def config_4(scale_order):
 
 
 def main():
+    from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
+
+    # persistent XLA cache: repeat bench runs skip the ~70s warm-up compile
+    enable_persistent_cache(
+        os.environ.get("BENCH_COMPILE_CACHE", "~/.cache/cruise_control_tpu/xla")
+    )
     scale = os.environ.get("BENCH_SCALE", "auto")
     scale_order = [scale] if scale != "auto" else ["north_star", "mid", "small"]
     wanted = set(

@@ -75,6 +75,11 @@ class OptimizerConfig:
     #: with the largest objective contribution (rest stay uniform); the
     #: sampling plan is refreshed every round
     importance_fraction: float = 0.5
+    #: intra-broker (JBOD) mode: candidates move replicas between a broker's
+    #: own logdirs instead of between brokers (reference rebalance_disk
+    #: semantics, AnalyzerConfig.java:236 default.intra.broker.goals);
+    #: leadership/swap candidates are disabled
+    intra_broker: bool = False
 
 
 @partial(
@@ -183,21 +188,23 @@ class SamplingPlan:
     lead_cost: jax.Array  # f32 scalar: price per strayed partition leadership
 
 
-def partition_replica_table(state: ClusterState) -> np.ndarray:
+def partition_replica_table(state: ClusterState, max_rf: int | None = None) -> np.ndarray:
     """i32[P, max_rf] replica indices per partition, padded with R.
 
     Membership never changes during optimization (only placement does), so
     this is built once on the host.  Mirrors reference model/Partition.java's
-    replica list.
+    replica list.  `max_rf` forces a uniform table width (the sharded engine
+    needs identical shapes across shards).
     """
     valid = np.asarray(state.replica_valid)
     part = np.asarray(state.replica_partition)
     pos = np.asarray(state.replica_pos)
     P, R = state.shape.P, state.shape.R
-    max_rf = 1
-    counts = np.bincount(part[valid], minlength=P)
-    if counts.size:
-        max_rf = max(1, int(counts.max()))
+    if max_rf is None:
+        max_rf = 1
+        counts = np.bincount(part[valid], minlength=P)
+        if counts.size:
+            max_rf = max(1, int(counts.max()))
     table = np.full((P, max_rf), R, np.int32)
     idx = np.nonzero(valid)[0]
     slot = np.minimum(pos[idx], max_rf - 1)
@@ -333,11 +340,15 @@ class Engine:
         # effective candidate split (leadership + swap carved out of K);
         # swaps never take more than half the non-leadership budget so plain
         # relocations — the workhorse moves — keep a healthy share
-        self.K_l = min(config.leadership_candidates, config.num_candidates - 1)
-        self.K_s = min(
-            config.swap_candidates, max(0, (config.num_candidates - self.K_l) // 2)
-        )
-        self.K_r = config.num_candidates - self.K_l - self.K_s
+        if config.intra_broker:
+            # disk rebalancing: only intra-broker disk moves make sense
+            self.K_l, self.K_s, self.K_r = 0, 0, config.num_candidates
+        else:
+            self.K_l = min(config.leadership_candidates, config.num_candidates - 1)
+            self.K_s = min(
+                config.swap_candidates, max(0, (config.num_candidates - self.K_l) // 2)
+            )
+            self.K_r = config.num_candidates - self.K_l - self.K_s
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
         self.statics = build_statics(state, options)
         self._scan = jax.jit(self._scan_impl)
@@ -793,6 +804,77 @@ class Engine:
                        pot=pot, lbin=lbin, d_src=d_src)
         return delta, feasible, src, dst, part, payload
 
+    def _intra_disk_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+        """K_r intra-broker disk-move candidates (JBOD rebalance_disk mode).
+
+        Replicas move between a broker's OWN logdirs — no broker-level load
+        shifts, only the intra-broker disk goals + offline term move
+        (reference IntraBrokerDiskCapacity/UsageDistributionGoal,
+        Executor.intraBrokerMoveReplicas:1036 alterReplicaLogDirs).
+        Returned in the replica-candidate payload shape: src == dst broker,
+        so `_apply`'s broker-axis scatters cancel and only replica_disk +
+        disk_load actually change.
+        """
+        st = sx.state
+        K = self.K_r
+        D = self.shape.max_disks_per_broker
+        r = self._sample_sources(key, K, plan)
+        b = carry.replica_broker[r]
+        d_src = carry.replica_disk[r]
+        part = st.replica_partition[r]
+
+        # destination logdir: most-free alive disk on b, excluding the
+        # current slot
+        pct = carry.disk_load[b] / (st.disk_capacity[b] + 1e-12)
+        pct = jnp.where(st.disk_alive[b], pct, jnp.inf)
+        pct = jnp.where(jax.nn.one_hot(d_src, D, dtype=bool), jnp.inf, pct)
+        d_dst = jnp.argmin(pct, axis=1).astype(jnp.int32)
+
+        off_src = ~(st.broker_alive[b] & st.disk_alive[b, d_src])
+        movable = sx.topic_movable[st.replica_topic[r]] | off_src
+        dst_ok = st.broker_alive[b] & st.disk_alive[b, d_dst]
+        feasible = (
+            st.replica_valid[r] & movable & dst_ok & (d_dst != d_src)
+        )
+
+        is_lead = carry.replica_is_leader[r]
+        load = jnp.where(
+            is_lead[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+        )
+        load = jnp.where(st.replica_valid[r][:, None], load, 0.0)
+        ddisk = load[:, int(Resource.DISK)]
+
+        # intra-broker disk terms: one broker, one row reshuffled
+        row = carry.disk_load[b]
+        shift = (
+            jax.nn.one_hot(d_dst, D, dtype=jnp.float32)
+            - jax.nn.one_hot(d_src, D, dtype=jnp.float32)
+        ) * ddisk[:, None]
+        bsum = row.sum(-1)
+        delta = self._disk_terms(sx, b, row + shift, bsum, g) - self._disk_terms(
+            sx, b, row, bsum, g
+        )
+        # offline-replica shift (rescuing off a failed logdir)
+        delta += self.w.offline * (
+            (~dst_ok).astype(jnp.float32) - off_src.astype(jnp.float32)
+        ) / sx.n_valid
+        # movement pricing vs the ORIGINAL logdir (alterReplicaLogDirs copies
+        # the whole replica; reference ExecutionProposal data-to-move)
+        if plan is not None and self.config.replica_move_cost:
+            orig = st.replica_disk[r]
+            stray = (d_dst != orig).astype(jnp.float32) - (d_src != orig).astype(
+                jnp.float32
+            )
+            delta += plan.replica_cost * stray
+
+        payload = dict(kind=0, r=r, dst=b, d_dst=d_dst, load=load, is_lead=is_lead,
+                       pot=st.replica_load_leader[r, int(Resource.NW_OUT)],
+                       lbin=jnp.where(
+                           is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0
+                       ),
+                       d_src=d_src)
+        return delta, feasible, b, b, part, payload
+
     def _swap_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
         """K_s replica-swap candidates: r <-> q exchange brokers (and disk
         slots).  Escapes local optima single relocations cannot leave through
@@ -838,6 +920,11 @@ class Engine:
             # both ends must be allowed destinations (each receives a replica)
             & sx.dest_ok[src]
             & sx.dest_ok[dst]
+            # each replica inherits the other's disk slot — that slot must be
+            # alive (relocations argmin over alive disks; swaps must not be
+            # the back door onto a failed logdir)
+            & st.disk_alive[dst, d_q]
+            & st.disk_alive[src, d_r]
         )
         # neither partition may end up duplicated on its new broker
         mem_r = sx.part_replicas[part_r]  # [K, max_rf]
@@ -970,6 +1057,13 @@ class Engine:
         st = sx.state
         K = self.K_l
         R = self.shape.R
+        if K == 0:
+            z = jnp.zeros((0,), jnp.float32)
+            zi = jnp.zeros((0,), jnp.int32)
+            zb = jnp.zeros((0,), bool)
+            zl = jnp.zeros((0, NUM_RESOURCES), jnp.float32)
+            payload = dict(kind=1, rf=zi, rt=zi, dl_f=zl, dl_t=zl, dlbin_src=z, dlbin_dst=z)
+            return z, zb, zi, zi, zi, payload
         rt = jax.random.randint(key, (K,), 0, R)
         part = st.replica_partition[rt]
         members = sx.part_replicas[part]  # [K, max_rf]
@@ -1136,11 +1230,21 @@ class Engine:
     # step: propose -> evaluate -> select -> apply
     # ------------------------------------------------------------------
 
-    def _step(self, sx: EngineStatics, carry: EngineCarry, temperature, plan=None):
-        key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
-        g = self._globals(sx, carry)
-
-        dr, fr, sr, tr, pr, payr = self._replica_candidates(sx, carry, k_r, g, plan)
+    def _propose(self, sx: EngineStatics, carry: EngineCarry, k_r, k_s, k_l, g, plan=None):
+        """Sample + evaluate all candidate kinds; return a selection/apply
+        bundle.  Payloads carry src broker / topic / partition explicitly so
+        `_apply` never has to index replica-axis arrays for them — which lets
+        the sharded engine (parallel/sharded.py) apply rows gathered from
+        OTHER devices' replica shards to its replicated broker aggregates.
+        """
+        st = sx.state
+        R1 = self.shape.R - 1
+        repl = (
+            self._intra_disk_candidates
+            if self.config.intra_broker
+            else self._replica_candidates
+        )
+        dr, fr, sr, tr, pr, payr = repl(sx, carry, k_r, g, plan)
         ds, fs, ss, ts, ps1, ps2, pays = self._swap_candidates(sx, carry, k_s, g, plan)
         dl, fl, sl, tl, pl, payl = self._leadership_candidates(sx, carry, k_l, g, plan)
 
@@ -1152,37 +1256,13 @@ class Engine:
         # duplicate their single partition (harmless)
         part1 = jnp.concatenate([pr, ps1, pl])
         part2 = jnp.concatenate([pr, ps2, pl])
-        K = delta.shape[0]
-        B, P = self.shape.B, self.shape.P
-
-        # Metropolis acceptance: delta < -T log u  (greedy at T=0)
-        u = jax.random.uniform(k_u, (K,), minval=1e-12, maxval=1.0)
-        thresh = -temperature * jnp.log(u)
-        accept = feas & (delta < thresh - 1e-12)
-
-        # conflict resolution: unique ranks; a candidate survives iff it is
-        # the best-ranked touching each of its brokers and its partition(s)
-        big = jnp.where(accept, delta, jnp.inf)
-        rank = jnp.argsort(jnp.argsort(big)).astype(jnp.int32)
-        seg = jnp.concatenate([src, dst, B + part1, B + part2])
-        ranks4 = jnp.concatenate([rank, rank, rank, rank])
-        min_rank = jax.ops.segment_min(ranks4, seg, num_segments=B + P)
-        survive = (
-            accept
-            & (min_rank[src] == rank)
-            & (min_rank[dst] == rank)
-            & (min_rank[B + part1] == rank)
-            & (min_rank[B + part2] == rank)
-        )
-        nr, ns = dr.shape[0], ds.shape[0]
-        sv_r = survive[:nr]
-        sv_s = survive[nr: nr + ns]
-        sv_l = survive[nr + ns:]
 
         # a surviving swap applies as two linked relocations: r -> (dst, q's
         # disk) and q -> (src, r's disk) — the scatter path is shared
+        r_ext = jnp.concatenate([payr["r"], pays["r"], pays["q"]])
         payr_ext = dict(
-            r=jnp.concatenate([payr["r"], pays["r"], pays["q"]]),
+            r=r_ext,
+            src=jnp.concatenate([sr, ss, ts]),
             dst=jnp.concatenate([payr["dst"], ts, ss]),
             d_dst=jnp.concatenate([payr["d_dst"], pays["d_q"], pays["d_r"]]),
             load=jnp.concatenate([payr["load"], pays["load_r"], pays["load_q"]]),
@@ -1190,10 +1270,62 @@ class Engine:
             pot=jnp.concatenate([payr["pot"], pays["pot_r"], pays["pot_q"]]),
             lbin=jnp.concatenate([payr["lbin"], pays["lbin_r"], pays["lbin_q"]]),
             d_src=jnp.concatenate([payr["d_src"], pays["d_r"], pays["d_q"]]),
+            topic=st.replica_topic[jnp.minimum(r_ext, R1)],
+            part=jnp.concatenate([pr, ps1, ps2]),
         )
+        payl_ext = dict(
+            payl,
+            src_b=sl,
+            dst_b=tl,
+            d_f=carry.replica_disk[jnp.minimum(payl["rf"], R1)],
+            d_t=carry.replica_disk[jnp.minimum(payl["rt"], R1)],
+        )
+        return dict(
+            delta=delta, feas=feas, src=src, dst=dst, part1=part1, part2=part2,
+            nr=dr.shape[0], ns=ds.shape[0], payr=payr_ext, payl=payl_ext,
+        )
+
+    def _select(self, accept, delta, src, dst, part1, part2, num_parts=None):
+        """Conflict resolution: unique ranks; a candidate survives iff it is
+        the best-ranked touching each of its brokers and its partition(s).
+        `num_parts` overrides the partition-segment count (the sharded engine
+        selects over GLOBAL partition ids spanning all shards)."""
+        B = self.shape.B
+        P = self.shape.P if num_parts is None else num_parts
+        big = jnp.where(accept, delta, jnp.inf)
+        rank = jnp.argsort(jnp.argsort(big)).astype(jnp.int32)
+        seg = jnp.concatenate([src, dst, B + part1, B + part2])
+        ranks4 = jnp.concatenate([rank, rank, rank, rank])
+        min_rank = jax.ops.segment_min(ranks4, seg, num_segments=B + P)
+        return (
+            accept
+            & (min_rank[src] == rank)
+            & (min_rank[dst] == rank)
+            & (min_rank[B + part1] == rank)
+            & (min_rank[B + part2] == rank)
+        )
+
+    def _step(self, sx: EngineStatics, carry: EngineCarry, temperature, plan=None):
+        key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
+        g = self._globals(sx, carry)
+        prop = self._propose(sx, carry, k_r, k_s, k_l, g, plan)
+        delta, feas = prop["delta"], prop["feas"]
+
+        # Metropolis acceptance: delta < -T log u  (greedy at T=0)
+        u = jax.random.uniform(k_u, (delta.shape[0],), minval=1e-12, maxval=1.0)
+        thresh = -temperature * jnp.log(u)
+        accept = feas & (delta < thresh - 1e-12)
+
+        survive = self._select(
+            accept, delta, prop["src"], prop["dst"], prop["part1"], prop["part2"]
+        )
+        nr, ns = prop["nr"], prop["ns"]
+        sv_r = survive[:nr]
+        sv_s = survive[nr: nr + ns]
+        sv_l = survive[nr + ns:]
         sv_r_ext = jnp.concatenate([sv_r, sv_s, sv_s])
 
-        carry = self._apply(sx, carry, sv_r_ext, payr_ext, sv_l, payl)
+        carry = self._apply(sx, carry, sv_r_ext, prop["payr"], sv_l, prop["payl"])
         carry = dataclasses.replace(carry, key=key)
         stats = dict(
             accepted=survive.sum(),
@@ -1202,16 +1334,40 @@ class Engine:
         )
         return carry, stats
 
-    def _apply(self, sx, carry: EngineCarry, sv_r, payr, sv_l, payl) -> EngineCarry:
+    def _apply(
+        self, sx, carry: EngineCarry, sv_r, payr, sv_l, payl,
+        *, r_offset=None, p_offset=None,
+    ) -> EngineCarry:
+        """Scatter surviving candidates into placement + aggregates.
+
+        Payload rows identify everything by explicit fields (replica id, src
+        broker, topic, partition) rather than replica-array lookups.  When
+        `r_offset`/`p_offset` are given (sharded engine), replica/partition
+        ids are GLOBAL: aggregates (replicated broker/host/topic axes) absorb
+        every row, while placement scatters translate to shard-local indices
+        and rows owned by other shards fall out of range and are dropped.
+        """
         st = sx.state
         B, R, D = self.shape.B, self.shape.R, self.shape.max_disks_per_broker
         drop = dict(mode="drop")
+        # ownership masks: negative indices would WRAP (python semantics), so
+        # rows owned by other shards must be masked to the sentinel explicitly
+        if r_offset is None:
+            r_ids, own_r = payr["r"], True
+        else:
+            r_ids = payr["r"] - r_offset
+            own_r = (r_ids >= 0) & (r_ids < R)
+        if p_offset is None:
+            p_ids, own_p = payr["part"], True
+        else:
+            p_ids = payr["part"] - p_offset
+            own_p = (p_ids >= 0) & (p_ids < self.shape.P)
 
         # ---- replica moves ----
-        r = jnp.where(sv_r, payr["r"], R)
+        r = jnp.where(sv_r & own_r, r_ids, R)
         dst = payr["dst"]
         load = payr["load"] * sv_r[:, None]
-        src = carry.replica_broker[jnp.minimum(payr["r"], R - 1)]
+        src = payr["src"]
         src_idx = jnp.where(sv_r, src, B)
         dst_idx = jnp.where(sv_r, dst, B)
 
@@ -1233,18 +1389,18 @@ class Engine:
         lb = carry.broker_leader_bytes_in.at[src_idx].add(-dlb, **drop).at[dst_idx].add(
             dlb, **drop
         )
-        t = st.replica_topic[jnp.minimum(payr["r"], R - 1)]
+        t = payr["topic"]
         T = self.shape.num_topics
         tc = (
             carry.broker_topic_count.at[jnp.where(sv_r, t, T), src_idx].add(-ones, **drop)
             .at[jnp.where(sv_r, t, T), dst_idx].add(ones, **drop)
         )
-        p = st.replica_partition[jnp.minimum(payr["r"], R - 1)]
+        p = jnp.where(sv_r & own_p, p_ids, self.shape.P)
         rack_s = st.broker_rack[src]
         rack_d = st.broker_rack[dst]
         prc = (
-            carry.part_rack_count.at[jnp.where(sv_r, p, self.shape.P), rack_s].add(-ones, **drop)
-            .at[jnp.where(sv_r, p, self.shape.P), rack_d].add(ones, **drop)
+            carry.part_rack_count.at[p, rack_s].add(-ones, **drop)
+            .at[p, rack_d].add(ones, **drop)
         )
         ddisk = load[:, int(Resource.DISK)]
         dl_ = (
@@ -1260,12 +1416,19 @@ class Engine:
         )
 
         # ---- leadership transfers ----
-        rf = jnp.where(sv_l, payl["rf"], R)
-        rt = jnp.where(sv_l, payl["rt"], R)
+        if r_offset is None:
+            rf_ids, rt_ids, own_f, own_t = payl["rf"], payl["rt"], True, True
+        else:
+            rf_ids = payl["rf"] - r_offset
+            rt_ids = payl["rt"] - r_offset
+            own_f = (rf_ids >= 0) & (rf_ids < R)
+            own_t = (rt_ids >= 0) & (rt_ids < R)
+        rf = jnp.where(sv_l & own_f, rf_ids, R)
+        rt = jnp.where(sv_l & own_t, rt_ids, R)
         is_leader = carry.replica_is_leader.at[rf].set(False, **drop).at[rt].set(True, **drop)
 
-        src_l = carry.replica_broker[jnp.minimum(payl["rf"], R - 1)]
-        dst_l = carry.replica_broker[jnp.minimum(payl["rt"], R - 1)]
+        src_l = payl["src_b"]
+        dst_l = payl["dst_b"]
         sl_idx = jnp.where(sv_l, src_l, B)
         tl_idx = jnp.where(sv_l, dst_l, B)
         dl_f = payl["dl_f"] * sv_l[:, None]
@@ -1277,8 +1440,8 @@ class Engine:
             lb.at[sl_idx].add(-payl["dlbin_src"] * sv_l, **drop)
             .at[tl_idx].add(payl["dlbin_dst"] * sv_l, **drop)
         )
-        d_f = carry.replica_disk[jnp.minimum(payl["rf"], R - 1)]
-        d_t = carry.replica_disk[jnp.minimum(payl["rt"], R - 1)]
+        d_f = payl["d_f"]
+        d_t = payl["d_t"]
         dl_ = (
             dl_.at[sl_idx, d_f].add(dl_f[:, int(Resource.DISK)], **drop)
             .at[tl_idx, d_t].add(dl_t[:, int(Resource.DISK)], **drop)

@@ -1,0 +1,46 @@
+"""Persistent XLA compilation cache.
+
+The engine's statics-as-arguments design already avoids recompiles WITHIN a
+process (analyzer/engine.py module docstring), but a service restart used to
+pay the full ~70s warm-up again (BENCH_r01 warmup_s).  JAX's persistent
+compilation cache writes compiled executables to disk keyed by HLO
+fingerprint, so a restarted service (same shapes, same jax/XLA version)
+reloads them in milliseconds.
+
+Reference analog: none — a JVM has no compile step to amortize; this is a
+TPU-framework concern (the proposal-precompute thread
+GoalOptimizer.java:124-175 amortizes model generations, not compilation).
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Idempotently point JAX at a durable on-disk compilation cache.
+
+    Returns the directory used, or None when disabled (empty dir given or
+    an old jax without the feature).
+    """
+    global _enabled
+    if not cache_dir:
+        return None
+    cache_dir = os.path.expanduser(cache_dir)
+    if _enabled:
+        return cache_dir
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that took meaningfully long to compile; tiny
+        # programs are cheaper to rebuild than to hit disk for
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _enabled = True
+        return cache_dir
+    except Exception:  # pragma: no cover — very old jax
+        return None

@@ -1,0 +1,216 @@
+"""Observability sensors: counters, gauges, timers, meters in one registry.
+
+TPU-native analog of the reference's Dropwizard MetricRegistry published
+under the `kafka.cruisecontrol` JMX domain (reference
+KafkaCruiseControlApp.java:39-41; sensor catalog docs/wiki/User
+Guide/Sensors.md:1-17).  There is no JVM/JMX here: sensors are plain
+thread-safe Python objects snapshotted into the `/state` JSON (substate
+`sensors`), which is how a TPU-side service is actually scraped.
+
+Headline sensors (same semantics as the reference catalog):
+  * analyzer.proposal-computation-timer  (GoalOptimizer.java:116,155)
+  * monitor.cluster-model-creation-timer (LoadMonitor.java:100,510)
+  * executor.execution-started / -stopped, per-mode gauges
+    (Executor.java:118-125,257)
+  * anomaly-detector per-type rates + mean-time-between-anomalies
+    (detector/AnomalyMetrics.java:1, MeanTimeBetweenAnomaliesMs.java:1)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+
+class Counter:
+    """Monotonic event count."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "count": self._count}
+
+
+class Gauge:
+    """Point-in-time value; either set explicitly or computed by a callback."""
+
+    def __init__(self, fn: Callable[[], float] | None = None) -> None:
+        self._fn = fn
+        self._value: float = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return float("nan")
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
+class Timer:
+    """Duration statistics with a bounded sample window for percentiles."""
+
+    def __init__(self, window: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+        self._min = float("inf")
+        self._max = 0.0
+        self._samples: deque[float] = deque(maxlen=window)
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            self._min = min(self._min, seconds)
+            self._max = max(self._max, seconds)
+            self._samples.append(seconds)
+
+    def time(self) -> "_TimerContext":
+        return _TimerContext(self)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            if not self._count:
+                return {"type": "timer", "count": 0}
+            ordered = sorted(self._samples)
+
+            def pct(p: float) -> float:
+                return ordered[min(len(ordered) - 1, int(p * len(ordered)))]
+
+            return {
+                "type": "timer",
+                "count": self._count,
+                "meanMs": 1e3 * self._total / self._count,
+                "minMs": 1e3 * self._min,
+                "maxMs": 1e3 * self._max,
+                "p50Ms": 1e3 * pct(0.50),
+                "p95Ms": 1e3 * pct(0.95),
+                "p99Ms": 1e3 * pct(0.99),
+            }
+
+
+class _TimerContext:
+    def __init__(self, timer: Timer) -> None:
+        self._timer = timer
+
+    def __enter__(self) -> "_TimerContext":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timer.update(time.monotonic() - self._t0)
+
+
+class Meter:
+    """Event rate + mean inter-arrival time (the MTBA sensor's shape:
+    reference detector/MeanTimeBetweenAnomaliesMs.java)."""
+
+    def __init__(self, clock: Callable[[], float] = time.time) -> None:
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._count = 0
+        self._first: float | None = None
+        self._last: float | None = None
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            now = self._clock()
+            self._count += n
+            if self._first is None:
+                self._first = now
+            self._last = now
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def mean_time_between_ms(self) -> float:
+        """Mean time between events; inf until two events were seen."""
+        with self._lock:
+            if self._count < 2 or self._first is None or self._last is None:
+                return float("inf")
+            span = self._last - self._first
+            return 1e3 * span / (self._count - 1)
+
+    def rate_per_hour(self) -> float:
+        with self._lock:
+            # a single event carries no rate information; a tiny span right
+            # after it would report an absurd spike (same count>=2 guard as
+            # mean_time_between_ms)
+            if self._count < 2 or self._first is None:
+                return 0.0
+            span = max(self._clock() - self._first, 1.0)
+            return 3600.0 * self._count / span
+
+    def snapshot(self) -> dict:
+        mtb = self.mean_time_between_ms()
+        return {
+            "type": "meter",
+            "count": self._count,
+            "ratePerHour": self.rate_per_hour(),
+            "meanTimeBetweenMs": (None if mtb == float("inf") else mtb),
+        }
+
+
+class SensorRegistry:
+    """Named sensor catalog; `snapshot()` renders the /state JSON block."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sensors: dict[str, object] = {}
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            s = self._sensors.get(name)
+            if s is None:
+                s = factory()
+                self._sensors[name] = s
+            return s
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str, fn: Callable[[], float] | None = None) -> Gauge:
+        g = self._get(name, lambda: Gauge(fn))
+        if fn is not None:
+            g._fn = fn
+        return g
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._sensors.items())
+        return {name: s.snapshot() for name, s in sorted(items)}
+
+
+#: process-wide default registry (components accept an override for tests)
+REGISTRY = SensorRegistry()

@@ -86,6 +86,10 @@ def _analyzer_defs() -> ConfigDef:
     d.define("tpu.importance.fraction", T.DOUBLE, 0.5, I.LOW,
              "fraction of candidates importance-sampled toward violating brokers",
              in_range(lo=0.0, hi=1.0), group=g)
+    d.define("tpu.compilation.cache.dir", T.STRING,
+             "~/.cache/cruise_control_tpu/xla", I.LOW,
+             "persistent XLA compilation cache directory; empty disables "
+             "(compiled programs survive service restarts)", group=g)
     return d
 
 

@@ -130,10 +130,21 @@ class AnomalyDetector:
         actions: SelfHealingActions,
         *,
         now_ms=None,
+        sensors=None,
     ):
+        from cruise_control_tpu.common.sensors import REGISTRY
+
         self.notifier = notifier
         self.actions = actions
         self.state = AnomalyDetectorState()
+        self.sensors = sensors if sensors is not None else REGISTRY
+
+        def _healing_ratio() -> float:
+            enabled = notifier.self_healing_enabled()
+            return sum(enabled.values()) / max(1, len(enabled))
+
+        # reference AnomalyMetrics self-healing-enabled ratio sensor
+        self.sensors.gauge("anomaly-detector.self-healing-enabled-ratio", _healing_ratio)
         self._queue: list[tuple[int, int, Anomaly]] = []  # (priority, seq, anomaly)
         self._seq = 0
         self._detectors: list = []
@@ -189,13 +200,21 @@ class AnomalyDetector:
         """Reference AnomalyHandlerTask:318."""
         now = self._now()
         if self.actions.is_busy:
-            # executor busy: re-check later (reference handleAnomalyInProgress)
+            # executor busy: re-check later (reference handleAnomalyInProgress);
+            # NOT counted in the rate sensors — a busy-delayed anomaly cycling
+            # through _handle is one event, not many
             with self._lock:
                 self._delayed.append((now + 30_000, self._seq, anomaly))
                 self._seq += 1
             rec = AnomalyRecord(anomaly, "CHECKED", now)
             self.state.record(anomaly, "CHECKED", now)
             return rec
+        # per-type rate + mean-time-between-anomalies sensors (reference
+        # detector/AnomalyMetrics.java, MeanTimeBetweenAnomaliesMs.java)
+        self.sensors.meter(
+            f"anomaly-detector.{anomaly.anomaly_type.name.lower()}.rate"
+        ).mark()
+        self.sensors.meter("anomaly-detector.mean-time-between-anomalies").mark()
         result = self.notifier.on_anomaly(anomaly)
         if result.action == Action.IGNORE:
             status = "IGNORED"

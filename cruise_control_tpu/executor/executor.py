@@ -89,7 +89,11 @@ class Executor:
         strategy: ReplicaMovementStrategy | None = None,
         topic_names: dict[int, str] | None = None,
         catalog=None,
+        sensors=None,
     ):
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        self.sensors = sensors if sensors is not None else REGISTRY
         self.admin = admin
         self.strategy = strategy
         self.topic_names = topic_names or {}
@@ -122,6 +126,10 @@ class Executor:
                 self._force_stop = force
                 self.num_executions_stopped += 1
                 self.state = ExecutorState.STOPPING_EXECUTION
+                # reference Executor execution-stopped gauge (:118-125,257)
+                self.sensors.counter("executor.execution-stopped").inc()
+                if force:
+                    self.sensors.counter("executor.execution-stopped.forced").inc()
 
     def execute_proposals(
         self,
@@ -143,6 +151,8 @@ class Executor:
             self._force_stop = False
             self._uuid = uuid
             self.num_executions_started += 1
+            # reference Executor execution-started sensor (:118-125)
+            self.sensors.counter("executor.execution-started").inc()
             if removed_brokers:
                 self.removed_brokers |= removed_brokers
             if demoted_brokers:

@@ -168,7 +168,17 @@ class LoadMonitor:
         allow_capacity_estimation: bool = True,
     ) -> ClusterState:
         """Generate the array-encoded cluster model
-        (reference LoadMonitor.clusterModel():485-568)."""
+        (reference LoadMonitor.clusterModel():485-568; timed like its
+        cluster-model-creation-timer sensor, LoadMonitor.java:100,510)."""
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        sensors = getattr(self, "sensors", None) or REGISTRY
+        with sensors.timer("monitor.cluster-model-creation-timer").time():
+            return self._cluster_model_impl(requirements)
+
+    def _cluster_model_impl(
+        self, requirements: ModelCompletenessRequirements
+    ) -> ClusterState:
         topology = self.metadata.refresh()
         agg = self.partition_aggregator.aggregate(
             AggregationOptions(

@@ -122,7 +122,9 @@ class LoadMonitorTaskRunner:
 
     def train(self, start_ms: int, end_ms: int) -> dict:
         """Reference TrainingTask: harvest (bytes-in, bytes-out, follower
-        bytes-in, cpu) tuples from broker samples into the regression."""
+        bytes-in, cpu) tuples from broker samples into the regression —
+        restricted to windows inside [start_ms, end_ms) as requested
+        (reference LoadMonitor.train:354 passes the range through)."""
         self._enter(MonitorState.TRAINING)
         try:
             agg = self.fetcher.broker_aggregator
@@ -138,6 +140,12 @@ class LoadMonitorTaskRunner:
                 for e_idx in range(res.values.shape[0]):
                     for w in range(res.values.shape[1]):
                         if not res.window_valid[e_idx, w]:
+                            continue
+                        # NB: broker windows have their OWN span (reference
+                        # broker.metrics.window.ms), not the partition span
+                        # this runner was built with
+                        w_start = int(res.window_indices[w]) * agg.window_ms
+                        if not (start_ms <= w_start < end_ms):
                             continue
                         v = res.values[e_idx, w]
                         self.regression.add_sample(

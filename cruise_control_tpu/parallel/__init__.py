@@ -1,0 +1,27 @@
+"""Multi-device parallelism: restart portfolios (DP) + model sharding.
+
+Two orthogonal axes over a `jax.sharding.Mesh` (SURVEY §2.6):
+  * portfolio.py — independent SA chains sharded over devices, winner by
+    all_gather race (data parallelism over restarts);
+  * sharded.py — the cluster model itself sharded (replica/partition axes)
+    with replicated broker aggregates and psum'd refresh, for models
+    exceeding one chip's HBM ("replica-axis sharding is our sequence
+    parallelism").
+"""
+
+from cruise_control_tpu.parallel.portfolio import default_mesh, portfolio_run
+from cruise_control_tpu.parallel.sharded import (
+    MODEL_AXIS,
+    ShardedEngine,
+    build_layout,
+    model_mesh,
+)
+
+__all__ = [
+    "MODEL_AXIS",
+    "ShardedEngine",
+    "build_layout",
+    "default_mesh",
+    "model_mesh",
+    "portfolio_run",
+]

@@ -1,0 +1,458 @@
+"""Model-sharded optimization: replica/partition axes split over the mesh.
+
+The restart portfolio (portfolio.py) is pure data parallelism — every device
+holds the WHOLE cluster model.  At reference scale that is fine (200k
+partitions ≈ tens of MB), but the design must also cover models that exceed
+one chip's HBM (SURVEY §2.6: "replica-axis sharding is our sequence
+parallelism"; §7 M6).  This module shards the MODEL itself:
+
+  * The replica axis [R] and partition axis [P] are sharded across the mesh,
+    with a partition-grouped layout so every replica of a partition lives on
+    the same shard (leadership transfers and rack counts stay shard-local).
+  * The small broker/host/topic/disk aggregates ([B]-sized) are REPLICATED;
+    every device applies the same aggregate updates so they never diverge.
+  * Each step, every device samples candidates from ITS replica shard and
+    evaluates exact objective deltas locally (the broker aggregates it needs
+    are replicated).  Candidate metadata — not replica data — is exchanged
+    with one `all_gather` over the mesh axis, conflict resolution runs
+    identically everywhere, and each shard scatters only the placement rows
+    it owns (`Engine._apply` with r_offset/p_offset translation).
+  * Aggregate re-derivation (`refresh`) computes per-shard partial
+    segment-sums and `psum`s them over the mesh — the objective's partial
+    reductions ride ICI, never the host.
+
+Communication per step is O(num_candidates) floats — independent of R — so
+the design scales to arbitrarily large cluster models at constant per-step
+comm volume.  Candidate throughput also scales: n devices evaluate
+n × num_candidates moves per step.
+
+Swap partners are sampled within a shard (a swap across shards would need a
+second placement exchange); relocations and leadership transfers are
+unrestricted, so cross-shard mass still moves freely — shards partition the
+*partition id space*, not brokers.
+
+Reference analog: none — the reference's optimizer is a single-threaded Java
+loop over one in-heap model (analyzer/goals/AbstractGoal.java:66-107).  This
+is the TPU-native scale-out story for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cruise_control_tpu.analyzer.engine import (
+    Engine,
+    EngineCarry,
+    OptimizerConfig,
+    build_statics,
+    partition_replica_table,
+)
+from cruise_control_tpu.analyzer.objective import GoalChain
+from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.resources import NUM_RESOURCES
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.aggregates import compute_aggregates
+from cruise_control_tpu.models.state import ClusterShape, ClusterState
+
+MODEL_AXIS = "model"
+
+
+def model_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (MODEL_AXIS,))
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except (ImportError, TypeError):  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)
+
+
+def _unstack(tree):
+    """[1, ...] shard_map block -> local pytree."""
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _restack(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def _tree_stack(trees):
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLayout:
+    """Host-side partition-grouped sharding of a ClusterState.
+
+    orig_index[i, j] is the original replica id behind shard i's local slot
+    j, or -1 for padding — the inverse map used to reassemble the optimized
+    placement in the original replica order.
+    """
+
+    n_shards: int
+    R_local: int
+    P_local: int
+    max_rf: int
+    orig_index: np.ndarray  # i32[n, R_local]
+    local_states: list  # per-shard ClusterState (numpy-backed)
+
+
+def build_layout(state: ClusterState, n: int) -> ShardLayout:
+    """Split `state` into n partition-aligned shards.
+
+    Partitions [i*P_local, (i+1)*P_local) and every replica of those
+    partitions land on shard i; each shard is padded to a uniform R_local so
+    the stacked arrays are rectangular.
+    """
+    s = state.shape
+    P_local = -(-s.P // n)  # ceil
+    valid = np.asarray(state.replica_valid)
+    part = np.asarray(state.replica_partition)
+    shard_of = np.where(valid, part // P_local, -1)
+    counts = np.bincount(shard_of[valid], minlength=n)
+    R_local = max(8, int(-(-int(counts.max()) // 8) * 8))  # pad to /8
+    counts_all = np.bincount(part[valid], minlength=s.P)
+    max_rf = max(1, int(counts_all.max())) if counts_all.size else 1
+
+    local_shape = ClusterShape(
+        num_replicas=R_local,
+        num_brokers=s.B,
+        num_partitions=P_local,
+        num_topics=s.num_topics,
+        num_racks=s.num_racks,
+        num_hosts=s.num_hosts,
+        max_disks_per_broker=s.max_disks_per_broker,
+    )
+    orig_index = np.full((n, R_local), -1, np.int64)
+    locals_: list[ClusterState] = []
+    repl_fields = [
+        "replica_broker", "replica_partition", "replica_topic", "replica_pos",
+        "replica_is_leader", "replica_valid", "replica_orig_broker",
+        "replica_offline", "replica_disk", "replica_load_leader",
+        "replica_load_follower",
+    ]
+    for i in range(n):
+        sel = np.nonzero(shard_of == i)[0]
+        k = sel.size
+        orig_index[i, :k] = sel
+        kw = {}
+        for f in repl_fields:
+            src = np.asarray(getattr(state, f))
+            pad_shape = (R_local,) + src.shape[1:]
+            dst = np.zeros(pad_shape, src.dtype)
+            dst[:k] = src[sel]
+            kw[f] = dst
+        kw["replica_partition"] = kw["replica_partition"] - np.int32(i * P_local)
+        kw["replica_partition"][k:] = 0
+        kw["replica_valid"][k:] = False
+        locals_.append(
+            dataclasses.replace(
+                state,
+                shape=local_shape,
+                **{f: jnp.asarray(v) for f, v in kw.items()},
+            )
+        )
+    return ShardLayout(
+        n_shards=n, R_local=R_local, P_local=P_local, max_rf=max_rf,
+        orig_index=orig_index, local_states=locals_,
+    )
+
+
+class ShardedEngine:
+    """Engine wrapper that runs ONE annealing chain over a sharded model.
+
+    Reuses Engine's candidate/delta/apply machinery on shard-local views; the
+    cross-shard glue (gather, global selection, psum'd refresh) lives here.
+    """
+
+    def __init__(
+        self,
+        state: ClusterState,
+        chain: GoalChain,
+        mesh: Mesh | None = None,
+        constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        config: OptimizerConfig = OptimizerConfig(),
+    ):
+        self.mesh = mesh if mesh is not None else model_mesh()
+        self.n = int(self.mesh.devices.size)
+        self.global_state = state
+        self.layout = build_layout(state, self.n)
+        self.P_total = self.layout.P_local * self.n
+        # local-shape engine: candidate generation + apply run per shard
+        self.engine = Engine(
+            self.layout.local_states[0], chain, constraint, options, config
+        )
+        n_valid_global = jnp.asarray(
+            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
+        )
+        statics_list = []
+        for ls in self.layout.local_states:
+            sx = build_statics(ls, options)
+            sx = dataclasses.replace(
+                sx,
+                n_valid=n_valid_global,
+                part_replicas=jnp.asarray(
+                    partition_replica_table(ls, max_rf=self.layout.max_rf)
+                ),
+            )
+            statics_list.append(sx)
+        self.statics = _tree_stack(statics_list)
+
+        spec_in = P(MODEL_AXIS)
+        self._jit_init = jax.jit(
+            _shard_map(
+                self._init_fn, self.mesh,
+                in_specs=(spec_in, spec_in), out_specs=spec_in,
+            )
+        )
+        self._jit_round = jax.jit(
+            _shard_map(
+                self._round_fn, self.mesh,
+                in_specs=(spec_in, spec_in, P()), out_specs=(spec_in, spec_in),
+            )
+        )
+        self._jit_obj = jax.jit(
+            _shard_map(
+                self._obj_fn, self.mesh,
+                in_specs=(spec_in, spec_in), out_specs=spec_in,
+            )
+        )
+
+    # ---- traced per-shard bodies (run inside shard_map) ----
+
+    def _sharded_refresh(self, sx, carry: EngineCarry) -> EngineCarry:
+        """Re-derive aggregates: local partial segment-sums + psum over mesh."""
+        eng = self.engine
+        state = eng.carry_to_state(carry, sx)
+        agg = compute_aggregates(state)  # partials (local replicas, full B axis)
+        psum = lambda x: jax.lax.psum(x, MODEL_AXIS)  # noqa: E731
+        broker_load = psum(agg.broker_load)
+        hseg = jnp.where(
+            state.broker_valid, state.broker_host, eng.shape.num_hosts
+        )
+        host_load = jax.ops.segment_sum(
+            broker_load, hseg, num_segments=eng.shape.num_hosts + 1
+        )[: eng.shape.num_hosts]
+        return dataclasses.replace(
+            carry,
+            broker_load=broker_load,
+            broker_replica_count=psum(agg.broker_replica_count),
+            broker_leader_count=psum(agg.broker_leader_count),
+            broker_potential_nw_out=psum(agg.broker_potential_nw_out),
+            broker_leader_bytes_in=psum(agg.broker_leader_bytes_in),
+            broker_topic_count=psum(agg.broker_topic_count),
+            part_rack_count=agg.part_rack_count,  # partition axis: shard-local
+            disk_load=psum(agg.disk_load),
+            host_load=host_load,
+        )
+
+    def _sharded_objective(self, sx, carry: EngineCarry):
+        """carry_objective with the partition/replica partials psum'd."""
+        eng = self.engine
+        g = eng._globals(sx, carry)
+        b = jnp.arange(eng.shape.B)
+        terms = eng._broker_terms(
+            sx, b,
+            carry.broker_load, carry.broker_replica_count,
+            carry.broker_leader_count, carry.broker_potential_nw_out,
+            carry.broker_leader_bytes_in, g,
+        ).sum()
+        rack_local = jnp.maximum(carry.part_rack_count - 1, 0).sum().astype(jnp.float32)
+        st = sx.state
+        off_local = (
+            st.replica_valid
+            & ~(
+                st.broker_alive[carry.replica_broker]
+                & st.disk_alive[carry.replica_broker, carry.replica_disk]
+            )
+        ).sum().astype(jnp.float32)
+        partials = jax.lax.psum(jnp.stack([rack_local, off_local]), MODEL_AXIS)
+        terms += eng.w.rack * partials[0] / sx.n_valid
+        terms += eng.w.offline * partials[1] / sx.n_valid
+        terms += eng._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
+        return terms
+
+    def _sharded_step(self, sx, carry: EngineCarry, temperature, plan):
+        eng = self.engine
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        r_off = idx * self.layout.R_local
+        p_off = idx * self.layout.P_local
+
+        key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
+        g = eng._globals(sx, carry)
+        prop = eng._propose(sx, carry, k_r, k_s, k_l, g, plan)
+
+        delta, feas = prop["delta"], prop["feas"]
+        K = delta.shape[0]
+        u = jax.random.uniform(k_u, (K,), minval=1e-12, maxval=1.0)
+        accept = feas & (delta < -temperature * jnp.log(u) - 1e-12)
+
+        # globalize replica/partition ids, then exchange candidate METADATA
+        # (O(K) floats — never replica data) across the mesh
+        payr = dict(prop["payr"])
+        payl = {k: v for k, v in prop["payl"].items() if not isinstance(v, int)}
+        payr["r"] = payr["r"] + r_off
+        payr["part"] = payr["part"] + p_off
+        payl["rf"] = payl["rf"] + r_off
+        payl["rt"] = payl["rt"] + r_off
+
+        gather = lambda x: jax.lax.all_gather(x, MODEL_AXIS, tiled=True)  # noqa: E731
+        delta_all = gather(delta)
+        accept_all = gather(accept)
+        src_all = gather(prop["src"])
+        dst_all = gather(prop["dst"])
+        p1_all = gather(prop["part1"] + p_off)
+        p2_all = gather(prop["part2"] + p_off)
+        payr_all = {k: gather(v) for k, v in payr.items()}
+        payl_all = {k: gather(v) for k, v in payl.items()}
+
+        # identical global conflict resolution on every shard
+        survive = eng._select(
+            accept_all, delta_all, src_all, dst_all, p1_all, p2_all,
+            num_parts=self.P_total,
+        )
+        nr, ns = prop["nr"], prop["ns"]
+        sv = survive.reshape(self.n, K)
+        sv_r_ext = jnp.concatenate(
+            [sv[:, :nr], sv[:, nr: nr + ns], sv[:, nr: nr + ns]], axis=1
+        ).reshape(-1)
+        sv_l = sv[:, nr + ns:].reshape(-1)
+
+        # replicated aggregates absorb ALL rows; placement scatters translate
+        # to shard-local ids and foreign rows drop out of range
+        carry = eng._apply(
+            sx, carry, sv_r_ext, payr_all, sv_l, payl_all,
+            r_offset=r_off, p_offset=p_off,
+        )
+        carry = dataclasses.replace(carry, key=key)
+        stats = dict(
+            accepted=survive.sum(),
+            improving=(accept_all & (delta_all < 0)).sum(),
+        )
+        return carry, stats
+
+    # ---- shard_map entry points (blocks have a leading axis of 1) ----
+
+    def _init_fn(self, sx_blk, keys_blk):
+        sx = _unstack(sx_blk)
+        key = keys_blk[0]
+        eng = self.engine
+        st = sx.state
+        B = eng.shape.B
+        carry = EngineCarry(
+            replica_broker=st.replica_broker,
+            replica_is_leader=st.replica_is_leader,
+            replica_disk=st.replica_disk,
+            broker_load=jnp.zeros((B, NUM_RESOURCES), jnp.float32),
+            broker_replica_count=jnp.zeros(B, jnp.int32),
+            broker_leader_count=jnp.zeros(B, jnp.int32),
+            broker_potential_nw_out=jnp.zeros(B, jnp.float32),
+            broker_leader_bytes_in=jnp.zeros(B, jnp.float32),
+            broker_topic_count=jnp.zeros((eng.shape.num_topics, B), jnp.int32),
+            part_rack_count=jnp.zeros(
+                (eng.shape.P, eng.shape.num_racks), jnp.int32
+            ),
+            disk_load=jnp.zeros((B, eng.shape.max_disks_per_broker), jnp.float32),
+            host_load=jnp.zeros((eng.shape.num_hosts, NUM_RESOURCES), jnp.float32),
+            key=key,
+        )
+        return _restack(self._sharded_refresh(sx, carry))
+
+    def _round_fn(self, sx_blk, carry_blk, temps):
+        sx = _unstack(sx_blk)
+        carry = _unstack(carry_blk)
+        eng = self.engine
+        plan = eng._plan_impl(sx, carry)
+        # reprice movement against the GLOBAL objective (the local plan's
+        # pricing only saw this shard's rack/offline partials)
+        unit = self._sharded_objective(sx, carry) / sx.n_valid
+        plan = dataclasses.replace(
+            plan,
+            replica_cost=eng.config.replica_move_cost * unit,
+            lead_cost=eng.config.leadership_move_cost * unit,
+        )
+
+        def body(c, t):
+            return self._sharded_step(sx, c, t, plan)
+
+        carry, stats = jax.lax.scan(body, carry, temps)
+        carry = self._sharded_refresh(sx, carry)
+        return _restack(carry), jax.tree.map(lambda x: x[None], stats)
+
+    def _obj_fn(self, sx_blk, carry_blk):
+        obj = self._sharded_objective(_unstack(sx_blk), _unstack(carry_blk))
+        return obj[None]
+
+    # ---- host-side driver ----
+
+    def run(self, *, verbose: bool = False):
+        """Execute the annealing schedule over the sharded model.
+
+        Mirrors Engine.run: python rounds, each one jitted scan over the
+        mesh; refresh between rounds washes out incremental float drift.
+        """
+        cfg = self.engine.config
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), self.n)
+        carry = self._jit_init(self.statics, keys)
+        t0_obj = float(np.asarray(self._jit_obj(self.statics, carry))[0])
+        t0_obj *= cfg.init_temperature_scale
+        history = []
+        for rnd in range(cfg.num_rounds):
+            t_round = (
+                0.0 if rnd == cfg.num_rounds - 1
+                else t0_obj * (cfg.temperature_decay**rnd)
+            )
+            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
+            carry, stats = self._jit_round(self.statics, carry, temps)
+            rec = dict(
+                round=rnd,
+                temperature=t_round,
+                accepted=int(np.asarray(stats["accepted"])[0].sum()),
+            )
+            if verbose:
+                rec["objective"] = float(np.asarray(self._jit_obj(self.statics, carry))[0])
+            history.append(rec)
+        return self.final_state(carry), history
+
+    def objective(self, carry) -> float:
+        return float(np.asarray(self._jit_obj(self.statics, carry))[0])
+
+    def final_state(self, carry) -> ClusterState:
+        """Reassemble the optimized placement in the original replica order."""
+        lay = self.layout
+        rb = np.asarray(carry.replica_broker)  # [n, R_local]
+        rl = np.asarray(carry.replica_is_leader)
+        rd = np.asarray(carry.replica_disk)
+        st = self.global_state
+        g_rb = np.array(np.asarray(st.replica_broker))
+        g_rl = np.array(np.asarray(st.replica_is_leader))
+        g_rd = np.array(np.asarray(st.replica_disk))
+        own = lay.orig_index >= 0
+        idx = lay.orig_index[own]
+        g_rb[idx] = rb[own]
+        g_rl[idx] = rl[own]
+        g_rd[idx] = rd[own]
+        alive = np.asarray(st.broker_alive)
+        dalive = np.asarray(st.disk_alive)
+        offline = ~(alive[g_rb] & dalive[g_rb, g_rd]) & np.asarray(st.replica_valid)
+        return dataclasses.replace(
+            st,
+            replica_broker=jnp.asarray(g_rb),
+            replica_is_leader=jnp.asarray(g_rl),
+            replica_disk=jnp.asarray(g_rd),
+            replica_offline=jnp.asarray(offline),
+        )

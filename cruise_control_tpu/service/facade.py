@@ -28,6 +28,7 @@ from cruise_control_tpu.analyzer import (
     OptimizerResult,
 )
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.sensors import SensorRegistry
 from cruise_control_tpu.config.app_config import CruiseControlConfig
 from cruise_control_tpu.detector import (
     AnomalyDetector,
@@ -71,10 +72,16 @@ class CruiseControl:
         admin: ClusterAdmin,
         *,
         chain: GoalChain | None = None,
+        sensors: SensorRegistry | None = None,
     ):
         self.config = config
         self.monitor = monitor
         self.admin = admin
+        #: per-instance sensor catalog (module-global registries would mix
+        #: counters across embedded instances; reference scopes its
+        #: MetricRegistry per app, KafkaCruiseControlApp.java:39-41)
+        self.sensors = sensors if sensors is not None else SensorRegistry()
+        monitor.sensors = self.sensors
         self.constraint = config.balancing_constraint()
         self.chain = chain or GoalChain.from_names(config.get("default.goals"))
         self.optimizer = GoalOptimizer(
@@ -82,7 +89,7 @@ class CruiseControl:
             constraint=self.constraint,
             config=config.optimizer_config(),
         )
-        self.executor = Executor(admin)
+        self.executor = Executor(admin, sensors=self.sensors)
         self._cache: _CachedResult | None = None
         self._cache_lock = threading.Lock()
         self._proposal_expiration_ms = config.get("proposal.expiration.ms")
@@ -101,7 +108,7 @@ class CruiseControl:
         )
         self.notifier = notifier
         self.actions = SelfHealingAdapter(self)
-        self.anomaly_detector = AnomalyDetector(notifier, self.actions)
+        self.anomaly_detector = AnomalyDetector(notifier, self.actions, sensors=self.sensors)
         self._wire_detectors()
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
@@ -195,7 +202,9 @@ class CruiseControl:
                 config=self.config.optimizer_config(),
             )
         progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
-        result = optimizer.optimize(state, options=options or OptimizationOptions())
+        # reference GoalOptimizer proposal-computation-timer (:116,155)
+        with self.sensors.timer("analyzer.proposal-computation-timer").time():
+            result = optimizer.optimize(state, options=options or OptimizationOptions())
         if cacheable:
             with self._cache_lock:
                 self._cache = _CachedResult(
@@ -296,9 +305,17 @@ class CruiseControl:
         goals: list[str] | None = None,
         destination_broker_ids: list[int] | None = None,
         excluded_topics_pattern: str | None = None,
+        rebalance_disk: bool = False,
     ) -> dict:
-        """Reference RebalanceRunnable.workWithoutClusterModel:116."""
-        custom = bool(destination_broker_ids or excluded_topics_pattern or goals)
+        """Reference RebalanceRunnable.workWithoutClusterModel:116.
+
+        rebalance_disk selects the intra-broker (JBOD) goal chain and an
+        engine whose candidates move replicas between a broker's own logdirs
+        (reference rebalance_disk semantics; AnalyzerConfig.java:236
+        default.intra.broker.goals)."""
+        custom = bool(
+            destination_broker_ids or excluded_topics_pattern or goals or rebalance_disk
+        )
         if custom:
             state = self._cluster_model(progress)
             options = self._build_options(
@@ -307,7 +324,19 @@ class CruiseControl:
                 excluded_topics_pattern=excluded_topics_pattern,
             )
             optimizer = self.optimizer
-            if goals is not None:
+            if rebalance_disk:
+                from cruise_control_tpu.analyzer.goals import (
+                    DEFAULT_INTRA_BROKER_GOAL_ORDER,
+                )
+
+                optimizer = GoalOptimizer(
+                    chain=GoalChain.from_names(goals or DEFAULT_INTRA_BROKER_GOAL_ORDER),
+                    constraint=self.constraint,
+                    config=dataclasses.replace(
+                        self.config.optimizer_config(), intra_broker=True
+                    ),
+                )
+            elif goals is not None:
                 optimizer = GoalOptimizer(
                     chain=GoalChain.from_names(goals),
                     constraint=self.constraint,
@@ -410,8 +439,18 @@ class CruiseControl:
     # ------------------------------------------------------------------
 
     def state(self, substates: list[str] | None = None) -> dict:
-        substates = [s.lower() for s in (substates or ["monitor", "executor", "analyzer", "anomaly_detector"])]
+        substates = [
+            s.lower()
+            for s in (
+                substates
+                or ["monitor", "executor", "analyzer", "anomaly_detector", "sensors"]
+            )
+        ]
         out: dict = {"version": 1}
+        if "sensors" in substates:
+            # reference publishes these via JMX (KafkaCruiseControlApp.java:39-41,
+            # docs/wiki/User Guide/Sensors.md); here they ride the /state JSON
+            out["Sensors"] = self.sensors.snapshot()
         if "monitor" in substates:
             out["MonitorState"] = self.monitor.monitor_state()
             runner = getattr(self, "task_runner", None)

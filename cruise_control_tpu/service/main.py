@@ -36,6 +36,9 @@ def build_service(
     sample_store=None,
     partitions_fn=None,
 ) -> tuple[CruiseControlApp, MetricFetcherManager]:
+    from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
     if capacity_resolver is None:
         path = config.get("capacity.config.file")
         capacity_resolver = (

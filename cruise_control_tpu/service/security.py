@@ -186,6 +186,14 @@ class SessionManager:
         with self._lock:
             self._sessions.pop(key, None)
 
+    def release_task(self, task_id: str):
+        """Unbind every session pointing at task_id (used when the task's
+        response was delivered via the User-Task-ID header path, so a later
+        identical request must execute fresh rather than resume it)."""
+        with self._lock:
+            for k in [k for k, (t, _) in self._sessions.items() if t == task_id]:
+                del self._sessions[k]
+
     def _expire(self, now: int):
         for k in [
             k for k, (_, t) in self._sessions.items() if now - t > self.max_expiry_ms

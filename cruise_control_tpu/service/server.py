@@ -116,7 +116,12 @@ class CruiseControlApp:
                 # reference UserTaskManager rejects unknown task ids rather
                 # than silently re-executing the operation
                 return 404, {"errorMessage": f"unknown user task id {tid}"}
-            return self._task_response(task)
+            status, payload = self._task_response(task)
+            if status != 202:
+                # response delivered: drop any session bound to this task, or
+                # a later identical request would resume the stale result
+                self.sessions.release_task(tid)
+            return status, payload
         # header lost: rebind via session key (reference SessionManager).
         # Binding needs a client identity (reference: the HTTP session) —
         # anonymous requests must NOT share one namespace, or client B's
@@ -335,6 +340,7 @@ class CruiseControlApp:
 
     def _ep_rebalance(self, params) -> tuple[int, dict]:
         dryrun = _parse_bool(params, "dryrun", True)
+        rebalance_disk = _parse_bool(params, "rebalance_disk", False)
         goals = params.get("goals", [None])[0]
         dests = params.get("destination_broker_ids", [None])[0]
         excluded = params.get("excluded_topics", [None])[0]
@@ -346,6 +352,7 @@ class CruiseControlApp:
                 goals=goals.split(",") if goals else None,
                 destination_broker_ids=[int(x) for x in dests.split(",")] if dests else None,
                 excluded_topics_pattern=excluded,
+                rebalance_disk=rebalance_disk,
             )
 
         return self._async_op("rebalance", op)

@@ -108,11 +108,16 @@ def random_cluster(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
     rng = np.random.default_rng(seed)
     b = ClusterModelBuilder(replica_capacity=spec.replica_capacity)
     cap = np.asarray(spec.broker_capacity, np.float32)
+    D = max(1, spec.disks_per_broker)
+    disks = (
+        [float(cap[Resource.DISK]) / D] * D if D > 1 else None
+    )  # JBOD: split capacity evenly across logdirs
     for i in range(spec.num_brokers):
         alive = i < spec.num_brokers - spec.num_dead_brokers
         new = i >= spec.num_brokers - spec.num_new_brokers if alive else False
         b.add_broker(
-            BrokerSpec(i, rack=f"r{i % spec.num_racks}", capacity=cap, alive=alive, new_broker=new)
+            BrokerSpec(i, rack=f"r{i % spec.num_racks}", capacity=cap, alive=alive,
+                       new_broker=new, disk_capacities=disks)
         )
     means = np.array(
         [spec.mean_cpu, spec.mean_nw_in, spec.mean_nw_out, spec.mean_disk], np.float64
@@ -127,7 +132,10 @@ def random_cluster(spec: RandomClusterSpec, seed: int = 0) -> ClusterState:
         rf = min(rf, spec.num_brokers)
         brokers = rng.choice(spec.num_brokers, size=rf, replace=False, p=w / w.sum()).tolist()
         load = (means * np.exp(rng.normal(0.0, spec.deviation, NUM_RESOURCES))).astype(np.float32)
-        b.add_partition(PartitionSpec(f"T{t}", p, [int(x) for x in brokers], load))
+        rdisks = [int(x) for x in rng.integers(0, D, size=rf)] if D > 1 else None
+        b.add_partition(
+            PartitionSpec(f"T{t}", p, [int(x) for x in brokers], load, replica_disks=rdisks)
+        )
     return b.build()
 
 

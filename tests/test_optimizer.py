@@ -196,3 +196,41 @@ def test_tpu_beats_or_matches_greedy_oracle():
 
     res = GoalOptimizer(config=FAST).optimize(state)
     assert res.objective_after <= float(obj_greedy) * (1 + 1e-4) + 1e-9
+
+
+def test_intra_broker_disk_rebalance():
+    """rebalance_disk mode: JBOD disks balance WITHOUT any inter-broker
+    movement (reference default.intra.broker.goals, AnalyzerConfig.java:236;
+    Executor.intraBrokerMoveReplicas:1036)."""
+    from cruise_control_tpu.analyzer.goals import DEFAULT_INTRA_BROKER_GOAL_ORDER
+    from cruise_control_tpu.analyzer.objective import GoalChain
+    from cruise_control_tpu.testing.fixtures import random_cluster_fast
+
+    # random_cluster_fast scatters replicas over random logdirs -> imbalance
+    state = random_cluster_fast(
+        RandomClusterSpec(
+            num_brokers=6, num_partitions=200, disks_per_broker=4, deviation=1.0
+        ),
+        seed=7,
+    )
+    chain = GoalChain.from_names(DEFAULT_INTRA_BROKER_GOAL_ORDER)
+    obj0, _, _ = chain.evaluate(state)
+    opt = GoalOptimizer(
+        chain=chain,
+        config=OptimizerConfig(
+            num_candidates=128, steps_per_round=16, num_rounds=3, intra_broker=True
+        ),
+    )
+    res = opt.optimize(state)
+    validate(res.state_after)
+    assert res.objective_after < float(obj0)
+    # no replica may change broker; all movement is logdir-to-logdir
+    before_b = np.asarray(state.replica_broker)
+    after_b = np.asarray(res.state_after.replica_broker)
+    np.testing.assert_array_equal(before_b, after_b)
+    before_l = np.asarray(state.replica_is_leader)
+    after_l = np.asarray(res.state_after.replica_is_leader)
+    np.testing.assert_array_equal(before_l, after_l)
+    assert any(p.disk_moves for p in res.proposals)
+    for p in res.proposals:
+        assert sorted(p.old_replicas) == sorted(p.new_replicas)

@@ -1,0 +1,72 @@
+"""Sensor registry tests (reference docs/wiki/User Guide/Sensors.md parity)."""
+
+import time
+
+from cruise_control_tpu.common.sensors import (
+    Counter,
+    Gauge,
+    Meter,
+    SensorRegistry,
+    Timer,
+)
+
+
+def test_counter_and_gauge():
+    reg = SensorRegistry()
+    reg.counter("x").inc()
+    reg.counter("x").inc(2)
+    assert reg.counter("x").count == 3
+    reg.gauge("g").set(1.5)
+    assert reg.gauge("g").value == 1.5
+    reg.gauge("cb", fn=lambda: 7.0)
+    snap = reg.snapshot()
+    assert snap["x"] == {"type": "counter", "count": 3}
+    assert snap["cb"]["value"] == 7.0
+
+
+def test_timer_statistics():
+    t = Timer()
+    for ms in (10, 20, 30):
+        t.update(ms / 1e3)
+    snap = t.snapshot()
+    assert snap["count"] == 3
+    assert abs(snap["meanMs"] - 20.0) < 1e-6
+    assert snap["minMs"] <= snap["p50Ms"] <= snap["maxMs"]
+    with t.time():
+        time.sleep(0.01)
+    assert t.count == 4
+
+
+def test_meter_mtba():
+    clock = iter([0.0, 1.0, 3.0, 10.0])
+    m = Meter(clock=lambda: next(clock))
+    assert m.mean_time_between_ms() == float("inf")
+    m.mark()  # t=0
+    m.mark()  # t=1
+    m.mark()  # t=3
+    # mean time between 3 events spanning 3s = 1500ms
+    assert abs(m.mean_time_between_ms() - 1500.0) < 1e-6
+    snap = m.snapshot()
+    assert snap["count"] == 3
+
+
+def test_headline_sensors_reach_state_endpoint():
+    """facade.state() must expose the (per-instance) sensor catalog under
+    /state; a second service instance must not see the first's counters."""
+    from cruise_control_tpu.service.main import build_simulated_service
+
+    app, fetcher, admin, sampler = build_simulated_service(seed=5)
+    app2, *_ = build_simulated_service(seed=6)
+    try:
+        app.cc.sensors.timer("analyzer.proposal-computation-timer").update(0.5)
+        out = app.cc.state()
+        assert "Sensors" in out
+        sensors = out["Sensors"]
+        assert sensors["analyzer.proposal-computation-timer"]["count"] == 1
+        assert "anomaly-detector.self-healing-enabled-ratio" in sensors
+        # isolation: instance 2 never computed a proposal
+        s2 = app2.cc.state()["Sensors"]
+        assert "analyzer.proposal-computation-timer" not in s2
+    finally:
+        app.stop()
+        app2.stop()

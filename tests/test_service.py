@@ -369,6 +369,39 @@ def test_session_rebind_resumes_same_task():
         app.stop()
 
 
+def test_header_delivery_releases_session_binding():
+    """If the final response is delivered via the User-Task-ID header path,
+    the session binding must be dropped too — a later identical request must
+    execute fresh rather than resume the stale completed task."""
+    config = _service_config(**{
+        "tpu.num.candidates": 64,
+        "tpu.leadership.candidates": 16,
+        "tpu.steps.per.round": 8,
+        "tpu.num.rounds": 2,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=9)
+    app.start()
+    try:
+        headers = {"X-Client": "c2"}
+        status, payload, h = _request(app, "GET", "proposals", headers=headers)
+        tid = h.get("User-Task-ID")
+        deadline = time.time() + 60
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.3)
+            # poll by HEADER (keeps the session binding out of the loop)
+            status, payload, h = _request(
+                app, "GET", "proposals",
+                headers={"X-Client": "c2", "User-Task-ID": tid},
+            )
+        assert status == 200
+        assert app.sessions.num_active() == 0  # header delivery released it
+        # identical request again: must start a NEW task, not resume tid
+        status2, _, h2 = _request(app, "GET", "proposals", headers=headers)
+        assert h2.get("User-Task-ID") != tid
+    finally:
+        app.stop()
+
+
 def test_two_step_verification_flow():
     config = CruiseControlConfig(
         {

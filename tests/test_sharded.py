@@ -1,0 +1,141 @@
+"""Model-sharded engine tests (8-device virtual CPU mesh, conftest.py).
+
+Exercises parallel/sharded.py: the cluster model's replica/partition axes
+are explicitly sharded across the mesh (one shard per device), candidates
+are exchanged with all_gather, refresh psums partial aggregates.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import DEFAULT_CHAIN, Engine, OptimizerConfig
+from cruise_control_tpu.models.aggregates import compute_aggregates
+from cruise_control_tpu.models.state import validate
+from cruise_control_tpu.parallel.sharded import (
+    ShardedEngine,
+    build_layout,
+    model_mesh,
+)
+from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster
+
+CFG = OptimizerConfig(
+    num_candidates=64,
+    leadership_candidates=16,
+    swap_candidates=8,
+    steps_per_round=6,
+    num_rounds=3,
+    seed=3,
+)
+
+
+def _state(seed=21, brokers=12, parts=160):
+    return random_cluster(
+        RandomClusterSpec(num_brokers=brokers, num_partitions=parts, skew=1.5),
+        seed=seed,
+    )
+
+
+def test_layout_partition_aligned_and_invertible():
+    state = _state()
+    n = 8
+    lay = build_layout(state, n)
+    assert lay.n_shards == n
+    total_valid = int(np.asarray(state.replica_valid).sum())
+    owned = lay.orig_index[lay.orig_index >= 0]
+    assert owned.size == total_valid
+    assert np.unique(owned).size == owned.size  # each replica exactly once
+    part = np.asarray(state.replica_partition)
+    for i in range(n):
+        idx = lay.orig_index[i][lay.orig_index[i] >= 0]
+        if idx.size:
+            p = part[idx]
+            assert p.min() >= i * lay.P_local and p.max() < (i + 1) * lay.P_local
+        ls = lay.local_states[i]
+        assert ls.shape.R == lay.R_local and ls.shape.P == lay.P_local
+        # local loads must match the original rows
+        np.testing.assert_allclose(
+            np.asarray(ls.replica_load_leader)[: idx.size],
+            np.asarray(state.replica_load_leader)[idx],
+        )
+
+
+def test_sharded_engine_improves_and_validates():
+    state = _state()
+    mesh = model_mesh(np.asarray(jax.devices()[:8]))
+    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
+    final, history = se.run(verbose=True)
+    validate(final)
+    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
+    obj1, _, _ = DEFAULT_CHAIN.evaluate(final)
+    assert float(obj1) < float(obj0)
+    assert sum(h["accepted"] for h in history) > 0
+
+
+def test_sharded_aggregates_match_unsharded():
+    """The psum'd refresh must produce the same replicated broker aggregates
+    a single-device engine derives from the whole model."""
+    state = _state(seed=5)
+    mesh = model_mesh(np.asarray(jax.devices()[:8]))
+    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
+    keys = jax.random.split(jax.random.PRNGKey(0), se.n)
+    carry = se._jit_init(se.statics, keys)
+
+    agg = compute_aggregates(state)
+    # stacked replicated copies: every shard must hold the global aggregates
+    bl = np.asarray(carry.broker_load)
+    for i in range(se.n):
+        np.testing.assert_allclose(bl[i], np.asarray(agg.broker_load), rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(carry.broker_replica_count)[0],
+        np.asarray(agg.broker_replica_count),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry.broker_leader_count)[0],
+        np.asarray(agg.broker_leader_count),
+    )
+    # sharded part_rack_count concatenates to the global table (padded P)
+    prc = np.asarray(carry.part_rack_count).reshape(-1, state.shape.num_racks)
+    np.testing.assert_array_equal(
+        prc[: state.shape.P], np.asarray(agg.part_rack_count)
+    )
+
+
+def test_sharded_objective_matches_engine_objective():
+    state = _state(seed=9)
+    mesh = model_mesh(np.asarray(jax.devices()[:8]))
+    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
+    keys = jax.random.split(jax.random.PRNGKey(0), se.n)
+    carry = se._jit_init(se.statics, keys)
+    sharded_obj = se.objective(carry)
+
+    eng = Engine(state, DEFAULT_CHAIN, config=CFG)
+    c0 = eng.init_carry(jax.random.PRNGKey(0))
+    local_obj = float(eng.carry_objective(eng.statics, c0))
+    assert abs(sharded_obj - local_obj) < max(1e-4, 1e-4 * abs(local_obj))
+
+
+def test_sharded_tracks_single_device_quality():
+    """Same budget, same seed family: the sharded run must land in the same
+    quality regime as the single-device engine (it evaluates n× candidates,
+    so equal-or-better is the expectation, with slack for stochasticity)."""
+    state = _state(seed=33, brokers=10, parts=120)
+    cfg = dataclasses.replace(CFG, num_rounds=4)
+    eng = Engine(state, DEFAULT_CHAIN, config=cfg)
+    single, _ = eng.run()
+    obj_single, _, _ = DEFAULT_CHAIN.evaluate(single)
+
+    mesh = model_mesh(np.asarray(jax.devices()[:8]))
+    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=cfg)
+    sharded, _ = se.run()
+    validate(sharded)
+    obj_sharded, _, _ = DEFAULT_CHAIN.evaluate(sharded)
+
+    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
+    # both must improve substantially; sharded within 20% of single's gain
+    gain_single = float(obj0 - obj_single)
+    gain_sharded = float(obj0 - obj_sharded)
+    assert gain_single > 0 and gain_sharded > 0
+    assert gain_sharded >= 0.8 * gain_single

@@ -148,3 +148,18 @@ def test_bootstrap_and_train_endpoints():
         assert payload["MonitorState"]["trainingState"]["trained"] is True
     finally:
         app.stop()
+
+
+def test_train_respects_requested_range_with_distinct_broker_window():
+    """/train?start&end must filter BROKER windows by the broker window
+    span, not the partition span (they differ by 12x under defaults)."""
+    app, fetcher, admin, sampler = _fresh_service(
+        seed=13, **{"broker.metrics.window.ms": 500}
+    )
+    runner = app.cc.task_runner
+    runner.regression.min_samples_to_train = 1
+    # samples were fetched over windows starting at t=0 (build_simulated_service)
+    out_none = runner.train(10_000_000, 20_000_000)  # empty range
+    assert out_none["numSamples"] == 0
+    out_all = runner.train(0, 1_000_000)
+    assert out_all["numSamples"] > 0
