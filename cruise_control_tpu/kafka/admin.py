@@ -1,0 +1,234 @@
+"""ClusterAdmin / MetadataProvider adapters over the wire-protocol client.
+
+KafkaClusterAdmin implements the exact SPI the executor drives
+(executor/admin.py ClusterAdmin) against a live cluster:
+
+  reassign_partitions        -> AlterPartitionReassignments (KIP-455; replaces
+                                the reference's ZK znode writes,
+                                ExecutorUtils.scala:31)
+  in_progress_reassignments  -> ListPartitionReassignments
+                                (ExecutorUtils.scala:103)
+  cancel_reassignments       -> AlterPartitionReassignments with null targets
+                                (replaces ZK node deletion, Executor.java:1145)
+  elect_leaders              -> ElectLeaders PREFERRED (ExecutorUtils.scala:95)
+  alter_replica_logdirs      -> AlterReplicaLogDirs per broker
+                                (ExecutorAdminUtils.java:1, KIP-113)
+  set/clear throttle         -> IncrementalAlterConfigs broker + topic configs
+                                (ReplicationThrottleHelper.java:32-47)
+  topology                   -> Metadata (+ DescribeLogDirs for logdir axes)
+
+Disk indices: the framework models JBOD logdirs as dense per-broker disk
+indices; the adapter maps index <-> path by sorting each broker's logdir
+paths (stable across calls because brokers report a fixed logdir set).
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.executor.admin import LeadershipSpec, ReassignmentSpec
+from cruise_control_tpu.kafka.client import KafkaAdminClient, KafkaProtocolError
+from cruise_control_tpu.monitor.topology import (
+    BrokerNode,
+    ClusterTopology,
+    PartitionInfo,
+)
+
+_BROKER = 4  # config resource types (public protocol spec)
+_TOPIC = 2
+_SET = 0
+_DELETE = 1
+
+_THROTTLE_RATE_CONFIGS = (
+    "leader.replication.throttled.rate",
+    "follower.replication.throttled.rate",
+)
+_THROTTLE_REPLICA_CONFIGS = (
+    "leader.replication.throttled.replicas",
+    "follower.replication.throttled.replicas",
+)
+
+
+class KafkaClusterAdmin:
+    """Real-cluster ClusterAdmin over the Kafka wire protocol."""
+
+    def __init__(self, client: KafkaAdminClient):
+        self.client = client
+        self._throttled_brokers: set[int] = set()
+        self._throttled_topics: set[str] = set()
+
+    # --- ClusterAdmin SPI ---
+
+    def reassign_partitions(self, specs: list[ReassignmentSpec]) -> None:
+        results = self.client.alter_partition_reassignments({
+            (s.topic, s.partition): list(s.new_replicas) for s in specs
+        })
+        errors = [(t, p, c) for t, p, c, _ in results if c != 0]
+        if errors:
+            raise KafkaProtocolError(
+                "AlterPartitionReassignments", errors[0][2],
+                f"{len(errors)} partitions rejected, first: {errors[0][:2]}",
+            )
+
+    def in_progress_reassignments(self) -> set[tuple[str, int]]:
+        return self.client.list_partition_reassignments()
+
+    def cancel_reassignments(self) -> None:
+        in_progress = self.client.list_partition_reassignments()
+        if in_progress:
+            self.client.alter_partition_reassignments(
+                {key: None for key in in_progress}
+            )
+
+    def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
+        # the executor encodes the target leader as the preferred (first)
+        # replica; PREFERRED election realizes it (ExecutorUtils.scala:95)
+        results = self.client.elect_preferred_leaders(
+            [(s.topic, s.partition) for s in specs]
+        )
+        # 84 = ELECTION_NOT_NEEDED (preferred replica already leads) is success
+        errors = [(t, p, c) for t, p, c in results if c not in (0, 84)]
+        if errors:
+            raise KafkaProtocolError(
+                "ElectLeaders", errors[0][2],
+                f"{len(errors)} elections failed, first: {errors[0][:2]}",
+            )
+
+    def alter_replica_logdirs(self, moves: list[tuple[str, int, int, int]]) -> None:
+        """(topic, partition, broker, target_disk_index) intra-broker moves."""
+        by_broker: dict[int, dict[str, list[tuple[str, int]]]] = {}
+        paths_cache: dict[int, list[str]] = {}
+        for topic, part, broker, disk_idx in moves:
+            paths = paths_cache.get(broker)
+            if paths is None:
+                paths = paths_cache[broker] = self._logdir_paths(broker)
+            if disk_idx >= len(paths):
+                raise ValueError(
+                    f"broker {broker} has {len(paths)} logdirs, wanted index {disk_idx}"
+                )
+            by_broker.setdefault(broker, {}).setdefault(paths[disk_idx], []).append(
+                (topic, part)
+            )
+        for broker, dir_moves in sorted(by_broker.items()):
+            results = self.client.alter_replica_logdirs(broker, dir_moves)
+            errors = [r for r in results if r[2] != 0]
+            if errors:
+                raise KafkaProtocolError(
+                    "AlterReplicaLogDirs", errors[0][2],
+                    f"{len(errors)} moves rejected on broker {broker}",
+                )
+
+    def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
+        """Reference ReplicationThrottleHelper.java:32-47: per-broker rates +
+        per-topic throttled-replica wildcards around an execution."""
+        self.client.metadata()
+        brokers = sorted(self.client._brokers)
+        rate = str(int(rate_bytes_per_s))
+        resources = [
+            (_BROKER, str(b), [(c, _SET, rate) for c in _THROTTLE_RATE_CONFIGS])
+            for b in brokers
+        ] + [
+            (_TOPIC, t, [(c, _SET, "*") for c in _THROTTLE_REPLICA_CONFIGS])
+            for t in sorted(topics)
+        ]
+        self.client.incremental_alter_configs(resources)
+        self._throttled_brokers = set(brokers)
+        self._throttled_topics = set(topics)
+
+    def clear_replication_throttle(self) -> None:
+        resources = [
+            (_BROKER, str(b), [(c, _DELETE, None) for c in _THROTTLE_RATE_CONFIGS])
+            for b in sorted(self._throttled_brokers)
+        ] + [
+            (_TOPIC, t, [(c, _DELETE, None) for c in _THROTTLE_REPLICA_CONFIGS])
+            for t in sorted(self._throttled_topics)
+        ]
+        if resources:
+            self.client.incremental_alter_configs(resources)
+        self._throttled_brokers = set()
+        self._throttled_topics = set()
+
+    def topology(self) -> ClusterTopology:
+        return _topology_from_metadata(self.client, with_logdirs=True)
+
+    # --- helpers ---
+
+    def _logdir_paths(self, broker: int) -> list[str]:
+        """Dense disk index -> logdir path (sorted for stability)."""
+        return sorted(self.client.describe_logdirs(broker))
+
+
+class KafkaMetadataProvider:
+    """MetadataProvider over the wire protocol (reference MetadataClient)."""
+
+    def __init__(self, client: KafkaAdminClient):
+        self.client = client
+        self._generation = 0
+        self._topology: ClusterTopology | None = None
+
+    def topology(self) -> ClusterTopology:
+        if self._topology is None:
+            return self.refresh()
+        return self._topology
+
+    def refresh(self) -> ClusterTopology:
+        self._generation += 1
+        topo = _topology_from_metadata(self.client, with_logdirs=False)
+        self._topology = ClusterTopology(
+            brokers=topo.brokers, partitions=topo.partitions,
+            generation=self._generation,
+        )
+        return self._topology
+
+
+def _topology_from_metadata(
+    client: KafkaAdminClient, *, with_logdirs: bool
+) -> ClusterTopology:
+    md = client.metadata()
+    brokers = []
+    live_ids = set()
+    for b in md["brokers"]:
+        live_ids.add(b["node_id"])
+        logdirs: tuple[str, ...] = ()
+        offline: tuple[str, ...] = ()
+        if with_logdirs:
+            try:
+                dirs = client.describe_logdirs(b["node_id"])
+                logdirs = tuple(sorted(dirs))
+                offline = tuple(
+                    sorted(d for d, info in dirs.items() if info["error_code"] != 0)
+                )
+            except (OSError, ConnectionError):
+                pass
+        brokers.append(
+            BrokerNode(
+                broker_id=b["node_id"],
+                rack=b["rack"] or "",
+                host=b["host"],
+                alive=True,
+                logdirs=logdirs,
+                offline_logdirs=offline,
+            )
+        )
+    # brokers hosting replicas but absent from metadata = failed brokers:
+    # surface them as dead BrokerNodes (the BrokerFailureDetector's signal —
+    # replaces the reference's ZK /brokers/ids watch,
+    # detector/BrokerFailureDetector.java:88)
+    partitions = []
+    referenced: set[int] = set()
+    for t in md["topics"]:
+        if t["error_code"] != 0 or t["is_internal"]:
+            continue
+        for p in t["partitions"]:
+            replicas = tuple(p["replica_nodes"])
+            referenced.update(replicas)
+            partitions.append(
+                PartitionInfo(
+                    topic=t["name"],
+                    partition=p["partition_index"],
+                    leader=p["leader_id"],
+                    replicas=replicas,
+                )
+            )
+    for dead in sorted(referenced - live_ids):
+        brokers.append(BrokerNode(broker_id=dead, rack="", host="", alive=False))
+    brokers.sort(key=lambda b: b.broker_id)
+    return ClusterTopology(brokers=tuple(brokers), partitions=tuple(partitions))

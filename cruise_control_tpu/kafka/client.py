@@ -1,0 +1,297 @@
+"""Blocking Kafka admin client over raw sockets with controller routing.
+
+Reference roles covered: common/MetadataClient.java:1 (metadata refresh),
+executor/ExecutorAdminUtils.java:1 (admin operations).  One connection per
+broker, lazily opened; controller-only APIs (reassignments, elections,
+configs) are routed to the current controller and retried once after a
+metadata refresh if the controller moved (NOT_CONTROLLER).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from cruise_control_tpu.kafka import protocol as proto
+
+#: Kafka error codes we interpret (public protocol spec)
+NONE = 0
+NOT_CONTROLLER = 41
+NO_REASSIGNMENT_IN_PROGRESS = 85
+
+
+class KafkaProtocolError(Exception):
+    def __init__(self, api: str, code: int, message: str | None = None):
+        super().__init__(f"{api}: error_code={code} {message or ''}".strip())
+        self.api = api
+        self.code = code
+
+
+class BrokerConnection:
+    """One socket to one broker; request/response are strictly serial."""
+
+    def __init__(self, host: str, port: int, client_id: str, timeout_s: float):
+        self.host = host
+        self.port = port
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._correlation = 0
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s
+            )
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = sock.recv(n)
+            if not chunk:
+                raise ConnectionError("broker closed connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def request(self, api: proto.Api, body: dict) -> dict:
+        with self._lock:
+            self._correlation += 1
+            cid = self._correlation
+            frame = proto.encode_request(api, cid, self.client_id, body)
+            try:
+                sock = self._ensure()
+                sock.sendall(frame)
+                (size,) = struct.unpack(">i", self._read_exact(sock, 4))
+                payload = self._read_exact(sock, size)
+            except (OSError, ConnectionError):
+                self.close()  # poisoned stream; reconnect on next call
+                raise
+            got_cid, resp = proto.decode_response(api, payload)
+            if got_cid != cid:
+                self.close()
+                raise ConnectionError(
+                    f"correlation mismatch: sent {cid}, got {got_cid}"
+                )
+            return resp
+
+
+class KafkaAdminClient:
+    """Cluster-level operations with broker/controller routing."""
+
+    def __init__(
+        self,
+        bootstrap: list[tuple[str, int]],
+        *,
+        client_id: str = "cruise-control-tpu",
+        timeout_s: float = 30.0,
+    ):
+        if not bootstrap:
+            raise ValueError("bootstrap servers required")
+        self.bootstrap = bootstrap
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._conns: dict[tuple[str, int], BrokerConnection] = {}
+        self._brokers: dict[int, tuple[str, int]] = {}  # node_id -> addr
+        self._controller_id: int | None = None
+
+    # ------------------------------------------------------------- plumbing
+
+    def _conn(self, addr: tuple[str, int]) -> BrokerConnection:
+        conn = self._conns.get(addr)
+        if conn is None:
+            conn = BrokerConnection(addr[0], addr[1], self.client_id, self.timeout_s)
+            self._conns[addr] = conn
+        return conn
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+
+    def _any_conn(self) -> BrokerConnection:
+        errors = []
+        for node_addr in list(self._brokers.values()) + self.bootstrap:
+            try:
+                conn = self._conn(node_addr)
+                conn._ensure()
+                return conn
+            except OSError as e:  # try the next seed
+                errors.append(f"{node_addr}: {e}")
+        raise ConnectionError("no reachable broker: " + "; ".join(errors))
+
+    # ------------------------------------------------------------ metadata
+
+    def metadata(self, topics: list[str] | None = None) -> dict:
+        resp = self._any_conn().request(proto.METADATA, {"topics": topics})
+        self._brokers = {
+            b["node_id"]: (b["host"], b["port"]) for b in resp["brokers"]
+        }
+        self._controller_id = resp["controller_id"]
+        return resp
+
+    def api_versions(self) -> dict:
+        return self._any_conn().request(proto.API_VERSIONS, {})
+
+    def _controller_conn(self) -> BrokerConnection:
+        if self._controller_id is None or self._controller_id not in self._brokers:
+            self.metadata()
+        addr = self._brokers.get(self._controller_id)
+        if addr is None:
+            raise ConnectionError("no controller in metadata")
+        return self._conn(addr)
+
+    def _controller_request(self, api: proto.Api, body: dict) -> dict:
+        """Route to controller; one retry after refresh on NOT_CONTROLLER."""
+        resp = self._controller_conn().request(api, body)
+        if resp.get("error_code", NONE) == NOT_CONTROLLER:
+            self.metadata()
+            resp = self._controller_conn().request(api, body)
+        return resp
+
+    def broker_request(self, node_id: int, api: proto.Api, body: dict) -> dict:
+        if node_id not in self._brokers:
+            self.metadata()
+        addr = self._brokers.get(node_id)
+        if addr is None:
+            raise ConnectionError(f"unknown broker {node_id}")
+        return self._conn(addr).request(api, body)
+
+    # ----------------------------------------------------------- operations
+
+    def alter_partition_reassignments(
+        self, assignments: dict[tuple[str, int], list[int] | None],
+        timeout_ms: int = 60_000,
+    ) -> list[tuple[str, int, int, str | None]]:
+        """assignments: (topic, partition) -> target replicas (None cancels).
+        Returns per-partition (topic, partition, error_code, message)."""
+        by_topic: dict[str, list[dict]] = {}
+        for (topic, part), replicas in assignments.items():
+            by_topic.setdefault(topic, []).append(
+                {"partition_index": part, "replicas": replicas}
+            )
+        resp = self._controller_request(proto.ALTER_PARTITION_REASSIGNMENTS, {
+            "timeout_ms": timeout_ms,
+            "topics": [
+                {"name": t, "partitions": ps} for t, ps in sorted(by_topic.items())
+            ],
+        })
+        if resp["error_code"] != NONE:
+            raise KafkaProtocolError(
+                "AlterPartitionReassignments", resp["error_code"],
+                resp.get("error_message"),
+            )
+        out = []
+        for t in resp["responses"] or []:
+            for p in t["partitions"] or []:
+                out.append(
+                    (t["name"], p["partition_index"], p["error_code"],
+                     p.get("error_message"))
+                )
+        return out
+
+    def list_partition_reassignments(self) -> set[tuple[str, int]]:
+        resp = self._controller_request(proto.LIST_PARTITION_REASSIGNMENTS, {
+            "timeout_ms": 30_000, "topics": None,
+        })
+        if resp["error_code"] not in (NONE, NO_REASSIGNMENT_IN_PROGRESS):
+            raise KafkaProtocolError(
+                "ListPartitionReassignments", resp["error_code"],
+                resp.get("error_message"),
+            )
+        return {
+            (t["name"], p["partition_index"])
+            for t in resp["topics"] or []
+            for p in t["partitions"] or []
+        }
+
+    def elect_preferred_leaders(
+        self, partitions: list[tuple[str, int]], timeout_ms: int = 30_000
+    ) -> list[tuple[str, int, int]]:
+        by_topic: dict[str, list[int]] = {}
+        for topic, part in partitions:
+            by_topic.setdefault(topic, []).append(part)
+        resp = self._controller_request(proto.ELECT_LEADERS, {
+            "election_type": 0,  # PREFERRED
+            "topic_partitions": [
+                {"topic": t, "partition_ids": ps}
+                for t, ps in sorted(by_topic.items())
+            ],
+            "timeout_ms": timeout_ms,
+        })
+        if resp["error_code"] != NONE:
+            raise KafkaProtocolError("ElectLeaders", resp["error_code"])
+        return [
+            (t["topic"], p["partition_id"], p["error_code"])
+            for t in resp["replica_election_results"] or []
+            for p in t["partition_results"] or []
+        ]
+
+    def incremental_alter_configs(
+        self, resources: list[tuple[int, str, list[tuple[str, int, str | None]]]],
+    ) -> None:
+        """resources: (resource_type, name, [(config, op, value)])."""
+        resp = self._any_conn().request(proto.INCREMENTAL_ALTER_CONFIGS, {
+            "resources": [
+                {
+                    "resource_type": rt, "resource_name": name,
+                    "configs": [
+                        {"name": c, "config_operation": op, "value": v}
+                        for c, op, v in configs
+                    ],
+                }
+                for rt, name, configs in resources
+            ],
+            "validate_only": False,
+        })
+        for r in resp["responses"] or []:
+            if r["error_code"] != NONE:
+                raise KafkaProtocolError(
+                    "IncrementalAlterConfigs", r["error_code"], r.get("error_message")
+                )
+
+    def alter_replica_logdirs(
+        self, node_id: int, moves: dict[str, list[tuple[str, int]]]
+    ) -> list[tuple[str, int, int]]:
+        """moves: logdir path -> [(topic, partition)] on ONE broker."""
+        dirs = []
+        for path, tps in sorted(moves.items()):
+            by_topic: dict[str, list[int]] = {}
+            for topic, part in tps:
+                by_topic.setdefault(topic, []).append(part)
+            dirs.append({
+                "path": path,
+                "topics": [
+                    {"name": t, "partitions": ps}
+                    for t, ps in sorted(by_topic.items())
+                ],
+            })
+        resp = self.broker_request(node_id, proto.ALTER_REPLICA_LOG_DIRS, {"dirs": dirs})
+        return [
+            (t["topic_name"], p["partition_index"], p["error_code"])
+            for t in resp["results"] or []
+            for p in t["partitions"] or []
+        ]
+
+    def describe_logdirs(self, node_id: int) -> dict[str, dict]:
+        """node's logdirs: path -> {"error_code", "replicas": {(t, p): size}}."""
+        resp = self.broker_request(node_id, proto.DESCRIBE_LOG_DIRS, {"topics": None})
+        out: dict[str, dict] = {}
+        for r in resp["results"] or []:
+            replicas = {
+                (t["name"], p["partition_index"]): p["partition_size"]
+                for t in r["topics"] or []
+                for p in t["partitions"] or []
+            }
+            out[r["log_dir"]] = {"error_code": r["error_code"], "replicas": replicas}
+        return out

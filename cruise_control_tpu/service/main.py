@@ -99,6 +99,48 @@ def build_service(
     return app, fetcher
 
 
+def build_kafka_service(
+    config: CruiseControlConfig,
+    bootstrap_servers: str,
+    sampler,
+    *,
+    client_id: str = "cruise-control-tpu",
+    sample_store=None,
+):
+    """Service against a LIVE Kafka cluster over the wire-protocol adapters
+    (kafka/admin.py): metadata + reassignments + elections + logdir moves +
+    throttles all ride the binary protocol — no JVM, no ZooKeeper
+    (reference KafkaCruiseControlMain + the ZK/Scala bridge it starts).
+
+    `sampler` supplies partition/broker load samples (MetricSampler SPI,
+    monitor/sampling.py).  The stock choice is
+    CruiseControlMetricsReporterSampler fed by a transport that consumes
+    the reporter topic (reporter/reporter.py Transport SPI).
+    """
+    from cruise_control_tpu.kafka import (
+        KafkaAdminClient,
+        KafkaClusterAdmin,
+        KafkaMetadataProvider,
+    )
+
+    seeds = []
+    for hp in bootstrap_servers.split(","):
+        hp = hp.strip()
+        host, sep, port = hp.rpartition(":")
+        if not sep:  # bare hostname: Kafka's default port shorthand
+            host, port = hp, "9092"
+        if not port.isdigit():
+            raise ValueError(f"malformed bootstrap server {hp!r}")
+        seeds.append((host or "127.0.0.1", int(port)))
+    client = KafkaAdminClient(seeds, client_id=client_id)
+    metadata = KafkaMetadataProvider(client)
+    admin = KafkaClusterAdmin(client)
+    app, fetcher = build_service(
+        config, metadata, admin, sampler, sample_store=sample_store
+    )
+    return app, fetcher, admin, client
+
+
 def build_simulated_service(
     config: CruiseControlConfig | None = None,
     *,
