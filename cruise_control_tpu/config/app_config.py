@@ -163,7 +163,25 @@ def _anomaly_defs() -> ConfigDef:
     d.define("broker.failure.self.healing.threshold.ms", T.LONG, 1_800_000, I.MEDIUM,
              "", group=g)
     d.define("slow.broker.removal.enabled", T.BOOLEAN, False, I.LOW, "", group=g)
+    d.define("slow.broker.history.percentile", T.DOUBLE, 90.0, I.LOW,
+             "own-history percentile a slow broker must exceed",
+             in_range(lo=0.0, hi=100.0), group=g)
+    d.define("slow.broker.peer.comparison.ratio", T.DOUBLE, 3.0, I.LOW,
+             "multiple of the peer median flagged as slow", in_range(lo=1.0), group=g)
+    d.define("slow.broker.strike.removal.threshold", T.INT, 3, I.LOW,
+             "consecutive detections before removal is proposed",
+             in_range(lo=1), group=g)
+    d.define("broker.failure.persisted.path", T.STRING, None, I.LOW,
+             "file persisting broker-failure times across restarts "
+             "(reference persists to a ZK node)", group=g)
     d.define("topic.anomaly.target.replication.factor", T.INT, 2, I.LOW, "", group=g)
+    # Slack alerting (reference detector/notifier/SlackSelfHealingNotifier.java)
+    d.define("slack.self.healing.notifier.webhook", T.STRING, None, I.LOW,
+             "Slack incoming-webhook URL; enables the Slack notifier", group=g)
+    d.define("slack.self.healing.notifier.channel", T.STRING, None, I.LOW,
+             "override channel for alerts", group=g)
+    d.define("slack.self.healing.notifier.user", T.STRING, "cruise-control-tpu",
+             I.LOW, "sender username", group=g)
     return d
 
 
@@ -185,6 +203,16 @@ def _webserver_defs() -> ConfigDef:
              "enables HS256 bearer-token auth when set", group=g)
     d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
              "POSTs park in the review purgatory first", group=g)
+    # TLS for the REST listener (reference KafkaCruiseControlApp.java:100-120
+    # SSL connector; PEM files instead of JKS keystores)
+    d.define("webserver.ssl.enable", T.BOOLEAN, False, I.MEDIUM,
+             "serve the REST API over TLS", group=g)
+    d.define("webserver.ssl.certificate.location", T.STRING, None, I.MEDIUM,
+             "PEM certificate chain file", group=g)
+    d.define("webserver.ssl.key.location", T.STRING, None, I.MEDIUM,
+             "PEM private-key file (defaults to the certificate file)", group=g)
+    d.define("webserver.ssl.key.password", T.STRING, None, I.LOW,
+             "private-key passphrase", group=g)
     return d
 
 

@@ -115,6 +115,56 @@ class SelfHealingNotifier:
         return AnomalyNotificationResult.fix() if heal else AnomalyNotificationResult.ignore()
 
 
+class SlackSelfHealingNotifier(SelfHealingNotifier):
+    """SelfHealingNotifier that POSTs alerts to a Slack incoming webhook
+    (reference detector/notifier/SlackSelfHealingNotifier.java).
+
+    The HTTP POST rides `poster` (injectable for tests / alternate
+    webhook-compatible sinks); delivery failures are swallowed — alerting
+    must never break anomaly handling (the reference logs and continues).
+    """
+
+    def __init__(
+        self,
+        webhook_url: str,
+        *,
+        channel: str | None = None,
+        username: str = "cruise-control-tpu",
+        poster: Callable[[str, bytes], None] | None = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.webhook_url = webhook_url
+        self.channel = channel
+        self.username = username
+        self._post = poster or self._default_post
+        self._alert = self._slack_alert  # route SelfHealingNotifier alerts
+
+    @staticmethod
+    def _default_post(url: str, body: bytes) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def _slack_alert(self, anomaly: Anomaly, auto_fix: bool) -> None:
+        import json
+
+        text = (
+            f":warning: {anomaly.anomaly_type.name}: {anomaly.description()} "
+            f"(self-healing {'STARTED' if auto_fix else 'disabled'})"
+        )
+        payload: dict = {"text": text, "username": self.username}
+        if self.channel:
+            payload["channel"] = self.channel
+        try:
+            self._post(self.webhook_url, json.dumps(payload).encode())
+        except Exception:  # noqa: BLE001 — alert delivery is best-effort
+            pass
+
+
 class NoopNotifier:
     """Ignore everything (reference NoopNotifier)."""
 

@@ -79,8 +79,42 @@ class KafkaClusterAdmin:
             )
 
     def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
-        # the executor encodes the target leader as the preferred (first)
-        # replica; PREFERRED election realizes it (ExecutorUtils.scala:95)
+        """Realize leadership moves: make the target the PREFERRED (first)
+        replica, then run a preferred election (ExecutorUtils.scala:95).
+
+        A PREFERRED election elects the broker-side replica list's head — so
+        when the target is not already first, the assignment must be
+        reordered via AlterPartitionReassignments first.  A same-set reorder
+        moves no data (every replica is already in ISR) and completes
+        immediately on the broker.
+        """
+        md = self.client.metadata(sorted({s.topic for s in specs}))
+        current: dict[tuple[str, int], list[int]] = {
+            (t["name"], p["partition_index"]): list(p["replica_nodes"])
+            for t in md["topics"]
+            for p in t["partitions"]
+        }
+        reorders: dict[tuple[str, int], list[int]] = {}
+        for s in specs:
+            key = (s.topic, s.partition)
+            replicas = current.get(key)
+            if replicas is None or s.preferred_leader not in replicas:
+                raise KafkaProtocolError(
+                    "ElectLeaders", 3,
+                    f"{key}: target {s.preferred_leader} not in assignment {replicas}",
+                )
+            if replicas[0] != s.preferred_leader:
+                reorders[key] = [s.preferred_leader] + [
+                    b for b in replicas if b != s.preferred_leader
+                ]
+        if reorders:
+            results = self.client.alter_partition_reassignments(reorders)
+            bad = [(t, p, c) for t, p, c, _ in results if c != 0]
+            if bad:
+                raise KafkaProtocolError(
+                    "ElectLeaders", bad[0][2],
+                    f"preferred-replica reorder rejected, first: {bad[0][:2]}",
+                )
         results = self.client.elect_preferred_leaders(
             [(s.topic, s.partition) for s in specs]
         )
@@ -119,8 +153,8 @@ class KafkaClusterAdmin:
     def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
         """Reference ReplicationThrottleHelper.java:32-47: per-broker rates +
         per-topic throttled-replica wildcards around an execution."""
-        self.client.metadata()
-        brokers = sorted(self.client._brokers)
+        md = self.client.metadata()
+        brokers = sorted(b["node_id"] for b in md["brokers"])
         rate = str(int(rate_bytes_per_s))
         resources = [
             (_BROKER, str(b), [(c, _SET, rate) for c in _THROTTLE_RATE_CONFIGS])
@@ -134,12 +168,45 @@ class KafkaClusterAdmin:
         self._throttled_topics = set(topics)
 
     def clear_replication_throttle(self) -> None:
+        """Remove throttles discovered from CLUSTER state, not just this
+        process's memory — a crash between set and clear must not leave the
+        cluster capped forever (reference ReplicationThrottleHelper removes
+        what it finds in the configs)."""
+        md = self.client.metadata()
+        broker_ids = sorted(b["node_id"] for b in md["brokers"])
+        topic_names = sorted(
+            t["name"] for t in md["topics"] if t["error_code"] == 0
+        )
+        # broker-resource describes must be routed TO that broker (dynamic
+        # per-broker configs, KIP-226); topic describes may go anywhere
+        throttled_brokers = set(self._throttled_brokers)
+        for b in broker_ids:
+            cfg = self.client.describe_configs(
+                [(_BROKER, str(b))],
+                names=list(_THROTTLE_RATE_CONFIGS),
+                node_id=b,
+            ).get((_BROKER, str(b)), {})
+            if any(c in cfg for c in _THROTTLE_RATE_CONFIGS):
+                throttled_brokers.add(b)
+        described = self.client.describe_configs(
+            [(_TOPIC, t) for t in topic_names],
+            names=list(_THROTTLE_REPLICA_CONFIGS),
+        )
+        # only clear topic throttles bearing OUR signature (the "*"
+        # wildcard set_replication_throttle writes) — an operator's static
+        # per-replica throttle list is not ours to delete (reference
+        # ReplicationThrottleHelper removes what it set)
+        throttled_topics = {
+            name for (rt, name), cfg in described.items()
+            if rt == _TOPIC
+            and any(cfg.get(c) == "*" for c in _THROTTLE_REPLICA_CONFIGS)
+        } | self._throttled_topics
         resources = [
             (_BROKER, str(b), [(c, _DELETE, None) for c in _THROTTLE_RATE_CONFIGS])
-            for b in sorted(self._throttled_brokers)
+            for b in sorted(throttled_brokers)
         ] + [
             (_TOPIC, t, [(c, _DELETE, None) for c in _THROTTLE_REPLICA_CONFIGS])
-            for t in sorted(self._throttled_topics)
+            for t in sorted(throttled_topics)
         ]
         if resources:
             self.client.incremental_alter_configs(resources)

@@ -65,25 +65,37 @@ class BrokerConnection:
         return b"".join(chunks)
 
     def request(self, api: proto.Api, body: dict) -> dict:
+        """One retry on a fresh socket: brokers close idle connections
+        (connections.max.idle.ms), so the first call after an idle window
+        hits a dead cached socket — reconnect once, then surface errors.
+
+        Only idempotent APIs retry: an ambiguous failure (e.g. timeout after
+        the request was written) may mean the broker already executed it,
+        and re-sending a Produce would append duplicate records."""
         with self._lock:
-            self._correlation += 1
-            cid = self._correlation
-            frame = proto.encode_request(api, cid, self.client_id, body)
-            try:
-                sock = self._ensure()
-                sock.sendall(frame)
-                (size,) = struct.unpack(">i", self._read_exact(sock, 4))
-                payload = self._read_exact(sock, size)
-            except (OSError, ConnectionError):
-                self.close()  # poisoned stream; reconnect on next call
-                raise
-            got_cid, resp = proto.decode_response(api, payload)
-            if got_cid != cid:
-                self.close()
-                raise ConnectionError(
-                    f"correlation mismatch: sent {cid}, got {got_cid}"
-                )
-            return resp
+            last_error: Exception | None = None
+            attempts = 2 if api.idempotent else 1
+            for attempt in range(attempts):
+                self._correlation += 1
+                cid = self._correlation
+                frame = proto.encode_request(api, cid, self.client_id, body)
+                try:
+                    sock = self._ensure()
+                    sock.sendall(frame)
+                    (size,) = struct.unpack(">i", self._read_exact(sock, 4))
+                    payload = self._read_exact(sock, size)
+                except (OSError, ConnectionError) as e:
+                    self.close()  # poisoned stream; retry on a fresh socket
+                    last_error = e
+                    continue
+                got_cid, resp = proto.decode_response(api, payload)
+                if got_cid != cid:
+                    self.close()
+                    raise ConnectionError(
+                        f"correlation mismatch: sent {cid}, got {got_cid}"
+                    )
+                return resp
+            raise last_error  # type: ignore[misc]
 
 
 class KafkaAdminClient:
@@ -104,24 +116,31 @@ class KafkaAdminClient:
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}  # node_id -> addr
         self._controller_id: int | None = None
+        # routing maps are shared by detector/executor/REST threads; per-
+        # connection locks serialize frames but not these dicts
+        self._route_lock = threading.Lock()
 
     # ------------------------------------------------------------- plumbing
 
     def _conn(self, addr: tuple[str, int]) -> BrokerConnection:
-        conn = self._conns.get(addr)
-        if conn is None:
-            conn = BrokerConnection(addr[0], addr[1], self.client_id, self.timeout_s)
-            self._conns[addr] = conn
-        return conn
+        with self._route_lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = BrokerConnection(addr[0], addr[1], self.client_id, self.timeout_s)
+                self._conns[addr] = conn
+            return conn
 
     def close(self) -> None:
-        for c in self._conns.values():
+        with self._route_lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
             c.close()
-        self._conns.clear()
 
     def _any_conn(self) -> BrokerConnection:
         errors = []
-        for node_addr in list(self._brokers.values()) + self.bootstrap:
+        with self._route_lock:
+            known = list(self._brokers.values())
+        for node_addr in known + self.bootstrap:
             try:
                 conn = self._conn(node_addr)
                 conn._ensure()
@@ -134,19 +153,24 @@ class KafkaAdminClient:
 
     def metadata(self, topics: list[str] | None = None) -> dict:
         resp = self._any_conn().request(proto.METADATA, {"topics": topics})
-        self._brokers = {
-            b["node_id"]: (b["host"], b["port"]) for b in resp["brokers"]
-        }
-        self._controller_id = resp["controller_id"]
+        with self._route_lock:
+            self._brokers = {
+                b["node_id"]: (b["host"], b["port"]) for b in resp["brokers"]
+            }
+            self._controller_id = resp["controller_id"]
         return resp
 
     def api_versions(self) -> dict:
         return self._any_conn().request(proto.API_VERSIONS, {})
 
     def _controller_conn(self) -> BrokerConnection:
-        if self._controller_id is None or self._controller_id not in self._brokers:
+        with self._route_lock:
+            cid = self._controller_id
+            addr = self._brokers.get(cid) if cid is not None else None
+        if addr is None:
             self.metadata()
-        addr = self._brokers.get(self._controller_id)
+            with self._route_lock:
+                addr = self._brokers.get(self._controller_id)
         if addr is None:
             raise ConnectionError("no controller in metadata")
         return self._conn(addr)
@@ -160,9 +184,12 @@ class KafkaAdminClient:
         return resp
 
     def broker_request(self, node_id: int, api: proto.Api, body: dict) -> dict:
-        if node_id not in self._brokers:
+        with self._route_lock:
+            addr = self._brokers.get(node_id)
+        if addr is None:
             self.metadata()
-        addr = self._brokers.get(node_id)
+            with self._route_lock:
+                addr = self._brokers.get(node_id)
         if addr is None:
             raise ConnectionError(f"unknown broker {node_id}")
         return self._conn(addr).request(api, body)
@@ -259,6 +286,35 @@ class KafkaAdminClient:
                 raise KafkaProtocolError(
                     "IncrementalAlterConfigs", r["error_code"], r.get("error_message")
                 )
+
+    def describe_configs(
+        self, resources: list[tuple[int, str]], names: list[str] | None = None,
+        *, node_id: int | None = None,
+    ) -> dict[tuple[int, str], dict[str, str]]:
+        """(resource_type, name) -> {config: value} for non-default configs
+        (value None and defaults are omitted).  `node_id` routes the request
+        to a specific broker — required for BROKER resources (KIP-226)."""
+        body = {
+            "resources": [
+                {"resource_type": rt, "resource_name": rn,
+                 "configuration_keys": names}
+                for rt, rn in resources
+            ],
+        }
+        if node_id is not None:
+            resp = self.broker_request(node_id, proto.DESCRIBE_CONFIGS, body)
+        else:
+            resp = self._any_conn().request(proto.DESCRIBE_CONFIGS, body)
+        out: dict[tuple[int, str], dict[str, str]] = {}
+        for r in resp["results"] or []:
+            if r["error_code"] != NONE:
+                continue
+            out[(r["resource_type"], r["resource_name"])] = {
+                c["name"]: c["value"]
+                for c in r["configs"] or []
+                if c["value"] is not None and not c["is_default"]
+            }
+        return out
 
     def alter_replica_logdirs(
         self, node_id: int, moves: dict[str, list[tuple[str, int]]]

@@ -140,6 +140,32 @@ CompactString = _CompactString()
 CompactNullableString = _CompactString(nullable=True)
 
 
+class _Bytes:
+    """Classic BYTES / NULLABLE_BYTES (INT32 length, -1 = null)."""
+
+    def __init__(self, nullable: bool = False):
+        self.nullable = nullable
+
+    def write(self, out: bytearray, v) -> None:
+        if v is None:
+            if not self.nullable:
+                raise CodecError("null for non-nullable bytes")
+            Int32.write(out, -1)
+            return
+        Int32.write(out, len(v))
+        out += v
+
+    def read(self, buf, off: int):
+        n, off = Int32.read(buf, off)
+        if n == -1:
+            return None, off
+        return bytes(buf[off: off + n]), off + n
+
+
+Bytes = _Bytes()
+NullableBytes = _Bytes(nullable=True)
+
+
 class Array:
     """Classic ARRAY (INT32 count, -1 = null)."""
 

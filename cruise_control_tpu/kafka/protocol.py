@@ -36,6 +36,7 @@ from cruise_control_tpu.kafka.codec import (
     Int16,
     Int32,
     Int64,
+    NullableBytes,
     NullableString,
     String,
     Struct,
@@ -51,6 +52,10 @@ class Api:
     flexible: bool
     request: Struct
     response: Struct
+    #: safe to re-send after an ambiguous connection failure (the broker may
+    #: have executed the first attempt); Produce is NOT — duplicated batches
+    #: are silent double-counted metrics
+    idempotent: bool = True
 
 
 # -------------------------------------------------------------- ApiVersions
@@ -212,6 +217,128 @@ INCREMENTAL_ALTER_CONFIGS = Api(
     ),
 )
 
+# ------------------------------------------------------------ Produce/Fetch
+
+#: data-plane APIs for the reporter topic + sample-store topics (reference
+#: CruiseControlMetricsReporter producer, KafkaSampleStore.java:117-128,
+#: CruiseControlMetricsReporterSampler.java:101 consumer poll loop)
+PRODUCE = Api(
+    "Produce", 0, 3, False, idempotent=False,
+    request=Struct(
+        ("transactional_id", NullableString),
+        ("acks", Int16),
+        ("timeout_ms", Int32),
+        ("topic_data", Array(Struct(
+            ("name", String),
+            ("partition_data", Array(Struct(
+                ("index", Int32),
+                ("records", NullableBytes),  # one v2 record batch
+            ))),
+        ))),
+    ),
+    response=Struct(
+        ("responses", Array(Struct(
+            ("name", String),
+            ("partition_responses", Array(Struct(
+                ("index", Int32),
+                ("error_code", Int16),
+                ("base_offset", Int64),
+                ("log_append_time_ms", Int64),
+            ))),
+        ))),
+        ("throttle_time_ms", Int32),
+    ),
+)
+
+FETCH = Api(
+    "Fetch", 1, 4, False,
+    request=Struct(
+        ("replica_id", Int32),  # -1 = consumer
+        ("max_wait_ms", Int32),
+        ("min_bytes", Int32),
+        ("max_bytes", Int32),
+        ("isolation_level", Int8),
+        ("topics", Array(Struct(
+            ("topic", String),
+            ("partitions", Array(Struct(
+                ("partition", Int32),
+                ("fetch_offset", Int64),
+                ("partition_max_bytes", Int32),
+            ))),
+        ))),
+    ),
+    response=Struct(
+        ("throttle_time_ms", Int32),
+        ("responses", Array(Struct(
+            ("topic", String),
+            ("partitions", Array(Struct(
+                ("partition_index", Int32),
+                ("error_code", Int16),
+                ("high_watermark", Int64),
+                ("last_stable_offset", Int64),
+                ("aborted_transactions", Array(Struct(
+                    ("producer_id", Int64), ("first_offset", Int64),
+                ), nullable=True)),
+                ("records", NullableBytes),
+            ))),
+        ))),
+    ),
+)
+
+LIST_OFFSETS = Api(
+    "ListOffsets", 2, 1, False,
+    request=Struct(
+        ("replica_id", Int32),
+        ("topics", Array(Struct(
+            ("name", String),
+            ("partitions", Array(Struct(
+                ("partition_index", Int32),
+                ("timestamp", Int64),  # -1 latest, -2 earliest
+            ))),
+        ))),
+    ),
+    response=Struct(
+        ("topics", Array(Struct(
+            ("name", String),
+            ("partitions", Array(Struct(
+                ("partition_index", Int32),
+                ("error_code", Int16),
+                ("timestamp", Int64),
+                ("offset", Int64),
+            ))),
+        ))),
+    ),
+)
+
+# ----------------------------------------------------------- DescribeConfigs
+
+DESCRIBE_CONFIGS = Api(
+    "DescribeConfigs", 32, 0, False,
+    request=Struct(
+        ("resources", Array(Struct(
+            ("resource_type", Int8),
+            ("resource_name", String),
+            ("configuration_keys", Array(String, nullable=True)),
+        ))),
+    ),
+    response=Struct(
+        ("throttle_time_ms", Int32),
+        ("results", Array(Struct(
+            ("error_code", Int16),
+            ("error_message", NullableString),
+            ("resource_type", Int8),
+            ("resource_name", String),
+            ("configs", Array(Struct(
+                ("name", String),
+                ("value", NullableString),
+                ("read_only", Boolean),
+                ("is_default", Boolean),
+                ("is_sensitive", Boolean),
+            ))),
+        ))),
+    ),
+)
+
 # ------------------------------------------------------ AlterReplicaLogDirs
 
 ALTER_REPLICA_LOG_DIRS = Api(
@@ -264,9 +391,10 @@ DESCRIBE_LOG_DIRS = Api(
 )
 
 ALL_APIS = [
+    PRODUCE, FETCH, LIST_OFFSETS,
     API_VERSIONS, METADATA, ALTER_PARTITION_REASSIGNMENTS,
     LIST_PARTITION_REASSIGNMENTS, ELECT_LEADERS, INCREMENTAL_ALTER_CONFIGS,
-    ALTER_REPLICA_LOG_DIRS, DESCRIBE_LOG_DIRS,
+    DESCRIBE_CONFIGS, ALTER_REPLICA_LOG_DIRS, DESCRIBE_LOG_DIRS,
 ]
 
 BY_KEY_VERSION = {(a.key, a.version): a for a in ALL_APIS}

@@ -1,0 +1,240 @@
+"""Metric stream over real Kafka topics: producer + consumer transports.
+
+Completes the real-cluster sampling loop the reference runs
+(CruiseControlMetricsReporter produces to `__CruiseControlMetrics`;
+CruiseControlMetricsReporterSampler.java:101 polls it):
+
+  * KafkaMetricsTransport — the reporter-side MetricTransport SPI
+    (reporter/reporter.py): buffers serialized metric records and produces
+    one record-batch per partition leader on flush.
+  * KafkaMetricsConsumer — the sampler-side drain: fetches every partition
+    from its leader, decodes v2 batches, and exposes `poll_framed()` so the
+    native columnar decoder (cruise_control_tpu/native) parses the whole
+    batch without per-record objects.
+
+Both route by live Metadata (leader per partition) through the shared
+KafkaAdminClient connection pool.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cruise_control_tpu.kafka import protocol as proto
+from cruise_control_tpu.kafka.client import KafkaAdminClient, KafkaProtocolError, NONE
+from cruise_control_tpu.kafka.records import decode_batches, encode_batch
+
+DEFAULT_TOPIC = "__CruiseControlMetrics"
+EARLIEST = -2
+LATEST = -1
+
+
+class _TopicRouter:
+    """Partition -> leader routing from live metadata."""
+
+    def __init__(self, client: KafkaAdminClient, topic: str):
+        self.client = client
+        self.topic = topic
+        self._leaders: dict[int, int] = {}
+
+    def refresh(self) -> dict[int, int]:
+        md = self.client.metadata([self.topic])
+        self._leaders = {}
+        for t in md["topics"]:
+            if t["name"] != self.topic or t["error_code"] != NONE:
+                continue
+            for p in t["partitions"]:
+                if p["leader_id"] >= 0:
+                    self._leaders[p["partition_index"]] = p["leader_id"]
+        return self._leaders
+
+    def leaders(self) -> dict[int, int]:
+        return self._leaders or self.refresh()
+
+
+class KafkaMetricsTransport:
+    """MetricTransport over Produce v3 (reference reporter's producer)."""
+
+    def __init__(
+        self,
+        client: KafkaAdminClient,
+        topic: str = DEFAULT_TOPIC,
+        *,
+        acks: int = 1,
+        flush_every: int = 1000,
+        now_ms=None,
+    ):
+        self.client = client
+        self.topic = topic
+        self.acks = acks
+        self.flush_every = flush_every
+        self._router = _TopicRouter(client, topic)
+        self._buffer: list[bytes] = []
+        self._rr = 0  # round-robin partition cursor
+        self._lock = threading.Lock()
+        import time as _time
+
+        self._now = now_ms or (lambda: int(_time.time() * 1000))
+
+    def send(self, payload: bytes) -> None:
+        with self._lock:
+            self._buffer.append(payload)
+            full = len(self._buffer) >= self.flush_every
+        if full:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            records, self._buffer = self._buffer, []
+            if not records:
+                return
+            leaders = self._router.leaders()
+            if not leaders:
+                raise KafkaProtocolError("Produce", 3, f"no leaders for {self.topic}")
+            # spread whole flushes across partitions round-robin (records of
+            # one flush stay together: ordering within a batch is preserved)
+            parts = sorted(leaders)
+            partition = parts[self._rr % len(parts)]
+            self._rr += 1
+        batch = encode_batch(
+            [(None, r) for r in records], base_timestamp_ms=self._now()
+        )
+        self._produce(partition, leaders[partition], batch, retry_route=True)
+
+    def _produce(self, partition: int, node: int, batch: bytes, *,
+                 retry_route: bool) -> None:
+        resp = self.client.broker_request(node, proto.PRODUCE, {
+            "transactional_id": None,
+            "acks": self.acks,
+            "timeout_ms": 30_000,
+            "topic_data": [{
+                "name": self.topic,
+                "partition_data": [{"index": partition, "records": batch}],
+            }],
+        })
+        for t in resp["responses"] or []:
+            for p in t["partition_responses"] or []:
+                if p["error_code"] == NONE:
+                    continue
+                if p["error_code"] == 6 and retry_route:
+                    # NOT_LEADER_OR_FOLLOWER: re-route ONCE, then surface
+                    # whatever the retry returns (a silently-dropped batch is
+                    # silent metric loss)
+                    new_leader = self._router.refresh().get(partition)
+                    if new_leader is None:
+                        raise KafkaProtocolError(
+                            "Produce", 6, f"partition {partition} leaderless"
+                        )
+                    self._produce(partition, new_leader, batch, retry_route=False)
+                else:
+                    raise KafkaProtocolError("Produce", p["error_code"])
+
+
+class KafkaMetricsConsumer:
+    """Drains the reporter topic; `poll_framed()` feeds the native decoder.
+
+    Tracks its own per-partition offsets (the reference sampler also manages
+    offsets explicitly, seeking by time window) starting from EARLIEST.
+    """
+
+    def __init__(
+        self,
+        client: KafkaAdminClient,
+        topic: str = DEFAULT_TOPIC,
+        *,
+        max_bytes_per_fetch: int = 8 * 1024 * 1024,
+    ):
+        self.client = client
+        self.topic = topic
+        self.max_bytes = max_bytes_per_fetch
+        self._router = _TopicRouter(client, topic)
+        self._offsets: dict[int, int] = {}
+        #: fetched-but-undelivered payloads (a max_records poll must not
+        #: drop the tail — offsets advance at fetch time)
+        self._pending: list[bytes] = []
+        self._lock = threading.Lock()
+
+    def _ensure_offsets(self, leaders: dict[int, int]) -> None:
+        missing = [p for p in leaders if p not in self._offsets]
+        if not missing:
+            return
+        by_leader: dict[int, list[int]] = {}
+        for p in missing:
+            by_leader.setdefault(leaders[p], []).append(p)
+        for node, parts in by_leader.items():
+            resp = self.client.broker_request(node, proto.LIST_OFFSETS, {
+                "replica_id": -1,
+                "topics": [{
+                    "name": self.topic,
+                    "partitions": [
+                        {"partition_index": p, "timestamp": EARLIEST} for p in parts
+                    ],
+                }],
+            })
+            for t in resp["topics"] or []:
+                for p in t["partitions"] or []:
+                    if p["error_code"] == NONE:
+                        self._offsets[p["partition_index"]] = p["offset"]
+
+    def poll_records(self, max_records: int | None = None) -> list[bytes]:
+        """New record payloads across partitions (undelivered ones first)."""
+        with self._lock:
+            self._pending.extend(self._fetch_all())
+            n = len(self._pending) if max_records is None else min(
+                max_records, len(self._pending)
+            )
+            out, self._pending = self._pending[:n], self._pending[n:]
+            return out
+
+    def _fetch_all(self) -> list[bytes]:
+        """Fetch every partition from its leader, advancing offsets.
+        Caller holds the lock."""
+        leaders = self._router.refresh()
+        self._ensure_offsets(leaders)
+        by_leader: dict[int, list[int]] = {}
+        for p, node in leaders.items():
+            by_leader.setdefault(node, []).append(p)
+        out: list[bytes] = []
+        for node, parts in sorted(by_leader.items()):
+            resp = self.client.broker_request(node, proto.FETCH, {
+                "replica_id": -1,
+                "max_wait_ms": 0,
+                "min_bytes": 0,
+                "max_bytes": self.max_bytes,
+                "isolation_level": 0,
+                "topics": [{
+                    "topic": self.topic,
+                    "partitions": [
+                        {
+                            "partition": p,
+                            "fetch_offset": self._offsets.get(p, 0),
+                            "partition_max_bytes": self.max_bytes,
+                        }
+                        for p in sorted(parts)
+                    ],
+                }],
+            })
+            for t in resp["responses"] or []:
+                for pr in t["partitions"] or []:
+                    if pr["error_code"] != NONE or not pr["records"]:
+                        continue
+                    records = decode_batches(pr["records"])
+                    part = pr["partition_index"]
+                    next_off = self._offsets.get(part, 0)
+                    for r in records:
+                        if r.offset >= next_off:
+                            out.append(r.value)
+                            next_off = r.offset + 1
+                    self._offsets[part] = next_off
+        return out
+
+    def poll_framed(self, max_records: int | None = None) -> bytes:
+        from cruise_control_tpu.native import frame_records
+
+        return frame_records(self.poll_records(max_records))
+
+    def poll(self, max_records: int | None = None):
+        """Object-path compatibility with the MetricSampler SPI."""
+        from cruise_control_tpu.reporter.metrics import MetricSerde
+
+        return [MetricSerde.deserialize(r) for r in self.poll_records(max_records)]

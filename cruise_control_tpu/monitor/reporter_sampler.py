@@ -67,7 +67,6 @@ class CruiseControlMetricsReporterSampler:
 
     def get_samples(self, assigned_partitions, start_ms: int, end_ms: int) -> SamplingResult:
         topo: ClusterTopology = self.topology_provider()
-        raw = self.transport.poll()
         m = self.metric_def
         cpu_id = m.metric_id("CPU_USAGE")
         disk_id = m.metric_id("DISK_USAGE")
@@ -81,25 +80,72 @@ class CruiseControlMetricsReporterSampler:
         broker_values: dict[int, np.ndarray] = {}
         times: dict[int, int] = {}
 
-        for r in raw:
-            times[r.broker_id] = max(times.get(r.broker_id, 0), r.time_ms)
-            if isinstance(r, PartitionMetric) and r.metric_type == MetricType.PARTITION_SIZE:
-                part_size[(r.topic, r.partition)] = r.value
-            elif isinstance(r, TopicMetric):
-                if r.metric_type == MetricType.TOPIC_BYTES_IN:
-                    topic_bytes_in[(r.broker_id, r.topic)] = r.value
-                elif r.metric_type == MetricType.TOPIC_BYTES_OUT:
-                    topic_bytes_out[(r.broker_id, r.topic)] = r.value
-            elif isinstance(r, BrokerMetric):
-                if r.metric_type == MetricType.BROKER_CPU_UTIL:
-                    broker_cpu[r.broker_id] = r.value
-                else:
-                    name = _BROKER_METRIC_MAP.get(r.metric_type)
-                    if name is not None:
-                        v = broker_values.setdefault(
-                            r.broker_id, np.zeros(m.num_metrics, np.float32)
+        if hasattr(self.transport, "poll_framed"):
+            # columnar fast path: one native pass over the whole batch
+            # (cruise_control_tpu/native/serde.cpp), numpy masks instead of
+            # a per-record object loop — the JVM sampler's hot loop analog
+            from cruise_control_tpu.native import batch_deserialize
+
+            b = batch_deserialize(self.transport.poll_framed())
+            if len(b):
+                # latest report time per broker
+                order = np.argsort(b.broker_ids, kind="stable")
+                bids = b.broker_ids[order]
+                tms = b.times_ms[order]
+                uniq, starts = np.unique(bids, return_index=True)
+                maxes = np.maximum.reduceat(tms, starts)
+                times.update(
+                    (int(u), int(t)) for u, t in zip(uniq, maxes)
+                )
+                part_mask = (b.class_ids == 2) & (
+                    b.metric_types == int(MetricType.PARTITION_SIZE)
+                )
+                for i in np.nonzero(part_mask)[0]:
+                    part_size[(b.topics[b.topic_ids[i]], int(b.partitions[i]))] = float(
+                        b.values[i]
+                    )
+                for mask, store in (
+                    ((b.class_ids == 1) & (b.metric_types == int(MetricType.TOPIC_BYTES_IN)),
+                     topic_bytes_in),
+                    ((b.class_ids == 1) & (b.metric_types == int(MetricType.TOPIC_BYTES_OUT)),
+                     topic_bytes_out),
+                ):
+                    for i in np.nonzero(mask)[0]:
+                        store[(int(b.broker_ids[i]), b.topics[b.topic_ids[i]])] = float(
+                            b.values[i]
                         )
-                        v[m.metric_id(name)] = r.value
+                broker_mask = b.class_ids == 0
+                for i in np.nonzero(broker_mask)[0]:
+                    mt = MetricType(int(b.metric_types[i]))
+                    if mt == MetricType.BROKER_CPU_UTIL:
+                        broker_cpu[int(b.broker_ids[i])] = float(b.values[i])
+                    else:
+                        name = _BROKER_METRIC_MAP.get(mt)
+                        if name is not None:
+                            v = broker_values.setdefault(
+                                int(b.broker_ids[i]), np.zeros(m.num_metrics, np.float32)
+                            )
+                            v[m.metric_id(name)] = float(b.values[i])
+        else:
+            for r in self.transport.poll():
+                times[r.broker_id] = max(times.get(r.broker_id, 0), r.time_ms)
+                if isinstance(r, PartitionMetric) and r.metric_type == MetricType.PARTITION_SIZE:
+                    part_size[(r.topic, r.partition)] = r.value
+                elif isinstance(r, TopicMetric):
+                    if r.metric_type == MetricType.TOPIC_BYTES_IN:
+                        topic_bytes_in[(r.broker_id, r.topic)] = r.value
+                    elif r.metric_type == MetricType.TOPIC_BYTES_OUT:
+                        topic_bytes_out[(r.broker_id, r.topic)] = r.value
+                elif isinstance(r, BrokerMetric):
+                    if r.metric_type == MetricType.BROKER_CPU_UTIL:
+                        broker_cpu[r.broker_id] = r.value
+                    else:
+                        name = _BROKER_METRIC_MAP.get(r.metric_type)
+                        if name is not None:
+                            v = broker_values.setdefault(
+                                r.broker_id, np.zeros(m.num_metrics, np.float32)
+                            )
+                            v[m.metric_id(name)] = r.value
 
         # leader partitions per (broker, topic) for byte attribution
         leaders: dict[tuple[int, str], list] = defaultdict(list)

@@ -62,6 +62,16 @@ class InMemoryTransport:
             out, self._records = self._records[:n], self._records[n:]
         return [MetricSerde.deserialize(r) for r in out]
 
+    def poll_framed(self, max_records: int | None = None) -> bytes:
+        """Drain as one u32-length-framed batch for the native columnar
+        decoder (cruise_control_tpu/native) — no per-record objects."""
+        from cruise_control_tpu.native import frame_records
+
+        with self._lock:
+            n = len(self._records) if max_records is None else min(max_records, len(self._records))
+            out, self._records = self._records[:n], self._records[n:]
+        return frame_records(out)
+
 
 class MetricsRegistrySnapshotter:
     """Adapter from a metrics source to raw metric records — the

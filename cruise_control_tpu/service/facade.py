@@ -93,7 +93,20 @@ class CruiseControl:
         self._cache: _CachedResult | None = None
         self._cache_lock = threading.Lock()
         self._proposal_expiration_ms = config.get("proposal.expiration.ms")
-        notifier = SelfHealingNotifier(
+        webhook = config.get("slack.self.healing.notifier.webhook")
+        notifier_cls = SelfHealingNotifier
+        notifier_kwargs: dict = {}
+        if webhook:
+            from cruise_control_tpu.detector.notifier import SlackSelfHealingNotifier
+
+            notifier_cls = SlackSelfHealingNotifier
+            notifier_kwargs = dict(
+                webhook_url=webhook,
+                channel=config.get("slack.self.healing.notifier.channel"),
+                username=config.get("slack.self.healing.notifier.user"),
+            )
+        notifier = notifier_cls(
+            **notifier_kwargs,
             self_healing={
                 AnomalyType.BROKER_FAILURE: config.get("self.healing.broker.failure.enabled"),
                 AnomalyType.GOAL_VIOLATION: config.get("self.healing.goal.violation.enabled"),
@@ -118,21 +131,69 @@ class CruiseControl:
 
     def _wire_detectors(self):
         """Reference AnomalyDetector.java:63-68 wiring."""
+        from cruise_control_tpu.detector.detectors import SlowBrokerFinder
+
         req = ModelCompletenessRequirements(min_required_num_windows=1)
         gvd = GoalViolationDetector(
             lambda: self.monitor.cluster_model(req), self.chain, self.constraint
         )
-        bfd = BrokerFailureDetector(self.admin.topology)
+        bfd = BrokerFailureDetector(
+            self.admin.topology,
+            persist_path=self.config.get("broker.failure.persisted.path"),
+        )
         dfd = DiskFailureDetector(self.admin.topology)
         rfd = TopicReplicationFactorAnomalyFinder(
             self.admin.topology,
             target_rf=self.config.get("topic.anomaly.target.replication.factor"),
         )
+        slow = SlowBrokerFinder(
+            history_percentile=self.config.get("slow.broker.history.percentile"),
+            peer_ratio=self.config.get("slow.broker.peer.comparison.ratio"),
+            removal_threshold=self.config.get("slow.broker.strike.removal.threshold"),
+        )
+
+        def slow_detect():
+            """Feed the finder the broker log-flush latency window average
+            (reference SlowBrokerFinder.java:99 metric sources)."""
+            runner = self.task_runner
+            agg = getattr(getattr(runner, "fetcher", None), "broker_aggregator", None)
+            if agg is None or not agg.num_entities():
+                return None
+            try:
+                res = agg.aggregate()
+            except ValueError:
+                return None
+            try:
+                mid = agg.metric_def.metric_id("BROKER_LOG_FLUSH_TIME_MS_MEAN")
+            except KeyError:
+                return None
+            latest: dict[int, float] = {}
+            for i, entity in enumerate(agg.entities()):
+                valid = res.window_valid[i]
+                if valid.any():
+                    w = int(np.nonzero(valid)[0][0])  # newest valid window
+                    latest[int(getattr(entity, "broker_id", entity))] = float(
+                        res.values[i, w, mid]
+                    )
+            anomaly = slow.detect(latest)
+            # removal (decommission + rebuild) is destructive; the dedicated
+            # switch gates it regardless of strike count (reference
+            # AnomalyDetectorConfig slow.broker removal switches)
+            if (
+                anomaly is not None
+                and anomaly.remove_slow_brokers
+                and not self.config.get("slow.broker.removal.enabled")
+            ):
+                anomaly = dataclasses.replace(anomaly, remove_slow_brokers=False)
+            return anomaly
+
         self.broker_failure_detector = bfd
+        self.slow_broker_finder = slow
         self.anomaly_detector.register_detector(gvd.detect)
         self.anomaly_detector.register_detector(bfd.detect)
         self.anomaly_detector.register_detector(dfd.detect)
         self.anomaly_detector.register_detector(rfd.detect)
+        self.anomaly_detector.register_detector(slow_detect)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp():162)
@@ -256,6 +317,10 @@ class CruiseControl:
             replication_throttle_bytes_per_s=self.config.get("default.replication.throttle"),
             progress_check_interval_s=self.config.get(
                 "execution.progress.check.interval.ms"
+            )
+            / 1000.0,
+            task_execution_alerting_s=self.config.get(
+                "task.execution.alerting.threshold.ms"
             )
             / 1000.0,
         )

@@ -509,7 +509,45 @@ class CruiseControlApp:
             def do_POST(self):  # noqa: N802
                 self._dispatch("POST")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        # TLS listener (reference KafkaCruiseControlApp.java:100-120 wraps the
+        # Jetty connector in an SslContextFactory).  The handshake runs in
+        # the PER-CONNECTION thread (finish_request), never the accept loop —
+        # wrapping the listening socket would let one stalled client (open
+        # TCP, no ClientHello) block every other request.
+        ssl_ctx = None
+        if self.config.get("webserver.ssl.enable"):
+            import ssl
+
+            cert = self.config.get("webserver.ssl.certificate.location")
+            if not cert:
+                raise ValueError(
+                    "webserver.ssl.enable requires webserver.ssl.certificate.location"
+                )
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(
+                certfile=cert,
+                keyfile=self.config.get("webserver.ssl.key.location") or None,
+                password=self.config.get("webserver.ssl.key.password") or None,
+            )
+
+        class Server(ThreadingHTTPServer):
+            def finish_request(self, request, client_address):
+                if ssl_ctx is not None:
+                    import ssl
+
+                    try:
+                        request.settimeout(30)  # bound the handshake
+                        request = ssl_ctx.wrap_socket(request, server_side=True)
+                        request.settimeout(None)
+                    except (ssl.SSLError, OSError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                self.RequestHandlerClass(request, client_address, self)
+
+        self._httpd = Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()

@@ -50,6 +50,10 @@ class FakeKafkaCluster:
         self.placement: dict[int, dict[str, set]] = {}
         self._auto_complete_after: int | None = None
         self._list_polls = 0
+        #: data plane: (topic, partition) -> [batch bytes]; offsets assigned
+        #: at append like a real log
+        self.logs: dict[tuple[str, int], list[bytes]] = {}
+        self.log_end: dict[tuple[str, int], int] = {}
         self._servers: list[_BrokerListener] = []
         for bid, spec in sorted(brokers.items()):
             self.brokers[bid] = {"rack": spec.get("rack", ""), "port": None}
@@ -173,6 +177,10 @@ class FakeKafkaCluster:
                         del self.reassignments[key]
                     else:
                         code, msg = NO_REASSIGNMENT_IN_PROGRESS, "none in progress"
+                elif set(p["replicas"]) == set(self.topics[t["name"]][key[1]]["replicas"]):
+                    # pure reorder: every target replica is already in ISR, so
+                    # real Kafka completes it immediately (no data movement)
+                    self.topics[t["name"]][key[1]]["replicas"] = list(p["replicas"])
                 else:
                     self.reassignments[key] = list(p["replicas"])
                 parts.append(
@@ -240,6 +248,107 @@ class FakeKafkaCluster:
                 "resource_name": r["resource_name"],
             })
         return {"throttle_time_ms": 0, "responses": responses}
+
+    def _h_Produce(self, node, body):  # noqa: N802
+        responses = []
+        for t in body["topic_data"] or []:
+            name = t["name"]
+            if name not in self.topics:
+                # reporter auto-creates its topic
+                # (CruiseControlMetricsReporter topic bootstrap)
+                self.topics[name] = {
+                    0: {"partition": 0, "leader": node, "replicas": [node]}
+                }
+            parts = []
+            for pd in t["partition_data"] or []:
+                key = (name, pd["index"])
+                part = self.topics[name].get(pd["index"])
+                code = 0
+                base = -1
+                if part is None:
+                    code = 3  # UNKNOWN_TOPIC_OR_PARTITION
+                elif part["leader"] != node:
+                    code = 6  # NOT_LEADER_OR_FOLLOWER
+                elif pd["records"]:
+                    batch = bytearray(pd["records"])
+                    base = self.log_end.get(key, 0)
+                    struct.pack_into(">q", batch, 0, base)  # assign offsets
+                    (count,) = struct.unpack_from(">i", batch, 57)
+                    self.logs.setdefault(key, []).append(bytes(batch))
+                    self.log_end[key] = base + count
+                parts.append({
+                    "index": pd["index"], "error_code": code,
+                    "base_offset": base, "log_append_time_ms": -1,
+                })
+            responses.append({"name": name, "partition_responses": parts})
+        return {"responses": responses, "throttle_time_ms": 0}
+
+    def _h_Fetch(self, node, body):  # noqa: N802
+        responses = []
+        for t in body["topics"] or []:
+            parts = []
+            for p in t["partitions"] or []:
+                key = (t["topic"], p["partition"])
+                end = self.log_end.get(key, 0)
+                part = self.topics.get(t["topic"], {}).get(p["partition"])
+                code = 0
+                data = b""
+                if part is None:
+                    code = 3
+                elif part["leader"] != node:
+                    code = 6
+                else:
+                    want = p["fetch_offset"]
+                    chunks = []
+                    for batch in self.logs.get(key, []):
+                        (base,) = struct.unpack_from(">q", batch, 0)
+                        (count,) = struct.unpack_from(">i", batch, 57)
+                        if base + count > want:
+                            chunks.append(batch)
+                    data = b"".join(chunks)
+                parts.append({
+                    "partition_index": p["partition"], "error_code": code,
+                    "high_watermark": end, "last_stable_offset": end,
+                    "aborted_transactions": None,
+                    "records": data,
+                })
+            responses.append({"topic": t["topic"], "partitions": parts})
+        return {"throttle_time_ms": 0, "responses": responses}
+
+    def _h_ListOffsets(self, node, body):  # noqa: N802
+        topics = []
+        for t in body["topics"] or []:
+            parts = []
+            for p in t["partitions"] or []:
+                key = (t["name"], p["partition_index"])
+                if p["timestamp"] == -2:  # earliest
+                    off = 0
+                else:  # latest
+                    off = self.log_end.get(key, 0)
+                parts.append({
+                    "partition_index": p["partition_index"], "error_code": 0,
+                    "timestamp": -1, "offset": off,
+                })
+            topics.append({"name": t["name"], "partitions": parts})
+        return {"topics": topics}
+
+    def _h_DescribeConfigs(self, node, body):  # noqa: N802
+        results = []
+        for r in body["resources"] or []:
+            store = self.configs.get((r["resource_type"], r["resource_name"]), {})
+            wanted = r["configuration_keys"]
+            results.append({
+                "error_code": 0, "error_message": None,
+                "resource_type": r["resource_type"],
+                "resource_name": r["resource_name"],
+                "configs": [
+                    {"name": k, "value": v, "read_only": False,
+                     "is_default": False, "is_sensitive": False}
+                    for k, v in sorted(store.items())
+                    if wanted is None or k in wanted
+                ],
+            })
+        return {"throttle_time_ms": 0, "results": results}
 
     def _h_AlterReplicaLogDirs(self, node, body):  # noqa: N802
         results: dict[str, list[dict]] = {}

@@ -269,3 +269,20 @@ def test_self_healing_end_to_end_dead_broker():
     after = meta.topology()
     for p in after.partitions:
         assert 4 not in p.replicas, f"partition {p} still on dead broker"
+
+
+def test_slow_broker_detector_wired_into_service():
+    """The facade registers a SlowBrokerFinder fed from the broker
+    aggregator (reference AnomalyDetector.java:63-68 wiring + metric
+    sources SlowBrokerFinder.java:99)."""
+    from cruise_control_tpu.service.main import build_simulated_service
+
+    app, fetcher, admin, sampler = build_simulated_service(seed=17)
+    try:
+        assert app.cc.slow_broker_finder is not None
+        # a full detection round must execute the slow-broker feed without
+        # error against the live broker aggregator
+        records = app.cc.anomaly_detector.run_once()
+        assert isinstance(records, list)
+    finally:
+        app.stop()

@@ -90,6 +90,33 @@ def test_all_schemas_roundtrip():
     samples = {
         "ApiVersions": ({}, {"error_code": 0, "api_keys": [
             {"api_key": 3, "min_version": 0, "max_version": 9}]}),
+        "Produce": (
+            {"transactional_id": None, "acks": 1, "timeout_ms": 100,
+             "topic_data": [{"name": "t", "partition_data": [
+                 {"index": 0, "records": b"\x01\x02"}]}]},
+            {"responses": [{"name": "t", "partition_responses": [
+                {"index": 0, "error_code": 0, "base_offset": 7,
+                 "log_append_time_ms": -1}]}],
+             "throttle_time_ms": 0},
+        ),
+        "Fetch": (
+            {"replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+             "max_bytes": 1024, "isolation_level": 0,
+             "topics": [{"topic": "t", "partitions": [
+                 {"partition": 0, "fetch_offset": 3,
+                  "partition_max_bytes": 1024}]}]},
+            {"throttle_time_ms": 0, "responses": [{"topic": "t", "partitions": [
+                {"partition_index": 0, "error_code": 0, "high_watermark": 9,
+                 "last_stable_offset": 9, "aborted_transactions": None,
+                 "records": b"\x00"}]}]},
+        ),
+        "ListOffsets": (
+            {"replica_id": -1, "topics": [{"name": "t", "partitions": [
+                {"partition_index": 0, "timestamp": -2}]}]},
+            {"topics": [{"name": "t", "partitions": [
+                {"partition_index": 0, "error_code": 0, "timestamp": -1,
+                 "offset": 0}]}]},
+        ),
         "Metadata": (
             {"topics": None},
             {"brokers": [{"node_id": 0, "host": "h", "port": 9092, "rack": None}],
@@ -136,6 +163,15 @@ def test_all_schemas_roundtrip():
             {"throttle_time_ms": 0, "results": [
                 {"topic_name": "t", "partitions": [
                     {"partition_index": 0, "error_code": 0}]}]},
+        ),
+        "DescribeConfigs": (
+            {"resources": [{"resource_type": 4, "resource_name": "1",
+                            "configuration_keys": None}]},
+            {"throttle_time_ms": 0, "results": [
+                {"error_code": 0, "error_message": None, "resource_type": 4,
+                 "resource_name": "1", "configs": [
+                     {"name": "k", "value": "v", "read_only": False,
+                      "is_default": False, "is_sensitive": False}]}]},
         ),
         "DescribeLogDirs": (
             {"topics": None},
@@ -251,11 +287,15 @@ def test_contract_cancel(harness):
 
 def test_contract_leadership(harness):
     admin = harness.admin
-    # T0 p1 preferred leader (= first replica) is 1; move leadership to it
-    # after first making 1 non-leader via a real election on the fake side
-    admin.elect_leaders([LeadershipSpec("T0", 1, preferred_leader=1)])
+    # T0 p1: replicas (1, 2), leader 1.  Move leadership to 2 — NOT the
+    # preferred replica, so the real-cluster adapter must reorder the
+    # assignment before the preferred election (a plain election would
+    # re-elect 1 and silently no-op).
+    admin.elect_leaders([LeadershipSpec("T0", 1, preferred_leader=2)])
     parts = {(p.topic, p.partition): p for p in admin.topology().partitions}
-    assert parts[("T0", 1)].leader == 1
+    assert parts[("T0", 1)].leader == 2
+    # already-leader case must be accepted as success, not an error
+    admin.elect_leaders([LeadershipSpec("T0", 1, preferred_leader=2)])
 
 
 def test_contract_throttle(harness):
@@ -307,5 +347,38 @@ def test_logdir_moves_against_fake_kafka():
         dirs = h.client.describe_logdirs(0)
         assert ("T0", 0) in dirs["/d0/b"]["replicas"]
         assert ("T0", 0) not in dirs["/d0/a"]["replicas"]
+    finally:
+        h.close()
+
+
+def test_throttle_clear_survives_restart():
+    """A NEW admin instance (fresh process after a crash) must discover and
+    clear throttles set by the old one — via DescribeConfigs, not memory."""
+    h = _KafkaHarness()
+    try:
+        h.admin.set_replication_throttle(5e6, {"T0"})
+        assert h.throttle_active()
+        fresh = KafkaClusterAdmin(h.client)  # empty in-memory tracking
+        fresh.clear_replication_throttle()
+        assert not h.throttle_active()
+        assert not any(
+            cfg for (rt, _), cfg in h.cluster.configs.items() if cfg
+        )
+    finally:
+        h.close()
+
+
+def test_connection_retries_after_idle_close():
+    """The first request after the broker closed an idle connection must
+    transparently reconnect (brokers enforce connections.max.idle.ms)."""
+    h = _KafkaHarness()
+    try:
+        h.admin.topology()  # opens connections
+        # simulate an idle-close: kill every cached socket server-side view
+        for conn in h.client._conns.values():
+            if conn._sock is not None:
+                conn._sock.close()  # poisoned fd; next send/recv fails
+        topo = h.admin.topology()  # must succeed via reconnect
+        assert len(topo.brokers) == 3
     finally:
         h.close()

@@ -1,0 +1,171 @@
+"""Native (C++) batch-serde tests: parity with the Python serde, error
+handling, and the sampler's columnar fast path."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.native import (
+    batch_deserialize,
+    frame_records,
+    native_available,
+)
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric,
+    MetricSerde,
+    MetricType,
+    PartitionMetric,
+    TopicMetric,
+)
+
+
+def _random_records(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    topics = ["Topic-A", "tøpic-ünïcode", "T" * 100, "b"]
+    for i in range(n):
+        kind = rng.integers(0, 3)
+        t = int(rng.integers(0, 10_000_000))
+        b = int(rng.integers(0, 4000))
+        v = float(rng.normal() * 1e6)
+        if kind == 0:
+            recs.append(BrokerMetric(MetricType.BROKER_CPU_UTIL, t, b, v))
+        elif kind == 1:
+            recs.append(
+                TopicMetric(MetricType.TOPIC_BYTES_IN, t, b, v,
+                            topic=topics[i % len(topics)])
+            )
+        else:
+            recs.append(
+                PartitionMetric(MetricType.PARTITION_SIZE, t, b, v,
+                                topic=topics[i % len(topics)],
+                                partition=int(rng.integers(0, 500)))
+            )
+    return recs
+
+
+def test_native_builds():
+    assert native_available(), "g++ toolchain is baked into this image"
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_batch_parity_with_record_serde(force_python):
+    recs = _random_records(500, seed=3)
+    framed = frame_records([MetricSerde.serialize(r) for r in recs])
+    batch = batch_deserialize(framed, force_python=force_python)
+    assert len(batch) == len(recs)
+    for i, r in enumerate(recs):
+        assert batch.metric_types[i] == int(r.metric_type)
+        assert batch.times_ms[i] == r.time_ms
+        assert batch.broker_ids[i] == r.broker_id
+        assert batch.values[i] == r.value
+        if isinstance(r, PartitionMetric):
+            assert batch.class_ids[i] == 2
+            assert batch.partitions[i] == r.partition
+            assert batch.topics[batch.topic_ids[i]] == r.topic
+        elif isinstance(r, TopicMetric):
+            assert batch.class_ids[i] == 1
+            assert batch.topics[batch.topic_ids[i]] == r.topic
+        else:
+            assert batch.class_ids[i] == 0
+            assert batch.topic_ids[i] == -1
+
+
+def test_native_and_python_paths_agree():
+    recs = _random_records(300, seed=9)
+    framed = frame_records([MetricSerde.serialize(r) for r in recs])
+    a = batch_deserialize(framed, force_python=False)
+    b = batch_deserialize(framed, force_python=True)
+    np.testing.assert_array_equal(a.class_ids, b.class_ids)
+    np.testing.assert_array_equal(a.metric_types, b.metric_types)
+    np.testing.assert_array_equal(a.values, b.values)
+    np.testing.assert_array_equal(a.partitions, b.partitions)
+    assert [a.topics[i] for i in a.topic_ids if i >= 0] == [
+        b.topics[i] for i in b.topic_ids if i >= 0
+    ]
+
+
+def test_malformed_batches_rejected():
+    good = frame_records([MetricSerde.serialize(
+        BrokerMetric(MetricType.BROKER_CPU_UTIL, 1, 2, 3.0))])
+    for bad in (good[:-1], good + b"\x01", b"\x05\x00\x00\x00abc"):
+        with pytest.raises(ValueError):
+            batch_deserialize(bad)
+        with pytest.raises(ValueError):
+            batch_deserialize(bad, force_python=True)
+    assert len(batch_deserialize(b"")) == 0
+
+
+def test_sampler_columnar_path_matches_object_path():
+    """The reporter sampler must produce identical samples through the
+    native fast path and the per-record object path."""
+    from cruise_control_tpu.monitor.reporter_sampler import (
+        CruiseControlMetricsReporterSampler,
+    )
+    from cruise_control_tpu.reporter.reporter import InMemoryTransport
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    topo = synthetic_topology(num_brokers=4, topics={"T0": 8, "T1": 8}, seed=5)
+
+    def make_transport(records):
+        tr = InMemoryTransport()
+        for r in records:
+            tr.send(MetricSerde.serialize(r))
+        return tr
+
+    records = []
+    for b in range(4):
+        records.append(BrokerMetric(MetricType.BROKER_CPU_UTIL, 1000, b, 40.0 + b))
+        for t in ("T0", "T1"):
+            records.append(TopicMetric(MetricType.TOPIC_BYTES_IN, 1000, b, 1e5 * (b + 1), topic=t))
+            records.append(TopicMetric(MetricType.TOPIC_BYTES_OUT, 1000, b, 2e5 * (b + 1), topic=t))
+    for p in topo.partitions:
+        records.append(PartitionMetric(
+            MetricType.PARTITION_SIZE, 1000, p.leader, 1e6 + p.partition,
+            topic=p.topic, partition=p.partition,
+        ))
+
+    class ObjectOnlyTransport:
+        """Exposes poll() but not poll_framed — forces the object path."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def poll(self, max_records=None):
+            return self._inner.poll(max_records)
+
+    fast = CruiseControlMetricsReporterSampler(make_transport(records), lambda: topo)
+    slow = CruiseControlMetricsReporterSampler(
+        ObjectOnlyTransport(make_transport(records)), lambda: topo
+    )
+    r_fast = fast.get_samples([], 0, 2000)
+    r_slow = slow.get_samples([], 0, 2000)
+
+    def key(s):
+        return (repr(s.entity), tuple(np.round(np.asarray(s.values, float), 6)))
+
+    assert sorted(map(key, r_fast.partition_samples)) == sorted(
+        map(key, r_slow.partition_samples)
+    )
+    assert sorted(map(key, r_fast.broker_samples)) == sorted(
+        map(key, r_slow.broker_samples)
+    )
+
+
+def test_native_throughput_smoke():
+    """Native decode should comfortably beat the object loop (informational;
+    asserts only a sane lower bound to avoid flakes)."""
+    recs = _random_records(20_000, seed=1)
+    payloads = [MetricSerde.serialize(r) for r in recs]
+    framed = frame_records(payloads)
+    t0 = time.perf_counter()
+    batch = batch_deserialize(framed)
+    native_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _ = [MetricSerde.deserialize(p) for p in payloads]
+    object_s = time.perf_counter() - t0
+    assert len(batch) == len(recs)
+    if native_available():
+        # native columnar decode must not be slower than object-per-record
+        assert native_s <= object_s

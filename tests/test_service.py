@@ -429,3 +429,83 @@ def test_two_step_verification_flow():
         assert status == 200 and "balancednessAfter" in payload
     finally:
         app.stop()
+
+
+def test_ssl_listener():
+    """REST over TLS (reference KafkaCruiseControlApp.java:100-120)."""
+    import datetime
+    import ssl as ssl_mod
+    import tempfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name).public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .sign(key, hashes.SHA256())
+    )
+    pem = tempfile.NamedTemporaryFile("wb", suffix=".pem", delete=False)
+    pem.write(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    pem.write(cert.public_bytes(serialization.Encoding.PEM))
+    pem.close()
+
+    config = _service_config(**{
+        "webserver.ssl.enable": "true",
+        "webserver.ssl.certificate.location": pem.name,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=2)
+    app.start()
+    try:
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl_mod.CERT_NONE
+        url = f"https://{app.host}:{app.port}{app.prefix}/state?substates=monitor"
+        with urllib.request.urlopen(url, context=ctx, timeout=30) as resp:
+            assert resp.status == 200
+            assert "MonitorState" in json.loads(resp.read())
+    finally:
+        app.stop()
+
+
+def test_slack_notifier_posts_webhook():
+    """SlackSelfHealingNotifier formats + delivers alerts
+    (reference SlackSelfHealingNotifier.java); injected poster, no egress."""
+    from cruise_control_tpu.detector.anomalies import AnomalyType, GoalViolations
+    from cruise_control_tpu.detector.notifier import (
+        Action,
+        SlackSelfHealingNotifier,
+    )
+
+    posts = []
+    n = SlackSelfHealingNotifier(
+        "https://hooks.slack.invalid/services/X",
+        channel="#ops",
+        poster=lambda url, body: posts.append((url, json.loads(body))),
+        self_healing={AnomalyType.GOAL_VIOLATION: True},
+    )
+    anomaly = GoalViolations(fixable_violations=["DiskUsageDistributionGoal"])
+    result = n.on_anomaly(anomaly)
+    assert result.action == Action.FIX
+    assert len(posts) == 1
+    url, payload = posts[0]
+    assert payload["channel"] == "#ops"
+    assert "GOAL_VIOLATION" in payload["text"]
+    # delivery failure must not propagate
+    def boom(url, body):
+        raise OSError("no route")
+    n2 = SlackSelfHealingNotifier(
+        "https://x.invalid", poster=boom,
+        self_healing={AnomalyType.GOAL_VIOLATION: True},
+    )
+    assert n2.on_anomaly(anomaly).action == Action.FIX
