@@ -66,6 +66,7 @@ ENDPOINTS: dict[str, dict] = {
                              "--goals": ("goals", str),
                              "--destination-broker-ids": ("destination_broker_ids", csv_int_param),
                              "--excluded-topics": ("excluded_topics", str),
+                             "--rebalance-disk": ("rebalance_disk", boolean_param),
                              "--review-id": ("review_id", positive_int_param)}},
     "add_broker": {"method": "POST", "endpoint": "add_broker",
                    "params": {"--brokers": ("brokerid", csv_int_param),

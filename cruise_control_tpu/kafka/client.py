@@ -287,6 +287,21 @@ class KafkaAdminClient:
                     "IncrementalAlterConfigs", r["error_code"], r.get("error_message")
                 )
 
+    def create_topics(
+        self, topics: list[tuple[str, int, int]], timeout_ms: int = 30_000
+    ) -> dict[str, int]:
+        """[(name, num_partitions, replication_factor)] -> name: error_code.
+        36 = TOPIC_ALREADY_EXISTS (callers usually treat it as success)."""
+        resp = self._controller_request(proto.CREATE_TOPICS, {
+            "topics": [
+                {"name": n, "num_partitions": p, "replication_factor": rf,
+                 "assignments": [], "configs": []}
+                for n, p, rf in topics
+            ],
+            "timeout_ms": timeout_ms,
+        })
+        return {t["name"]: t["error_code"] for t in resp["topics"] or []}
+
     def describe_configs(
         self, resources: list[tuple[int, str]], names: list[str] | None = None,
         *, node_id: int | None = None,

@@ -96,6 +96,37 @@ METADATA = Api(
     ),
 )
 
+# -------------------------------------------------------------- CreateTopics
+
+#: used for the sample-store + reporter topics (reference auto-creates its
+#: topics: CruiseControlMetricsReporter topic bootstrap, KafkaSampleStore
+#: ensureTopicsCreated)
+CREATE_TOPICS = Api(
+    "CreateTopics", 19, 0, False,
+    request=Struct(
+        ("topics", Array(Struct(
+            ("name", String),
+            ("num_partitions", Int32),
+            ("replication_factor", Int16),
+            ("assignments", Array(Struct(
+                ("partition_index", Int32),
+                ("broker_ids", Array(Int32)),
+            ))),
+            ("configs", Array(Struct(
+                ("name", String),
+                ("value", NullableString),
+            ))),
+        ))),
+        ("timeout_ms", Int32),
+    ),
+    response=Struct(
+        ("topics", Array(Struct(
+            ("name", String),
+            ("error_code", Int16),
+        ))),
+    ),
+)
+
 # ---------------------------------------- AlterPartitionReassignments (KIP-455)
 
 ALTER_PARTITION_REASSIGNMENTS = Api(
@@ -391,7 +422,7 @@ DESCRIBE_LOG_DIRS = Api(
 )
 
 ALL_APIS = [
-    PRODUCE, FETCH, LIST_OFFSETS,
+    PRODUCE, FETCH, LIST_OFFSETS, CREATE_TOPICS,
     API_VERSIONS, METADATA, ALTER_PARTITION_REASSIGNMENTS,
     LIST_PARTITION_REASSIGNMENTS, ELECT_LEADERS, INCREMENTAL_ALTER_CONFIGS,
     DESCRIBE_CONFIGS, ALTER_REPLICA_LOG_DIRS, DESCRIBE_LOG_DIRS,

@@ -184,8 +184,12 @@ def decode_batches(buf: bytes, *, verify_crc: bool = True) -> list[Record]:
                 key = bytes(post[p: p + key_len])
                 p += key_len
             val_len, p = read_zigzag(post, p)
-            value = bytes(post[p: p + val_len])
-            p += val_len
+            # -1 = null value (tombstone on a compacted topic — the
+            # reference's sample-store topics are compacted)
+            value = b""
+            if val_len >= 0:
+                value = bytes(post[p: p + val_len])
+                p += val_len
             hdr_count, p = read_zigzag(post, p)
             for _h in range(hdr_count):
                 klen, p = read_zigzag(post, p)

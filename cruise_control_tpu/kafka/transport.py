@@ -84,22 +84,32 @@ class KafkaMetricsTransport:
             self.flush()
 
     def flush(self) -> None:
+        # swap the buffer under the lock; network work (metadata + produce)
+        # happens OUTSIDE it so concurrent send()s never block on a slow
+        # broker.  On any failure the records go back to the buffer — a
+        # transient hiccup must not drop metrics.
         with self._lock:
             records, self._buffer = self._buffer, []
             if not records:
                 return
+            rr = self._rr
+            self._rr += 1
+        try:
             leaders = self._router.leaders()
             if not leaders:
                 raise KafkaProtocolError("Produce", 3, f"no leaders for {self.topic}")
             # spread whole flushes across partitions round-robin (records of
             # one flush stay together: ordering within a batch is preserved)
             parts = sorted(leaders)
-            partition = parts[self._rr % len(parts)]
-            self._rr += 1
-        batch = encode_batch(
-            [(None, r) for r in records], base_timestamp_ms=self._now()
-        )
-        self._produce(partition, leaders[partition], batch, retry_route=True)
+            partition = parts[rr % len(parts)]
+            batch = encode_batch(
+                [(None, r) for r in records], base_timestamp_ms=self._now()
+            )
+            self._produce(partition, leaders[partition], batch, retry_route=True)
+        except Exception:
+            with self._lock:
+                self._buffer[:0] = records  # restore, preserving order
+            raise
 
     def _produce(self, partition: int, node: int, batch: bytes, *,
                  retry_route: bool) -> None:
@@ -216,6 +226,12 @@ class KafkaMetricsConsumer:
             })
             for t in resp["responses"] or []:
                 for pr in t["partitions"] or []:
+                    if pr["error_code"] == 1:  # OFFSET_OUT_OF_RANGE
+                        # retention passed our offset: drop it so the next
+                        # poll re-seeks to EARLIEST instead of stalling the
+                        # partition forever
+                        self._offsets.pop(pr["partition_index"], None)
+                        continue
                     if pr["error_code"] != NONE or not pr["records"]:
                         continue
                     records = decode_batches(pr["records"])

@@ -249,6 +249,26 @@ class FakeKafkaCluster:
             })
         return {"throttle_time_ms": 0, "responses": responses}
 
+    def _h_CreateTopics(self, node, body):  # noqa: N802
+        out = []
+        ids = sorted(self.brokers)
+        for t in body["topics"] or []:
+            if t["name"] in self.topics:
+                out.append({"name": t["name"], "error_code": 36})  # EXISTS
+                continue
+            n = max(1, t["num_partitions"])
+            rf = max(1, min(t["replication_factor"], len(ids)))
+            self.topics[t["name"]] = {
+                p: {
+                    "partition": p,
+                    "leader": ids[p % len(ids)],
+                    "replicas": [ids[(p + r) % len(ids)] for r in range(rf)],
+                }
+                for p in range(n)
+            }
+            out.append({"name": t["name"], "error_code": 0})
+        return {"topics": out}
+
     def _h_Produce(self, node, body):  # noqa: N802
         responses = []
         for t in body["topic_data"] or []:

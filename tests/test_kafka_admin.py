@@ -90,6 +90,13 @@ def test_all_schemas_roundtrip():
     samples = {
         "ApiVersions": ({}, {"error_code": 0, "api_keys": [
             {"api_key": 3, "min_version": 0, "max_version": 9}]}),
+        "CreateTopics": (
+            {"topics": [{"name": "t", "num_partitions": 2,
+                         "replication_factor": 1, "assignments": [],
+                         "configs": [{"name": "k", "value": None}]}],
+             "timeout_ms": 100},
+            {"topics": [{"name": "t", "error_code": 0}]},
+        ),
         "Produce": (
             {"transactional_id": None, "acks": 1, "timeout_ms": 100,
              "topic_data": [{"name": "t", "partition_data": [

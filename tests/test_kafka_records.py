@@ -47,6 +47,28 @@ def test_batch_roundtrip():
     # concatenated batches + trailing partial are handled
     two = batch + encode_batch([(None, b"x")], base_offset=111) + batch[:20]
     assert len(decode_batches(two)) == 12
+    # null-value (tombstone) records decode without poisoning the cursor
+    import struct as _struct
+    from cruise_control_tpu.kafka.records import write_zigzag
+
+    rec = bytearray()
+    rec.append(0)
+    write_zigzag(rec, 0)   # ts delta
+    write_zigzag(rec, 0)   # offset delta
+    write_zigzag(rec, 3)
+    rec += b"key"
+    write_zigzag(rec, -1)  # NULL value
+    write_zigzag(rec, 0)   # headers
+    body = bytearray()
+    write_zigzag(body, len(rec))
+    body += rec
+    post = _struct.pack(">hiqqqhii", 0, 0, 7, 7, -1, -1, -1, 1) + bytes(body)
+    from cruise_control_tpu.kafka.records import crc32c as _crc
+    tomb = (_struct.pack(">qii", 5, 4 + 1 + 4 + len(post), -1) + b"\x02"
+            + _struct.pack(">I", _crc(post)) + post)
+    [t] = decode_batches(tomb)
+    assert t.key == b"key" and t.value == b"" and t.offset == 5
+
     # corrupted CRC rejected
     bad = bytearray(batch)
     bad[30] ^= 0xFF
@@ -133,6 +155,67 @@ def test_reporter_to_sampler_loop_over_kafka():
         assert len(result.broker_samples) == 3
         vals = np.asarray(result.partition_samples[0].values, float)
         assert vals.sum() > 0
+    finally:
+        client.close()
+        cluster.stop()
+
+
+def test_kafka_sample_store_warm_restart():
+    """Samples persisted to the Kafka store topics replay into a FRESH
+    store instance — the reference KafkaSampleStore/SampleLoadingTask warm
+    restart (KafkaSampleStore.java:117-128)."""
+    from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+    from cruise_control_tpu.monitor.sampling import (
+        BrokerEntity,
+        MetricSample,
+        PartitionEntity,
+        SamplingResult,
+    )
+
+    cluster = _cluster()
+    client = KafkaAdminClient(cluster.bootstrap(), timeout_s=5.0)
+    try:
+        # old process interned {alpha: 0}; new process interns {alpha: 7} —
+        # replay must follow the NAME, not the stale dense id
+        store = KafkaSampleStore(
+            client, topic_name_fn={0: "alpha"}.__getitem__,
+        )
+        for w in range(3):
+            t = w * 1000 + 500
+            store.store(SamplingResult(
+                partition_samples=[
+                    MetricSample(PartitionEntity(0, p), t,
+                                 np.arange(4, dtype=np.float32) + p + w)
+                    for p in range(5)
+                ],
+                broker_samples=[
+                    MetricSample(BrokerEntity(b), t,
+                                 np.full(4, float(b), np.float32))
+                    for b in range(2)
+                ],
+            ))
+        # "restart": a brand-new store over a brand-new client
+        client2 = KafkaAdminClient(cluster.bootstrap(), timeout_s=5.0)
+        try:
+            fresh = KafkaSampleStore(
+                client2, topic_id_fn={"alpha": 7}.__getitem__,
+            )
+            replayed = fresh.load()
+            assert len(replayed) == 3  # one result per sample time
+            total_p = sum(len(r.partition_samples) for r in replayed)
+            total_b = sum(len(r.broker_samples) for r in replayed)
+            assert total_p == 15 and total_b == 6
+            assert all(
+                s.entity.topic == 7
+                for r in replayed for s in r.partition_samples
+            )
+            s0 = min(
+                (s for r in replayed for s in r.partition_samples),
+                key=lambda s: (s.time_ms, s.entity.partition),
+            )
+            np.testing.assert_allclose(s0.values, [0.0, 1.0, 2.0, 3.0])
+        finally:
+            client2.close()
     finally:
         client.close()
         cluster.stop()
