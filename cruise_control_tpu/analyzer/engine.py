@@ -80,6 +80,10 @@ class OptimizerConfig:
     #: semantics, AnalyzerConfig.java:236 default.intra.broker.goals);
     #: leadership/swap candidates are disabled
     intra_broker: bool = False
+    #: stop annealing once the weighted goal violations (objective minus the
+    #: dispersion tiebreaker) fall to this level — remaining rounds could
+    #: only polish dispersion, which no goal measures.  <0 disables.
+    early_stop_violations: float = 1e-9
 
 
 @partial(
@@ -355,6 +359,8 @@ class Engine:
         self._jit_refresh = jax.jit(self._refresh_impl)
         self._jit_objective = jax.jit(self._objective_impl)
         self._jit_plan = jax.jit(self._plan_impl)
+        self._jit_violations = jax.jit(self._violations_impl)
+        self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
 
     # convenience for call sites that held `engine.state`
     @property
@@ -467,6 +473,37 @@ class Engine:
         terms += self.w.offline * offline.astype(jnp.float32) / sx.n_valid
         terms += self._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
         return terms
+
+    def _cheap_violations_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """O(B) lower-bound signal: delta-decomposed objective minus the
+        dispersion tiebreaker.  Misses goals folded into candidate deltas
+        only (topic distribution), so it can read zero with work left —
+        used as a gate for the authoritative check below."""
+        g = self._globals(sx, carry)
+        return self.carry_objective(sx, carry) - self._tie_term(
+            sx, g["pct_sum"], g["pct_sumsq"]
+        )
+
+    def _violations_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """Authoritative early-stop signal: the WORST per-goal violation
+        from the full goal chain — evaluated against the carry's incremental
+        aggregates, so no O(R) segment-sums are recomputed."""
+        from cruise_control_tpu.models.aggregates import BrokerAggregates
+
+        agg = BrokerAggregates(
+            broker_load=carry.broker_load,
+            broker_replica_count=carry.broker_replica_count,
+            broker_leader_count=carry.broker_leader_count,
+            broker_potential_nw_out=carry.broker_potential_nw_out,
+            broker_leader_bytes_in=carry.broker_leader_bytes_in,
+            broker_topic_count=carry.broker_topic_count,
+            part_rack_count=carry.part_rack_count,
+            disk_load=carry.disk_load,
+        )
+        _, viol, _ = self.chain.evaluate(
+            self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint
+        )
+        return jnp.max(viol)
 
     def _plan_impl(self, sx: EngineStatics, carry: EngineCarry) -> SamplingPlan:
         """Importance-sampling + movement-pricing plan from current aggregates."""
@@ -1494,6 +1531,11 @@ class Engine:
 
         t0_obj = float(self._jit_objective(sx, carry)) * cfg.init_temperature_scale
         history = []
+        # the authoritative (full-chain) early-stop check is bounded: when
+        # the cheap gate opens but goals folded into candidate deltas (topic
+        # dist) still have work, re-checking every round would cost more
+        # than it saves
+        full_checks_left = 2
         for rnd in range(cfg.num_rounds):
             if rnd == cfg.num_rounds - 1:
                 t_round = 0.0
@@ -1508,4 +1550,18 @@ class Engine:
             history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
             if verbose:
                 history[-1]["objective"] = float(self._jit_objective(sx, carry))
+            # early stop: all goals already satisfied.  The O(B) lower bound
+            # gates the authoritative full-chain check so healthy rounds pay
+            # ~nothing.
+            if (
+                cfg.early_stop_violations >= 0.0
+                and rnd < cfg.num_rounds - 1
+                and full_checks_left > 0
+                and float(self._jit_cheap_violations(sx, carry))
+                <= cfg.early_stop_violations
+            ):
+                if float(self._jit_violations(sx, carry)) <= cfg.early_stop_violations:
+                    history[-1]["early_stop"] = True
+                    break
+                full_checks_left -= 1
         return self.carry_to_state(carry), history

@@ -9,6 +9,7 @@ Two orthogonal axes over a `jax.sharding.Mesh` (SURVEY §2.6):
     parallelism").
 """
 
+from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
 from cruise_control_tpu.parallel.portfolio import default_mesh, portfolio_run
 from cruise_control_tpu.parallel.sharded import (
     MODEL_AXIS,
@@ -18,10 +19,12 @@ from cruise_control_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "GridEngine",
     "MODEL_AXIS",
     "ShardedEngine",
     "build_layout",
     "default_mesh",
+    "grid_mesh",
     "model_mesh",
     "portfolio_run",
 ]

@@ -1,0 +1,168 @@
+"""2D-mesh optimization: restart portfolio OVER model-sharded chains.
+
+Composes the two parallel axes (SURVEY §2.6/§7 M6) the way a training
+stack composes data and model parallelism:
+
+  mesh ("restart", "model"): each restart group runs ONE independent
+  annealing chain whose cluster model is sharded across the "model" axis
+  (parallel/sharded.py semantics — all_gather'd candidates, psum'd
+  refresh, collectives scoped to "model" so chains never interact); the
+  best chain is selected at the end by comparing per-chain objectives.
+
+For a v5e-16 slice this means e.g. Mesh(4, 4): 4 restarts × 4-way model
+shards — candidate throughput AND HBM capacity scale together.  The
+statics (cluster data) are sharded over "model" and replicated over
+"restart": each model shard is stored once per restart group, never per
+device pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cruise_control_tpu.analyzer.engine import OptimizerConfig
+from cruise_control_tpu.analyzer.objective import GoalChain
+from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.parallel.portfolio import RESTART_AXIS
+from cruise_control_tpu.parallel.sharded import (
+    MODEL_AXIS,
+    ShardedEngine,
+    _restack,
+    _shard_map,
+    _unstack,
+)
+
+
+def grid_mesh(n_restarts: int, n_shards: int, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if devices.size < n_restarts * n_shards:
+        raise ValueError(
+            f"{devices.size} devices < {n_restarts}x{n_shards} grid"
+        )
+    grid = devices[: n_restarts * n_shards].reshape(n_restarts, n_shards)
+    return Mesh(grid, (RESTART_AXIS, MODEL_AXIS))
+
+
+class GridEngine(ShardedEngine):
+    """ShardedEngine whose carry carries an extra leading restart axis.
+
+    The traced per-shard bodies are inherited unchanged — their collectives
+    name MODEL_AXIS explicitly, so under the 2D mesh each restart group is
+    an isolated chain; only the block (un)stacking and the final winner
+    selection differ.
+    """
+
+    def __init__(
+        self,
+        state: ClusterState,
+        chain: GoalChain,
+        mesh: Mesh,
+        constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        config: OptimizerConfig = OptimizerConfig(),
+    ):
+        if tuple(mesh.axis_names) != (RESTART_AXIS, MODEL_AXIS):
+            raise ValueError(
+                f"grid mesh must have axes ({RESTART_AXIS!r}, {MODEL_AXIS!r})"
+            )
+        self.n_restarts = int(mesh.shape[RESTART_AXIS])
+        super().__init__(
+            state, chain, mesh=mesh, constraint=constraint, options=options,
+            config=config,
+        )
+
+    # ---- spec/stacking overrides: carry leaves are [r, m, ...] ----
+
+    def _build_jits(self):
+        spec_sx = P(MODEL_AXIS)     # statics: sharded by model, replicated
+        spec_c = P(RESTART_AXIS, MODEL_AXIS)  # per-chain, per-shard carry
+        self._jit_init = jax.jit(
+            _shard_map(self._init_fn, self.mesh,
+                       in_specs=(spec_sx, spec_c), out_specs=spec_c)
+        )
+        self._jit_round = jax.jit(
+            _shard_map(self._round_fn, self.mesh,
+                       in_specs=(spec_sx, spec_c, P()),
+                       out_specs=(spec_c, spec_c))
+        )
+        self._jit_obj = jax.jit(
+            _shard_map(self._obj_fn, self.mesh,
+                       in_specs=(spec_sx, spec_c), out_specs=spec_c)
+        )
+
+    def _unstack_carry(self, blk):
+        return jax.tree.map(lambda x: x[0, 0], blk)
+
+    def _restack_carry(self, tree):
+        return jax.tree.map(lambda x: x[None, None], tree)
+
+    # ---- traced entry points (blocks: sx [1,...], carry [1,1,...]) ----
+
+    def _init_fn(self, sx_blk, keys_blk):
+        sx = _unstack(sx_blk)
+        key = keys_blk[0, 0]
+        carry = self._zero_carry(sx, key)
+        return self._restack_carry(self._sharded_refresh(sx, carry))
+
+    def _round_fn(self, sx_blk, carry_blk, temps):
+        sx = _unstack(sx_blk)
+        carry = self._unstack_carry(carry_blk)
+        carry, stats = self._run_round(sx, carry, temps)
+        return self._restack_carry(carry), jax.tree.map(
+            lambda x: x[None, None], stats
+        )
+
+    def _obj_fn(self, sx_blk, carry_blk):
+        obj = self._sharded_objective(_unstack(sx_blk), self._unstack_carry(carry_blk))
+        return obj[None, None]
+
+    def objective(self, carry) -> float:
+        """Best chain's objective (the inherited accessor assumes a 1D
+        model-only mesh)."""
+        return float(np.asarray(self._jit_obj(self.statics, carry))[:, 0].min())
+
+    # ---- host-side driver ----
+
+    def run(self, *, verbose: bool = False):
+        cfg = self.engine.config
+        keys = jax.random.split(
+            jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n
+        ).reshape(self.n_restarts, self.n, 2)
+        carry = self._jit_init(self.statics, keys)
+        objs0 = np.asarray(self._jit_obj(self.statics, carry))
+        t0_obj = float(objs0[0, 0]) * cfg.init_temperature_scale
+        history = []
+        for rnd in range(cfg.num_rounds):
+            t_round = (
+                0.0 if rnd == cfg.num_rounds - 1
+                else t0_obj * (cfg.temperature_decay**rnd)
+            )
+            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
+            carry, stats = self._jit_round(self.statics, carry, temps)
+            rec = dict(
+                round=rnd, temperature=t_round,
+                # per-chain counts: the stat is replicated across the model
+                # axis (computed from the all-gathered candidate set), so
+                # take shard column 0 of each chain
+                accepted=int(np.asarray(stats["accepted"])[:, 0].sum()),
+            )
+            if verbose:
+                rec["objectives"] = np.asarray(
+                    self._jit_obj(self.statics, carry)
+                )[:, 0].tolist()
+            history.append(rec)
+        # winner: best chain by final objective (identical across the model
+        # axis of a chain — take column 0)
+        objs = np.asarray(self._jit_obj(self.statics, carry))[:, 0]
+        winner = int(np.argmin(objs))
+        win_carry = jax.tree.map(lambda x: x[winner], carry)
+        state = self.final_state(win_carry)
+        return state, {
+            "objectives": objs, "winner": winner, "history": history,
+            "n_chains": self.n_restarts, "n_shards": self.n,
+        }

@@ -188,7 +188,9 @@ class ShardedEngine:
         config: OptimizerConfig = OptimizerConfig(),
     ):
         self.mesh = mesh if mesh is not None else model_mesh()
-        self.n = int(self.mesh.devices.size)
+        # number of MODEL shards — on a 2D (restart, model) mesh this is the
+        # model-axis extent, not the device count
+        self.n = int(self.mesh.shape[MODEL_AXIS])
         self.global_state = state
         self.layout = build_layout(state, self.n)
         self.P_total = self.layout.P_local * self.n
@@ -212,6 +214,9 @@ class ShardedEngine:
             statics_list.append(sx)
         self.statics = _tree_stack(statics_list)
 
+        self._build_jits()
+
+    def _build_jits(self):
         spec_in = P(MODEL_AXIS)
         self._jit_init = jax.jit(
             _shard_map(
@@ -347,13 +352,11 @@ class ShardedEngine:
 
     # ---- shard_map entry points (blocks have a leading axis of 1) ----
 
-    def _init_fn(self, sx_blk, keys_blk):
-        sx = _unstack(sx_blk)
-        key = keys_blk[0]
+    def _zero_carry(self, sx, key) -> EngineCarry:
         eng = self.engine
         st = sx.state
         B = eng.shape.B
-        carry = EngineCarry(
+        return EngineCarry(
             replica_broker=st.replica_broker,
             replica_is_leader=st.replica_is_leader,
             replica_disk=st.replica_disk,
@@ -370,11 +373,9 @@ class ShardedEngine:
             host_load=jnp.zeros((eng.shape.num_hosts, NUM_RESOURCES), jnp.float32),
             key=key,
         )
-        return _restack(self._sharded_refresh(sx, carry))
 
-    def _round_fn(self, sx_blk, carry_blk, temps):
-        sx = _unstack(sx_blk)
-        carry = _unstack(carry_blk)
+    def _run_round(self, sx, carry: EngineCarry, temps):
+        """One annealing round on local blocks: plan + scan + refresh."""
         eng = self.engine
         plan = eng._plan_impl(sx, carry)
         # reprice movement against the GLOBAL objective (the local plan's
@@ -390,7 +391,16 @@ class ShardedEngine:
             return self._sharded_step(sx, c, t, plan)
 
         carry, stats = jax.lax.scan(body, carry, temps)
-        carry = self._sharded_refresh(sx, carry)
+        return self._sharded_refresh(sx, carry), stats
+
+    def _init_fn(self, sx_blk, keys_blk):
+        sx = _unstack(sx_blk)
+        carry = self._zero_carry(sx, keys_blk[0])
+        return _restack(self._sharded_refresh(sx, carry))
+
+    def _round_fn(self, sx_blk, carry_blk, temps):
+        sx = _unstack(sx_blk)
+        carry, stats = self._run_round(sx, _unstack(carry_blk), temps)
         return _restack(carry), jax.tree.map(lambda x: x[None], stats)
 
     def _obj_fn(self, sx_blk, carry_blk):

@@ -303,18 +303,34 @@ class CruiseControl:
         removed: set[int] | None = None,
         demoted: set[int] | None = None,
         extra_proposals: list[ExecutionProposal] | None = None,
+        execution_overrides: dict | None = None,
     ) -> dict:
+        """execution_overrides: per-request values for the concurrency caps
+        and throttle (reference request-level parameters,
+        servlet/parameters/ParameterUtils.java: concurrent_partition_
+        movements_per_broker, concurrent_leader_movements,
+        replication_throttle)."""
         progress.add_step(ExecutingProposals())
+        ov = execution_overrides or {}
         proposals = list(result.proposals) + list(extra_proposals or [])
+        def _ov(name, default_key):
+            v = ov.get(name)
+            return v if v is not None else self.config.get(default_key)
+
         exec_options = ExecutionOptions(
-            concurrent_partition_movements_per_broker=self.config.get(
-                "num.concurrent.partition.movements.per.broker"
+            concurrent_partition_movements_per_broker=_ov(
+                "concurrent_partition_movements_per_broker",
+                "num.concurrent.partition.movements.per.broker",
             ),
             concurrent_intra_broker_partition_movements=self.config.get(
                 "num.concurrent.intra.broker.partition.movements"
             ),
-            concurrent_leader_movements=self.config.get("num.concurrent.leader.movements"),
-            replication_throttle_bytes_per_s=self.config.get("default.replication.throttle"),
+            concurrent_leader_movements=_ov(
+                "concurrent_leader_movements", "num.concurrent.leader.movements"
+            ),
+            replication_throttle_bytes_per_s=_ov(
+                "replication_throttle", "default.replication.throttle"
+            ),
             progress_check_interval_s=self.config.get(
                 "execution.progress.check.interval.ms"
             )
@@ -371,6 +387,7 @@ class CruiseControl:
         destination_broker_ids: list[int] | None = None,
         excluded_topics_pattern: str | None = None,
         rebalance_disk: bool = False,
+        execution_overrides: dict | None = None,
     ) -> dict:
         """Reference RebalanceRunnable.workWithoutClusterModel:116.
 
@@ -414,18 +431,21 @@ class CruiseControl:
         out = result.summary()
         out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
-            out["execution"] = self._execute(result, progress)
+            out["execution"] = self._execute(
+                result, progress, execution_overrides=execution_overrides
+            )
         return out
 
     def add_brokers(self, progress: OperationProgress, broker_ids: list[int], *,
-                    dryrun: bool = True) -> dict:
+                    dryrun: bool = True, execution_overrides: dict | None = None) -> dict:
         """Reference AddBrokersRunnable: only move replicas TO the new brokers."""
         return self.rebalance(
-            progress, dryrun=dryrun, destination_broker_ids=broker_ids
+            progress, dryrun=dryrun, destination_broker_ids=broker_ids,
+            execution_overrides=execution_overrides,
         )
 
     def remove_brokers(self, progress: OperationProgress, broker_ids: list[int], *,
-                       dryrun: bool = True) -> dict:
+                       dryrun: bool = True, execution_overrides: dict | None = None) -> dict:
         """Reference RemoveBrokersRunnable: evacuate the given brokers."""
         state = self._cluster_model(progress)
         state = _mark_brokers_dead(state, broker_ids)
@@ -440,7 +460,8 @@ class CruiseControl:
         out = result.summary()
         if not dryrun:
             out["execution"] = self._execute(
-                result, progress, removed=set(broker_ids)
+                result, progress, removed=set(broker_ids),
+                execution_overrides=execution_overrides,
             )
         return out
 

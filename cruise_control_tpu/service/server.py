@@ -50,6 +50,28 @@ def _parse_bool(params: dict, name: str, default: bool) -> bool:
     return params[name][0].lower() in ("true", "1", "yes")
 
 
+def _parse_execution_overrides(params: dict) -> dict:
+    """Per-request execution knobs (reference ParameterUtils: concurrency
+    caps + replication_throttle request parameters)."""
+    out = {}
+    for name, cast, lo in (
+        ("concurrent_partition_movements_per_broker", int, 1),
+        ("concurrent_leader_movements", int, 1),
+        ("replication_throttle", float, 1),
+    ):
+        if name in params:
+            try:
+                v = cast(params[name][0])
+            except ValueError as e:
+                raise BadRequest(f"bad {name}: {e}") from e
+            if v < lo:
+                # a zero/negative cap would stall the executor loop forever;
+                # reject loudly rather than hang the user task
+                raise BadRequest(f"{name} must be >= {lo}, got {v}")
+            out[name] = v
+    return out
+
+
 def _parse_int_list(params: dict, name: str) -> list[int]:
     if name not in params:
         raise BadRequest(f"missing parameter {name}")
@@ -344,6 +366,7 @@ class CruiseControlApp:
         goals = params.get("goals", [None])[0]
         dests = params.get("destination_broker_ids", [None])[0]
         excluded = params.get("excluded_topics", [None])[0]
+        overrides = _parse_execution_overrides(params)
 
         def op(progress):
             return self.cc.rebalance(
@@ -353,6 +376,7 @@ class CruiseControlApp:
                 destination_broker_ids=[int(x) for x in dests.split(",")] if dests else None,
                 excluded_topics_pattern=excluded,
                 rebalance_disk=rebalance_disk,
+                execution_overrides=overrides,
             )
 
         return self._async_op("rebalance", op)
@@ -360,16 +384,23 @@ class CruiseControlApp:
     def _ep_add_broker(self, params) -> tuple[int, dict]:
         ids = _parse_int_list(params, "brokerid")
         dryrun = _parse_bool(params, "dryrun", True)
+        overrides = _parse_execution_overrides(params)
         return self._async_op(
-            "add_broker", lambda progress: self.cc.add_brokers(progress, ids, dryrun=dryrun)
+            "add_broker",
+            lambda progress: self.cc.add_brokers(
+                progress, ids, dryrun=dryrun, execution_overrides=overrides
+            ),
         )
 
     def _ep_remove_broker(self, params) -> tuple[int, dict]:
         ids = _parse_int_list(params, "brokerid")
         dryrun = _parse_bool(params, "dryrun", True)
+        overrides = _parse_execution_overrides(params)
         return self._async_op(
             "remove_broker",
-            lambda progress: self.cc.remove_brokers(progress, ids, dryrun=dryrun),
+            lambda progress: self.cc.remove_brokers(
+                progress, ids, dryrun=dryrun, execution_overrides=overrides
+            ),
         )
 
     def _ep_demote_broker(self, params) -> tuple[int, dict]:

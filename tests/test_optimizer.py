@@ -234,3 +234,20 @@ def test_intra_broker_disk_rebalance():
     assert any(p.disk_moves for p in res.proposals)
     for p in res.proposals:
         assert sorted(p.old_replicas) == sorted(p.new_replicas)
+
+
+def test_early_stop_breaks_when_goals_satisfied():
+    """A cluster whose goals are all satisfiable quickly must not burn the
+    full round budget (OptimizerConfig.early_stop_violations)."""
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=6, num_partitions=60, skew=0.3), seed=3
+    )
+    cfg = dataclasses.replace(FAST, num_rounds=12, seed=5)
+    eng = Engine(state, DEFAULT_CHAIN, config=cfg)
+    final, history = eng.run()
+    validate(final)
+    _, viol, _ = DEFAULT_CHAIN.evaluate(final)
+    if any(h.get("early_stop") for h in history):
+        # the early exit must only fire with every goal truly satisfied
+        assert float(np.max(np.asarray(viol))) <= 1e-6
+        assert len(history) < 12

@@ -509,3 +509,48 @@ def test_slack_notifier_posts_webhook():
         self_healing={AnomalyType.GOAL_VIOLATION: True},
     )
     assert n2.on_anomaly(anomaly).action == Action.FIX
+
+
+def test_execution_overrides_reach_executor():
+    """Per-request caps/throttle (reference ParameterUtils request params)
+    must override the config-level defaults in ExecutionOptions."""
+    from cruise_control_tpu.service.server import _parse_execution_overrides
+
+    ov = _parse_execution_overrides({
+        "concurrent_partition_movements_per_broker": ["9"],
+        "concurrent_leader_movements": ["77"],
+        "replication_throttle": ["12345"],
+    })
+    assert ov == {
+        "concurrent_partition_movements_per_broker": 9,
+        "concurrent_leader_movements": 77,
+        "replication_throttle": 12345.0,
+    }
+    with pytest.raises(Exception):
+        _parse_execution_overrides({"concurrent_leader_movements": ["xyz"]})
+
+    app, fetcher, admin, sampler = build_simulated_service(seed=21)
+    captured = {}
+    real = app.cc.executor.execute_proposals
+
+    def spy(proposals, options=None, **kw):
+        captured["options"] = options
+        return real(proposals, options, **kw)
+
+    app.cc.executor.execute_proposals = spy
+    try:
+        out = app.cc.rebalance(
+            OperationProgress(), dryrun=False,
+            execution_overrides={
+                "concurrent_partition_movements_per_broker": 9,
+                "concurrent_leader_movements": 77,
+                "replication_throttle": 12345.0,
+            },
+        )
+        if "execution" in out:  # moves existed -> executor ran
+            opts = captured["options"]
+            assert opts.concurrent_partition_movements_per_broker == 9
+            assert opts.concurrent_leader_movements == 77
+            assert opts.replication_throttle_bytes_per_s == 12345.0
+    finally:
+        app.stop()

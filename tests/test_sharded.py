@@ -139,3 +139,23 @@ def test_sharded_tracks_single_device_quality():
     gain_sharded = float(obj0 - obj_sharded)
     assert gain_single > 0 and gain_sharded > 0
     assert gain_sharded >= 0.8 * gain_single
+
+
+def test_grid_engine_2d_mesh():
+    """Restart portfolio OVER model-sharded chains on a 2x4 mesh: chains
+    are isolated (different final objectives), winner validates and
+    improves the cluster."""
+    from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
+
+    state = _state(seed=41, brokers=10, parts=128)
+    mesh = grid_mesh(2, 4, jax.devices()[:8])
+    ge = GridEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
+    final, info = ge.run(verbose=True)
+    assert info["n_chains"] == 2 and info["n_shards"] == 4
+    assert len(info["objectives"]) == 2
+    validate(final)
+    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
+    obj1, _, _ = DEFAULT_CHAIN.evaluate(final)
+    assert float(obj1) < float(obj0)
+    # winner must be the argmin chain
+    assert info["winner"] == int(np.argmin(info["objectives"]))
