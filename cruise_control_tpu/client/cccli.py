@@ -110,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-a", "--socket-address", default="http://127.0.0.1:9090",
                    help="host:port of the cruise-control server")
     p.add_argument("--prefix", default="/kafkacruisecontrol")
+    p.add_argument("-u", "--user", default=None, metavar="USER:PASSWORD",
+                   help="basic-auth credentials (reference BasicSecurityProvider)")
+    p.add_argument("--token", default=None,
+                   help="JWT bearer token (reference JwtSecurityProvider)")
+    p.add_argument("--insecure", action="store_true",
+                   help="skip TLS certificate verification (self-signed servers)")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--json-indent", type=int, default=2)
@@ -126,21 +132,39 @@ def build_parser() -> argparse.ArgumentParser:
 class Client:
     """HTTP session with the async 202 poll loop (reference Responder.py)."""
 
-    def __init__(self, base: str, prefix: str, *, poll_interval=1.0, timeout=600.0):
+    def __init__(self, base: str, prefix: str, *, poll_interval=1.0, timeout=600.0,
+                 user: str | None = None, token: str | None = None,
+                 insecure: bool = False):
         if not base.startswith("http"):
             base = "http://" + base
         self.base = base.rstrip("/") + prefix
         self.poll_interval = poll_interval
         self.timeout = timeout
+        self._auth: dict[str, str] = {}
+        if token:
+            self._auth["Authorization"] = f"Bearer {token}"
+        elif user:
+            import base64
+
+            self._auth["Authorization"] = (
+                "Basic " + base64.b64encode(user.encode()).decode()
+            )
+        self._ssl_ctx = None
+        if insecure:
+            import ssl
+
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
 
     def request(self, method: str, endpoint: str, params: dict) -> dict:
         query = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
         url = f"{self.base}/{endpoint}" + (f"?{query}" if query else "")
-        headers: dict[str, str] = {}
+        headers: dict[str, str] = dict(self._auth)
         deadline = time.time() + self.timeout
         while True:
             req = urllib.request.Request(url, method=method, headers=headers)
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(req, timeout=60, context=self._ssl_ctx) as resp:
                 payload = json.loads(resp.read())
                 if resp.status != 202:
                     return payload
@@ -164,7 +188,8 @@ def main(argv=None) -> int:
         for _, (param, _t) in spec["params"].items()
     }
     client = Client(args.socket_address, args.prefix,
-                    poll_interval=args.poll_interval, timeout=args.timeout)
+                    poll_interval=args.poll_interval, timeout=args.timeout,
+                    user=args.user, token=args.token, insecure=args.insecure)
     try:
         result = client.request(spec["method"], spec["endpoint"], params)
     except urllib.error.HTTPError as e:

@@ -17,6 +17,7 @@ I/O; no framework dependency is warranted.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import urllib.parse
 from concurrent.futures import TimeoutError as FutureTimeout
@@ -44,6 +45,13 @@ class BadRequest(ValueError):
     pass
 
 
+#: operation audit trail (reference OPERATION_LOGGER, executor/Executor.java:74,
+#: detector/AnomalyDetector.java:56): one line per REST operation with the
+#: authenticated principal and outcome.  Route to a file via standard logging
+#: config (`logging.getLogger("cruisecontrol.operations")`).
+OPERATION_LOGGER = logging.getLogger("cruisecontrol.operations")
+
+
 def _parse_bool(params: dict, name: str, default: bool) -> bool:
     if name not in params:
         return default
@@ -64,7 +72,7 @@ def _parse_execution_overrides(params: dict) -> dict:
                 v = cast(params[name][0])
             except ValueError as e:
                 raise BadRequest(f"bad {name}: {e}") from e
-            if v < lo:
+            if not v >= lo:  # also rejects NaN (NaN comparisons are False)
                 # a zero/negative cap would stall the executor loop forever;
                 # reject loudly rather than hang the user task
                 raise BadRequest(f"{name} must be >= {lo}, got {v}")
@@ -499,6 +507,11 @@ class CruiseControlApp:
                     params.update(urllib.parse.parse_qs(body))
                 auth = app.security.authenticate(self.headers)
                 if auth is None:
+                    # denied attempts are the most security-relevant audit
+                    # entries — log them too
+                    OPERATION_LOGGER.info(
+                        "%s %s by <unauthenticated> -> 401", method, endpoint
+                    )
                     body = json.dumps({"errorMessage": "authentication required"}).encode()
                     self.send_response(401)
                     self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
@@ -509,6 +522,9 @@ class CruiseControlApp:
                     return
                 principal, role = auth
                 if not app.security.authorize(role, method, endpoint):
+                    OPERATION_LOGGER.info(
+                        "%s %s by %s(%s) -> 403", method, endpoint, principal, role
+                    )
                     self._send(403, {
                         "errorMessage": f"role {role} of {principal} may not {method} {endpoint}"
                     })
@@ -521,6 +537,10 @@ class CruiseControlApp:
                     status, payload = 404, {"errorMessage": f"not found: {e}"}
                 except Exception as e:  # noqa: BLE001
                     status, payload = 500, {"errorMessage": repr(e)}
+                OPERATION_LOGGER.info(
+                    "%s %s by %s(%s) -> %d",
+                    method, endpoint, principal, role, status,
+                )
                 self._send(status, payload)
 
             def _send(self, status: int, payload: dict):

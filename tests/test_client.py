@@ -60,3 +60,42 @@ def test_cli_error_reporting(service, capsys):
                           "--topic", "NoSuchTopic", "--replication-factor", "3")
     assert rc == 0  # unknown topic -> zero proposals, not an error
     assert payload["numProposals"] == 0
+
+
+def test_cli_basic_auth(tmp_path, capsys):
+    """-u user:password sends the Authorization header the server's
+    BasicSecurityProvider expects (reference cccli auth flags)."""
+    from cruise_control_tpu.config import CruiseControlConfig
+
+    creds = tmp_path / "credentials"
+    creds.write_text("admin:secret:ADMIN\nviewer:ro:VIEWER\n")
+    config = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "min.samples.per.partition.metrics.window": 1,
+        "execution.progress.check.interval.ms": 100,
+        "webserver.http.port": 0,
+        "webserver.security.enable": "true",
+        "basic.auth.credentials.file": str(creds),
+        "tpu.num.candidates": 64, "tpu.leadership.candidates": 16,
+        "tpu.steps.per.round": 8, "tpu.num.rounds": 2,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=8)
+    app.start()
+    try:
+        addr = f"http://{app.host}:{app.port}"
+        # no credentials -> 401 -> nonzero exit with the error payload
+        rc = main(["-a", addr, "state"])
+        capsys.readouterr()
+        assert rc == 1
+        rc = main(["-a", addr, "-u", "admin:secret", "state"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and "MonitorState" in out
+        # VIEWER may GET but not POST
+        rc = main(["-a", addr, "-u", "viewer:ro", "state"])
+        capsys.readouterr()
+        assert rc == 0
+        rc = main(["-a", addr, "-u", "viewer:ro", "pause_sampling"])
+        err = json.loads(capsys.readouterr().out)
+        assert rc == 1 and "errorMessage" in err
+    finally:
+        app.stop()

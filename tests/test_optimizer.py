@@ -237,8 +237,9 @@ def test_intra_broker_disk_rebalance():
 
 
 def test_early_stop_breaks_when_goals_satisfied():
-    """A cluster whose goals are all satisfiable quickly must not burn the
-    full round budget (OptimizerConfig.early_stop_violations)."""
+    """A run starting from an already-satisfied cluster MUST early-stop
+    (OptimizerConfig.early_stop_violations), and the exit must only ever
+    fire with every goal truly satisfied."""
     state = random_cluster(
         RandomClusterSpec(num_brokers=6, num_partitions=60, skew=0.3), seed=3
     )
@@ -248,6 +249,13 @@ def test_early_stop_breaks_when_goals_satisfied():
     validate(final)
     _, viol, _ = DEFAULT_CHAIN.evaluate(final)
     if any(h.get("early_stop") for h in history):
-        # the early exit must only fire with every goal truly satisfied
         assert float(np.max(np.asarray(viol))) <= 1e-6
         assert len(history) < 12
+    if float(np.max(np.asarray(viol))) <= 1e-9:
+        # second run from the satisfied state: the stop is GUARANTEED on
+        # an early round (this pins the feature against regressions that
+        # silently disable the gate)
+        eng2 = Engine(final, DEFAULT_CHAIN, config=cfg)
+        _, history2 = eng2.run()
+        assert any(h.get("early_stop") for h in history2)
+        assert len(history2) < 12

@@ -554,3 +554,15 @@ def test_execution_overrides_reach_executor():
             assert opts.replication_throttle_bytes_per_s == 12345.0
     finally:
         app.stop()
+
+
+def test_operation_audit_log(service, caplog):
+    """Every REST operation lands one line in the operations audit logger
+    (reference OPERATION_LOGGER)."""
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="cruisecontrol.operations"):
+        _request(service, "GET", "state")
+    recs = [r for r in caplog.records if r.name == "cruisecontrol.operations"]
+    assert recs and "GET state" in recs[-1].getMessage()
+    assert "-> 200" in recs[-1].getMessage()
