@@ -163,6 +163,29 @@ class KafkaAdminClient:
     def api_versions(self) -> dict:
         return self._any_conn().request(proto.API_VERSIONS, {})
 
+    def check_api_support(self) -> None:
+        """Verify the broker supports every (api, version) this client pins
+        (one fixed version per API — see protocol.py).  Raises with the
+        full unsupported list, which beats per-operation decode failures
+        against an old broker."""
+        resp = self.api_versions()
+        if resp["error_code"] != NONE:
+            raise KafkaProtocolError("ApiVersions", resp["error_code"])
+        ranges = {
+            a["api_key"]: (a["min_version"], a["max_version"])
+            for a in resp["api_keys"] or []
+        }
+        missing = []
+        for api in proto.ALL_APIS:
+            lo_hi = ranges.get(api.key)
+            if lo_hi is None or not (lo_hi[0] <= api.version <= lo_hi[1]):
+                missing.append(f"{api.name} v{api.version} (broker has {lo_hi})")
+        if missing:
+            raise KafkaProtocolError(
+                "ApiVersions", 35,  # UNSUPPORTED_VERSION
+                "broker lacks required APIs: " + ", ".join(missing),
+            )
+
     def _controller_conn(self) -> BrokerConnection:
         with self._route_lock:
             cid = self._controller_id

@@ -133,6 +133,9 @@ def build_kafka_service(
             raise ValueError(f"malformed bootstrap server {hp!r}")
         seeds.append((host or "127.0.0.1", int(port)))
     client = KafkaAdminClient(seeds, client_id=client_id)
+    # fail fast with the full list of unsupported APIs rather than on the
+    # first mid-operation decode error against an old broker
+    client.check_api_support()
     metadata = KafkaMetadataProvider(client)
     admin = KafkaClusterAdmin(client)
     app, fetcher = build_service(

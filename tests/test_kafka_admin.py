@@ -389,3 +389,30 @@ def test_connection_retries_after_idle_close():
         assert len(topo.brokers) == 3
     finally:
         h.close()
+
+
+def test_api_version_negotiation():
+    """check_api_support passes against the fake broker (which advertises
+    exactly our pinned versions) and raises clearly when an API is absent."""
+    h = _KafkaHarness()
+    try:
+        h.client.check_api_support()  # must not raise
+
+        # simulate an older broker missing AlterPartitionReassignments
+        real = h.client.api_versions
+
+        def degraded():
+            resp = real()
+            resp["api_keys"] = [
+                a for a in resp["api_keys"] if a["api_key"] != 45
+            ]
+            return resp
+
+        h.client.api_versions = degraded
+        from cruise_control_tpu.kafka import KafkaProtocolError
+
+        with pytest.raises(KafkaProtocolError) as e:
+            h.client.check_api_support()
+        assert "AlterPartitionReassignments" in str(e.value)
+    finally:
+        h.close()

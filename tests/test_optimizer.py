@@ -259,3 +259,65 @@ def test_early_stop_breaks_when_goals_satisfied():
         _, history2 = eng2.run()
         assert any(h.get("early_stop") for h in history2)
         assert len(history2) < 12
+
+
+def test_goal_order_permutations():
+    """Reference RandomGoalTest shuffles goal priority order.  Here goal
+    priority is encoded as rank-decayed weights, so the WEIGHTED objective
+    legitimately depends on order — but each goal's raw violation is a pure
+    function of state and must be identical under any permutation, and
+    hard goals must outweigh any soft goal regardless of position."""
+    names = [
+        "RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal",
+        "CpuUsageDistributionGoal", "LeaderReplicaDistributionGoal",
+    ]
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=8, num_partitions=120, skew=1.2), seed=11
+    )
+    from cruise_control_tpu.analyzer.objective import GoalChain
+
+    rng = np.random.default_rng(4)
+    base = None
+    for _ in range(3):
+        order = list(rng.permutation(names))
+        chain = GoalChain.from_names(order)
+        _, viol, _ = chain.evaluate(state)
+        key = dict(zip(chain.names(), np.asarray(viol).tolist()))
+        if base is None:
+            base = key
+        else:
+            for n in names:
+                assert abs(key[n] - base[n]) < 1e-6
+        # hard goals keep their boost wherever they land in the order
+        w = dict(zip(chain.names(), chain.weights))
+        soft_max = max(v for n, v in w.items()
+                       if n in ("ReplicaDistributionGoal",
+                                "CpuUsageDistributionGoal",
+                                "LeaderReplicaDistributionGoal"))
+        assert w["RackAwareGoal"] > soft_max
+        assert w["DiskCapacityGoal"] > soft_max
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29])
+def test_random_self_healing(seed):
+    """Reference RandomSelfHealingTest: random clusters with dead brokers
+    must evacuate them completely (BROKEN_BROKERS) and stay valid."""
+    state = random_cluster(
+        RandomClusterSpec(
+            num_brokers=10, num_partitions=150, skew=0.8, num_dead_brokers=2
+        ),
+        seed=seed,
+    )
+    res = GoalOptimizer(config=FAST).optimize(state)
+    after = res.state_after
+    validate(after)
+    on_dead = (
+        np.asarray(after.replica_valid)
+        & ~np.asarray(after.broker_alive)[np.asarray(after.replica_broker)]
+    )
+    assert not on_dead.any(), f"seed {seed}: replicas remain on dead brokers"
+    # moved replicas may only land on alive brokers
+    moved = (
+        np.asarray(state.replica_broker) != np.asarray(after.replica_broker)
+    ) & np.asarray(state.replica_valid)
+    assert np.asarray(after.broker_alive)[np.asarray(after.replica_broker)[moved]].all()
