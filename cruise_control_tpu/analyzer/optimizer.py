@@ -77,6 +77,19 @@ class OptimizerResult:
         }
 
 
+def _validate_parallel_mode(mode: str) -> str:
+    """"single", "sharded", or "grid:RxM" with positive integer R, M."""
+    if mode in ("single", "sharded"):
+        return mode
+    if mode.startswith("grid:"):
+        r, sep, m = mode[5:].partition("x")
+        if sep and r.isdigit() and m.isdigit() and int(r) > 0 and int(m) > 0:
+            return mode
+    raise ValueError(
+        f"tpu.parallel.mode must be single | sharded | grid:RxM, got {mode!r}"
+    )
+
+
 class GoalOptimizer:
     """Entry point the service layer calls (reference GoalOptimizer.optimizations:416)."""
 
@@ -85,12 +98,29 @@ class GoalOptimizer:
         chain: GoalChain = DEFAULT_CHAIN,
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         config: OptimizerConfig = OptimizerConfig(),
+        parallel_mode: str = "single",
     ):
+        """parallel_mode (config key tpu.parallel.mode): "single" (one
+        device), "sharded" (model sharded over every device,
+        parallel/sharded.py), or "grid:RxM" (restart portfolio over model
+        shards, parallel/grid.py)."""
         import jax
 
         self.chain = chain
         self.constraint = constraint
         self.config = config
+        self.parallel_mode = _validate_parallel_mode(parallel_mode)
+        if self.parallel_mode.startswith("grid:"):
+            r, _, m = self.parallel_mode[5:].partition("x")
+            if len(jax.devices()) < int(r) * int(m):
+                raise ValueError(
+                    f"tpu.parallel.mode={self.parallel_mode!r} needs "
+                    f"{int(r) * int(m)} devices, host has {len(jax.devices())}"
+                )
+        elif self.parallel_mode != "single" and len(jax.devices()) < 2:
+            # single-chip host: sharded degenerates to the local engine
+            self.parallel_mode = "single"
+        self._parallel_engines: dict = {}
         #: engines cached per (ClusterShape, search config) — rebinding data
         #: is free, recompiling is not (reference amortizes the same way via
         #: its proposal precompute loop, GoalOptimizer.java:124-175)
@@ -119,6 +149,42 @@ class GoalOptimizer:
             engine.rebind(state, options)
         return engine
 
+    def _parallel_engine(
+        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
+    ):
+        """Multi-device engine per parallel_mode, cached per (shape, config)
+        with a data rebind like _engine_for — recompiling the sharded
+        programs per request would cost seconds to minutes.  Shard layouts
+        are data-dependent, so a rebind that changes the local shapes falls
+        back to building a fresh engine."""
+        key = (state.shape, config)
+        engine = self._parallel_engines.get(key)
+        if engine is not None:
+            try:
+                return engine.rebind(state, options)
+            except ValueError:
+                pass  # local shard shapes changed: rebuild below
+        engine = self._build_parallel_engine(state, options, config)
+        self._parallel_engines[key] = engine
+        return engine
+
+    def _build_parallel_engine(
+        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
+    ):
+        from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
+        from cruise_control_tpu.parallel.sharded import ShardedEngine, model_mesh
+
+        if self.parallel_mode == "sharded":
+            return ShardedEngine(
+                state, self.chain, mesh=model_mesh(),
+                constraint=self.constraint, options=options, config=config,
+            )
+        r, _, m = self.parallel_mode[5:].partition("x")
+        return GridEngine(
+            state, self.chain, mesh=grid_mesh(int(r), int(m)),
+            constraint=self.constraint, options=options, config=config,
+        )
+
     def optimize(
         self,
         state: ClusterState,
@@ -129,9 +195,15 @@ class GoalOptimizer:
     ) -> OptimizerResult:
         t0 = time.monotonic()
         validate(state)
-        engine = self._engine_for(state, options, config or self.config)
+        cfg = config or self.config
         (obj_b, viol_b), stats_b = self._report(state)
-        final, history = engine.run(verbose=verbose)
+        if self.parallel_mode == "single":
+            engine = self._engine_for(state, options, cfg)
+            final, history = engine.run(verbose=verbose)
+        else:
+            final, history = self._parallel_engine(state, options, cfg).run(
+                verbose=verbose
+            )
         (obj_a, viol_a), stats_a = self._report(final)
         validate(final)
         viol_b = np.asarray(viol_b)

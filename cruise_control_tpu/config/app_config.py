@@ -86,6 +86,20 @@ def _analyzer_defs() -> ConfigDef:
     d.define("tpu.importance.fraction", T.DOUBLE, 0.5, I.LOW,
              "fraction of candidates importance-sampled toward violating brokers",
              in_range(lo=0.0, hi=1.0), group=g)
+    def _valid_parallel_mode(name, value):
+        import re as _re
+
+        if value not in ("single", "sharded") and not _re.fullmatch(
+            r"grid:[1-9]\d*x[1-9]\d*", str(value)
+        ):
+            raise ConfigException(
+                f"{name} must be single / sharded / grid:RxM, got {value!r}"
+            )
+
+    d.define("tpu.parallel.mode", T.STRING, "single", I.MEDIUM,
+             "multi-device strategy: single / sharded (model sharded over "
+             "all devices) / grid:RxM (restart portfolio over model shards)",
+             _valid_parallel_mode, group=g)
     d.define("tpu.compilation.cache.dir", T.STRING,
              "~/.cache/cruise_control_tpu/xla", I.LOW,
              "persistent XLA compilation cache directory; empty disables "
@@ -294,6 +308,9 @@ class CruiseControlConfig(AbstractConfig):
             leadership_move_cost=g("tpu.leadership.move.cost"),
             importance_fraction=g("tpu.importance.fraction"),
         )
+
+    def parallel_mode(self) -> str:
+        return self.get("tpu.parallel.mode")
 
 
 def load_properties(path: str) -> dict[str, str]:

@@ -162,7 +162,9 @@ class GridEngine(ShardedEngine):
         winner = int(np.argmin(objs))
         win_carry = jax.tree.map(lambda x: x[winner], carry)
         state = self.final_state(win_carry)
-        return state, {
-            "objectives": objs, "winner": winner, "history": history,
+        #: per-run diagnostics beyond the uniform (state, history) contract
+        self.last_info = {
+            "objectives": objs, "winner": winner,
             "n_chains": self.n_restarts, "n_shards": self.n,
         }
+        return state, history

@@ -198,6 +198,7 @@ class ShardedEngine:
         self.engine = Engine(
             self.layout.local_states[0], chain, constraint, options, config
         )
+        self._options = options
         n_valid_global = jnp.asarray(
             max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
         )
@@ -215,6 +216,42 @@ class ShardedEngine:
         self.statics = _tree_stack(statics_list)
 
         self._build_jits()
+
+    def rebind(self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS):
+        """Swap in a new model generation without recompiling.
+
+        The shard layout's local shapes (R_local/P_local/max_rf) are
+        data-dependent; when they match the compiled ones the jitted
+        programs are reused, otherwise a ValueError tells the caller to
+        build a fresh engine (mirrors Engine.rebind's shape check)."""
+        lay = build_layout(state, self.n)
+        old = self.layout
+        if (lay.R_local, lay.P_local, lay.max_rf) != (
+            old.R_local, old.P_local, old.max_rf
+        ):
+            raise ValueError(
+                "shard layout changed "
+                f"{(old.R_local, old.P_local, old.max_rf)} -> "
+                f"{(lay.R_local, lay.P_local, lay.max_rf)}; build a new engine"
+            )
+        self.global_state = state
+        self.layout = lay
+        n_valid_global = jnp.asarray(
+            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
+        )
+        statics_list = []
+        for ls in lay.local_states:
+            sx = build_statics(ls, self._options)
+            sx = dataclasses.replace(
+                sx,
+                n_valid=n_valid_global,
+                part_replicas=jnp.asarray(
+                    partition_replica_table(ls, max_rf=lay.max_rf)
+                ),
+            )
+            statics_list.append(sx)
+        self.statics = _tree_stack(statics_list)
+        return self
 
     def _build_jits(self):
         spec_in = P(MODEL_AXIS)

@@ -88,6 +88,7 @@ class CruiseControl:
             chain=self.chain,
             constraint=self.constraint,
             config=config.optimizer_config(),
+            parallel_mode=config.parallel_mode(),
         )
         self.executor = Executor(admin, sensors=self.sensors)
         self._cache: _CachedResult | None = None

@@ -7,6 +7,8 @@ are exchanged with all_gather, refresh psums partial aggregates.
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -150,12 +152,28 @@ def test_grid_engine_2d_mesh():
     state = _state(seed=41, brokers=10, parts=128)
     mesh = grid_mesh(2, 4, jax.devices()[:8])
     ge = GridEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
-    final, info = ge.run(verbose=True)
+    final, history = ge.run(verbose=True)
+    info = ge.last_info
     assert info["n_chains"] == 2 and info["n_shards"] == 4
     assert len(info["objectives"]) == 2
+    assert history and all("accepted" in h for h in history)
     validate(final)
     obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
     obj1, _, _ = DEFAULT_CHAIN.evaluate(final)
     assert float(obj1) < float(obj0)
     # winner must be the argmin chain
     assert info["winner"] == int(np.argmin(info["objectives"]))
+
+
+@pytest.mark.parametrize("mode", ["sharded", "grid:2x4"])
+def test_goal_optimizer_parallel_modes(mode):
+    """tpu.parallel.mode wires the multi-device engines into the PRODUCT
+    optimizer path (GoalOptimizer -> ShardedEngine / GridEngine)."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+
+    state = _state(seed=51, brokers=10, parts=120)
+    opt = GoalOptimizer(config=CFG, parallel_mode=mode)
+    res = opt.optimize(state)
+    validate(res.state_after)
+    assert res.objective_after < res.objective_before
+    assert res.proposals  # a real plan came out of the parallel engine
