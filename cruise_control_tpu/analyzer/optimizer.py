@@ -77,14 +77,17 @@ class OptimizerResult:
         }
 
 
-def _validate_parallel_mode(mode: str) -> str:
-    """"single", "sharded", or "grid:RxM" with positive integer R, M."""
+def parse_parallel_mode(mode: str) -> tuple[int, int] | None:
+    """Validate "single" / "sharded" / "grid:RxM"; returns (R, M) for grid
+    modes, None otherwise.  The single source of truth for the mode syntax
+    (the config validator delegates here)."""
+    import re
+
     if mode in ("single", "sharded"):
-        return mode
-    if mode.startswith("grid:"):
-        r, sep, m = mode[5:].partition("x")
-        if sep and r.isdigit() and m.isdigit() and int(r) > 0 and int(m) > 0:
-            return mode
+        return None
+    m = re.fullmatch(r"grid:([1-9]\d*)x([1-9]\d*)", str(mode))
+    if m:
+        return int(m.group(1)), int(m.group(2))
     raise ValueError(
         f"tpu.parallel.mode must be single | sharded | grid:RxM, got {mode!r}"
     )
@@ -109,13 +112,14 @@ class GoalOptimizer:
         self.chain = chain
         self.constraint = constraint
         self.config = config
-        self.parallel_mode = _validate_parallel_mode(parallel_mode)
-        if self.parallel_mode.startswith("grid:"):
-            r, _, m = self.parallel_mode[5:].partition("x")
-            if len(jax.devices()) < int(r) * int(m):
+        self.parallel_mode = parallel_mode
+        self._grid_shape = parse_parallel_mode(parallel_mode)
+        if self._grid_shape is not None:
+            r, m = self._grid_shape
+            if len(jax.devices()) < r * m:
                 raise ValueError(
                     f"tpu.parallel.mode={self.parallel_mode!r} needs "
-                    f"{int(r) * int(m)} devices, host has {len(jax.devices())}"
+                    f"{r * m} devices, host has {len(jax.devices())}"
                 )
         elif self.parallel_mode != "single" and len(jax.devices()) < 2:
             # single-chip host: sharded degenerates to the local engine
@@ -179,9 +183,9 @@ class GoalOptimizer:
                 state, self.chain, mesh=model_mesh(),
                 constraint=self.constraint, options=options, config=config,
             )
-        r, _, m = self.parallel_mode[5:].partition("x")
+        r, m = self._grid_shape
         return GridEngine(
-            state, self.chain, mesh=grid_mesh(int(r), int(m)),
+            state, self.chain, mesh=grid_mesh(r, m),
             constraint=self.constraint, options=options, config=config,
         )
 

@@ -87,14 +87,12 @@ def _analyzer_defs() -> ConfigDef:
              "fraction of candidates importance-sampled toward violating brokers",
              in_range(lo=0.0, hi=1.0), group=g)
     def _valid_parallel_mode(name, value):
-        import re as _re
+        from cruise_control_tpu.analyzer.optimizer import parse_parallel_mode
 
-        if value not in ("single", "sharded") and not _re.fullmatch(
-            r"grid:[1-9]\d*x[1-9]\d*", str(value)
-        ):
-            raise ConfigException(
-                f"{name} must be single / sharded / grid:RxM, got {value!r}"
-            )
+        try:
+            parse_parallel_mode(str(value))
+        except ValueError as e:
+            raise ConfigException(f"{name}: {e}") from e
 
     d.define("tpu.parallel.mode", T.STRING, "single", I.MEDIUM,
              "multi-device strategy: single / sharded (model sharded over "

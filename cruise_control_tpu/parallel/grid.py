@@ -71,6 +71,8 @@ class GridEngine(ShardedEngine):
                 f"grid mesh must have axes ({RESTART_AXIS!r}, {MODEL_AXIS!r})"
             )
         self.n_restarts = int(mesh.shape[RESTART_AXIS])
+        #: diagnostics of the most recent COMPLETED run (None before/during)
+        self.last_info: dict | None = None
         super().__init__(
             state, chain, mesh=mesh, constraint=constraint, options=options,
             config=config,
@@ -129,6 +131,7 @@ class GridEngine(ShardedEngine):
     # ---- host-side driver ----
 
     def run(self, *, verbose: bool = False):
+        self.last_info = None  # never report a previous run's diagnostics
         cfg = self.engine.config
         keys = jax.random.split(
             jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n

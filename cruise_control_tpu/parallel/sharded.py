@@ -198,24 +198,32 @@ class ShardedEngine:
         self.engine = Engine(
             self.layout.local_states[0], chain, constraint, options, config
         )
+        self._bind(state, self.layout, options)
+        self._build_jits()
+
+    def _bind(self, state: ClusterState, layout: ShardLayout,
+              options: OptimizationOptions) -> None:
+        """Point the engine at a model generation: stacked per-shard statics
+        from `layout`, honoring `options` (shared by __init__ and rebind so
+        the two can never diverge)."""
+        self.global_state = state
+        self.layout = layout
         self._options = options
         n_valid_global = jnp.asarray(
             max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
         )
         statics_list = []
-        for ls in self.layout.local_states:
+        for ls in layout.local_states:
             sx = build_statics(ls, options)
             sx = dataclasses.replace(
                 sx,
                 n_valid=n_valid_global,
                 part_replicas=jnp.asarray(
-                    partition_replica_table(ls, max_rf=self.layout.max_rf)
+                    partition_replica_table(ls, max_rf=layout.max_rf)
                 ),
             )
             statics_list.append(sx)
         self.statics = _tree_stack(statics_list)
-
-        self._build_jits()
 
     def rebind(self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS):
         """Swap in a new model generation without recompiling.
@@ -234,23 +242,7 @@ class ShardedEngine:
                 f"{(old.R_local, old.P_local, old.max_rf)} -> "
                 f"{(lay.R_local, lay.P_local, lay.max_rf)}; build a new engine"
             )
-        self.global_state = state
-        self.layout = lay
-        n_valid_global = jnp.asarray(
-            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
-        )
-        statics_list = []
-        for ls in lay.local_states:
-            sx = build_statics(ls, self._options)
-            sx = dataclasses.replace(
-                sx,
-                n_valid=n_valid_global,
-                part_replicas=jnp.asarray(
-                    partition_replica_table(ls, max_rf=lay.max_rf)
-                ),
-            )
-            statics_list.append(sx)
-        self.statics = _tree_stack(statics_list)
+        self._bind(state, lay, options)
         return self
 
     def _build_jits(self):

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Start the cruise-control-tpu service (reference kafka-cruise-control-start.sh).
+# Usage: scripts/cruise-control-start.sh [config.properties] [-daemon]
+set -euo pipefail
+base="$(cd "$(dirname "$0")/.." && pwd)"
+config="${1:-}"
+pidfile="${CRUISE_CONTROL_PID_FILE:-/tmp/cruise-control-tpu.pid}"
+cmd=(python -m cruise_control_tpu.service.main)
+[[ -n "$config" && "$config" != "-daemon" ]] && cmd+=("$config")
+cd "$base"
+if [[ "${*: -1}" == "-daemon" ]]; then
+  nohup "${cmd[@]}" >"${CRUISE_CONTROL_LOG:-/tmp/cruise-control-tpu.log}" 2>&1 &
+  echo $! >"$pidfile"
+  echo "started pid $(cat "$pidfile") (log: ${CRUISE_CONTROL_LOG:-/tmp/cruise-control-tpu.log})"
+else
+  exec "${cmd[@]}"
+fi

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Stop a daemonized cruise-control-tpu service (reference kafka-cruise-control-stop.sh).
+set -euo pipefail
+pidfile="${CRUISE_CONTROL_PID_FILE:-/tmp/cruise-control-tpu.pid}"
+if [[ ! -f "$pidfile" ]]; then
+  echo "no pid file at $pidfile" >&2
+  exit 1
+fi
+pid="$(cat "$pidfile")"
+kill "$pid" 2>/dev/null && echo "stopped pid $pid" || echo "pid $pid not running"
+rm -f "$pidfile"

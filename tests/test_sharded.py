@@ -177,3 +177,26 @@ def test_goal_optimizer_parallel_modes(mode):
     validate(res.state_after)
     assert res.objective_after < res.objective_before
     assert res.proposals  # a real plan came out of the parallel engine
+
+
+def test_parallel_engine_rebind_honors_new_options():
+    """A cached sharded engine rebound with NEW options must honor them —
+    the stale-options path would move replicas onto excluded brokers."""
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizationOptions
+
+    state = _state(seed=61, brokers=10, parts=120)
+    opt = GoalOptimizer(config=CFG, parallel_mode="sharded")
+    opt.optimize(state)  # populate the parallel-engine cache (default opts)
+
+    excluded = np.zeros(state.shape.B, bool)
+    excluded[0] = True
+    res = opt.optimize(
+        state, options=OptimizationOptions(excluded_brokers_for_replica_move=excluded)
+    )
+    before, after = res.state_before, res.state_after
+    moved = (
+        np.asarray(before.replica_broker) != np.asarray(after.replica_broker)
+    ) & np.asarray(before.replica_valid)
+    assert not (np.asarray(after.replica_broker)[moved] == 0).any(), (
+        "cached sharded engine ignored the new exclusion options"
+    )
