@@ -361,6 +361,7 @@ class Engine:
         self._jit_plan = jax.jit(self._plan_impl)
         self._jit_violations = jax.jit(self._violations_impl)
         self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
+        self._jit_round_prep = jax.jit(self._round_prep_impl)
 
     # convenience for call sites that held `engine.state`
     @property
@@ -1506,6 +1507,17 @@ class Engine:
             host_load=hl,
         )
 
+    def _round_prep_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """Between-rounds bookkeeping as ONE program: refresh aggregates
+        (wash float drift), build the next round's sampling plan, and read
+        the cheap early-stop signal.  Separately jitted these three share
+        the O(R) aggregate rebuild and O(B) globals/objective work and cost
+        three dispatch+sync round trips; fused they cost one."""
+        carry = self._refresh_impl(sx, carry)
+        plan = self._plan_impl(sx, carry)
+        cheap = self._cheap_violations_impl(sx, carry)
+        return carry, plan, cheap
+
     def _scan_impl(
         self, sx: EngineStatics, carry: EngineCarry, temps: jax.Array, plan=None
     ):
@@ -1530,6 +1542,7 @@ class Engine:
         carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
 
         t0_obj = float(self._jit_objective(sx, carry)) * cfg.init_temperature_scale
+        plan = self._jit_plan(sx, carry)
         history = []
         # the authoritative (full-chain) early-stop check is bounded: when
         # the cheap gate opens but goals folded into candidate deltas (topic
@@ -1542,10 +1555,11 @@ class Engine:
             else:
                 t_round = t0_obj * (cfg.temperature_decay**rnd)
             temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            plan = self._jit_plan(sx, carry)
             carry, stats = self._scan(sx, carry, temps, plan)
-            # re-derive aggregates from placement to wash out float drift
-            carry = self._jit_refresh(sx, carry)
+            # fused between-rounds program: wash float drift out of the
+            # aggregates, plan the next round's sampling, read the cheap
+            # early-stop signal — one dispatch instead of three
+            carry, plan, cheap = self._jit_round_prep(sx, carry)
             accepted = int(jax.device_get(stats["accepted"]).sum())
             history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
             if verbose:
@@ -1557,8 +1571,7 @@ class Engine:
                 cfg.early_stop_violations >= 0.0
                 and rnd < cfg.num_rounds - 1
                 and full_checks_left > 0
-                and float(self._jit_cheap_violations(sx, carry))
-                <= cfg.early_stop_violations
+                and float(cheap) <= cfg.early_stop_violations
             ):
                 if float(self._jit_violations(sx, carry)) <= cfg.early_stop_violations:
                     history[-1]["early_stop"] = True

@@ -100,31 +100,44 @@ def extract_proposals(before: ClusterState, after: ClusterState) -> list[Executi
     disk_changed = mask & (tb_old == tb_new) & (td_old != td_new)
     t_topic = topic[rows[:, 0]]
 
-    def ordered(brokers, leader):
-        lst = [int(x) for x in brokers if x >= 0]
-        if leader in lst:
-            lst.remove(leader)
-            lst.insert(0, leader)
-        return tuple(lst)
+    # leader-first ordering, vectorized: stable sort on (2=pad, 1=follower,
+    # 0=leader) keeps the preferred order among followers while hoisting the
+    # leader to the head — then materialize via tolist() (numpy scalar
+    # indexing inside a 100k-row loop would dominate the optimizer wall)
+    def reorder(tb, leader):
+        key = np.where(tb < 0, 2, np.where(tb == leader[:, None], 0, 1))
+        idx = np.argsort(key, axis=1, kind="stable")
+        return np.take_along_axis(tb, idx, axis=1)
 
-    proposals: list[ExecutionProposal] = []
-    for k, p in enumerate(touched):
-        disk_moves = ()
-        if disk_changed[k].any():
-            disk_moves = tuple(
-                (int(tb_new[k, j]), int(td_old[k, j]), int(td_new[k, j]))
-                for j in np.nonzero(disk_changed[k])[0]
-            )
-        proposals.append(
-            ExecutionProposal(
-                partition=int(p),
-                topic=int(t_topic[k]),
-                old_leader=int(old_leader[k]),
-                new_leader=int(new_leader[k]),
-                old_replicas=ordered(tb_old[k], int(old_leader[k])),
-                new_replicas=ordered(tb_new[k], int(new_leader[k])),
-                disk_moves=disk_moves,
-                inter_broker_data_to_move=float(data[k]),
-            )
+    n_valid = mask.sum(1).tolist()
+    ob = reorder(tb_old, old_leader).tolist()
+    nb = reorder(tb_new, new_leader).tolist()
+    has_disk = disk_changed.any(1)
+    disk_rows = {
+        int(k): tuple(
+            (int(tb_new[k, j]), int(td_old[k, j]), int(td_new[k, j]))
+            for j in np.nonzero(disk_changed[k])[0]
         )
+        for k in np.nonzero(has_disk)[0]
+    }
+
+    # derived, not hand-written: stays aligned if fields are ever reordered
+    fields = tuple(f.name for f in dataclasses.fields(ExecutionProposal))
+    new = ExecutionProposal.__new__
+    cls = ExecutionProposal
+    proposals: list[ExecutionProposal] = []
+    append = proposals.append
+    empty: tuple = ()
+    for k, (p, t, olr, nlr, obk, nbk, nv, dt) in enumerate(zip(
+        touched.tolist(), t_topic.tolist(), old_leader.tolist(),
+        new_leader.tolist(), ob, nb, n_valid, data.tolist(),
+    )):
+        o = new(cls)
+        # frozen dataclass: populate __dict__ directly — object.__setattr__
+        # per field costs ~4x as much across ~100k proposals
+        o.__dict__.update(zip(fields, (
+            p, t, olr, nlr, tuple(obk[:nv]), tuple(nbk[:nv]),
+            disk_rows.get(k, empty), dt,
+        )))
+        append(o)
     return proposals

@@ -25,6 +25,7 @@ Record layout (little-endian):
 from __future__ import annotations
 
 import struct
+import time
 from typing import Callable
 
 import numpy as np
@@ -118,15 +119,36 @@ class KafkaSampleStore:
         self._b_out.flush()
 
     def load(self) -> list[SamplingResult]:
-        """Replay everything persisted (reference SampleLoadingTask)."""
-        parts = [
-            self._unpack(r)
-            for r in KafkaMetricsConsumer(self.client, self._p_topic).poll_records()
-        ]
-        brokers = [
-            self._unpack(r)
-            for r in KafkaMetricsConsumer(self.client, self._b_topic).poll_records()
-        ]
+        """Replay everything persisted (reference SampleLoadingTask).
+
+        Each poll issues one Fetch round (bounded bytes per partition), so a
+        history larger than one round needs repeated polls — the reference's
+        SampleLoadingTask likewise consumes to the log end, not one batch.
+        """
+
+        def drain(topic: str) -> list[MetricSample]:
+            consumer = KafkaMetricsConsumer(self.client, topic)
+            out: list[MetricSample] = []
+            stalled = 0
+            while True:
+                batch = consumer.poll_records()
+                if not batch:
+                    # an empty round is log-end only if ListOffsets agrees —
+                    # transient fetch errors (leader change, offset re-seek)
+                    # also yield empty rounds mid-stream.  A partition that
+                    # stays unreadable must not hang startup forever: after
+                    # 10 stalled rounds return the partial history (the
+                    # monitor re-samples what replay missed).
+                    stalled += 1
+                    if consumer.at_log_end() or stalled > 10:
+                        return out
+                    time.sleep(0.1 * stalled)
+                    continue
+                stalled = 0
+                out.extend(self._unpack(r) for r in batch)
+
+        parts = drain(self._p_topic)
+        brokers = drain(self._b_topic)
         if not parts and not brokers:
             return []
         # one SamplingResult per distinct sample time window keeps the

@@ -244,6 +244,43 @@ class KafkaMetricsConsumer:
                     self._offsets[part] = next_off
         return out
 
+    def log_end_offsets(self) -> dict[int, int]:
+        """Current LATEST offset per partition (fresh ListOffsets round)."""
+        leaders = self._router.refresh()
+        by_leader: dict[int, list[int]] = {}
+        for p, node in leaders.items():
+            by_leader.setdefault(node, []).append(p)
+        out: dict[int, int] = {}
+        for node, parts in by_leader.items():
+            resp = self.client.broker_request(node, proto.LIST_OFFSETS, {
+                "replica_id": -1,
+                "topics": [{
+                    "name": self.topic,
+                    "partitions": [
+                        {"partition_index": p, "timestamp": LATEST} for p in parts
+                    ],
+                }],
+            })
+            for t in resp["topics"] or []:
+                for p in t["partitions"] or []:
+                    if p["error_code"] == NONE:
+                        out[p["partition_index"]] = p["offset"]
+        return out
+
+    def at_log_end(self) -> bool:
+        """True when every reachable partition's offset is at LATEST.
+
+        One empty poll is NOT proof of log end: a transient fetch error
+        (leader change, offset re-seek) yields an empty round with data
+        still unread — callers draining history (sample-store replay) must
+        confirm against ListOffsets.
+        """
+        with self._lock:
+            if self._pending:
+                return False
+            ends = self.log_end_offsets()
+            return all(self._offsets.get(p, 0) >= end for p, end in ends.items())
+
     def poll_framed(self, max_records: int | None = None) -> bytes:
         from cruise_control_tpu.native import frame_records
 

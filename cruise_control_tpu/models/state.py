@@ -191,10 +191,17 @@ def validate(state: ClusterState, *, strict: bool = True) -> list[str]:
     Returns a list of human-readable problems; raises if strict and non-empty.
     """
     problems: list[str] = []
-    valid = np.asarray(state.replica_valid)
-    part = np.asarray(state.replica_partition)[valid]
-    brk = np.asarray(state.replica_broker)[valid]
-    lead = np.asarray(state.replica_is_leader)[valid]
+    # one batched device->host transfer (per-array np.asarray syncs five times)
+    valid, part, brk, lead, load_l = jax.device_get(
+        (
+            state.replica_valid,
+            state.replica_partition,
+            state.replica_broker,
+            state.replica_is_leader,
+            state.replica_load_leader,
+        )
+    )
+    part, brk, lead = part[valid], brk[valid], lead[valid]
     B, P = state.shape.B, state.shape.P
 
     if brk.size:
@@ -215,7 +222,7 @@ def validate(state: ClusterState, *, strict: bool = True) -> list[str]:
     if np.unique(pb).size != pb.size:
         problems.append("duplicate replica of a partition on one broker")
 
-    loads = np.asarray(state.replica_load_leader)[valid]
+    loads = load_l[valid]
     if not np.isfinite(loads).all() or (loads < 0).any():
         problems.append("non-finite or negative leader loads")
 

@@ -169,7 +169,12 @@ def _batch_deserialize_py(framed: bytes) -> MetricBatch:
         cls, _ver, mt, tms, bid, val = head.unpack_from(framed, off)
         tid, part = -1, -1
         if cls != 0:
+            # mirror the native decoder's bounds checks (serde.cpp returns -1)
+            if rec_len < 26:
+                raise ValueError("malformed metric batch")
             (tl,) = struct.unpack_from("<H", framed, off + 24)
+            if 26 + tl > rec_len or (cls == 2 and 26 + tl + 4 > rec_len):
+                raise ValueError("malformed metric batch")
             topic = framed[off + 26: off + 26 + tl].decode()
             tid = interned.get(topic)
             if tid is None:

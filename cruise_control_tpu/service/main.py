@@ -99,6 +99,42 @@ def build_service(
     return app, fetcher
 
 
+def parse_bootstrap_servers(bootstrap_servers: str) -> list[tuple[str, int]]:
+    """Parse a Kafka bootstrap list ("h1:9092,h2") into (host, port) seeds.
+
+    Supports bracketed IPv6 ("[::1]:9092", "[::1]") and bare IPv6 literals
+    without a port ("::1") — rpartition(':') alone would split those wrong.
+    """
+    seeds = []
+    for hp in bootstrap_servers.split(","):
+        hp = hp.strip()
+        if not hp:
+            continue
+        if hp.startswith("["):  # bracketed IPv6: [::1] or [::1]:9092
+            addr, sep, rest = hp[1:].partition("]")
+            if not sep or (rest and not rest.startswith(":")):
+                raise ValueError(f"malformed bootstrap server {hp!r}")
+            host, port = addr, (rest[1:] or "9092")
+        elif hp.count(":") > 1:  # bare IPv6 literal, no port
+            import ipaddress
+
+            try:  # reject comma typos like "h1:9092:h2:9093" fast
+                ipaddress.ip_address(hp)
+            except ValueError:
+                raise ValueError(f"malformed bootstrap server {hp!r}") from None
+            host, port = hp, "9092"
+        else:
+            host, sep, port = hp.rpartition(":")
+            if not sep:  # bare hostname: Kafka's default port shorthand
+                host, port = hp, "9092"
+        if not port.isdigit():
+            raise ValueError(f"malformed bootstrap server {hp!r}")
+        seeds.append((host or "127.0.0.1", int(port)))
+    if not seeds:
+        raise ValueError(f"no bootstrap servers in {bootstrap_servers!r}")
+    return seeds
+
+
 def build_kafka_service(
     config: CruiseControlConfig,
     bootstrap_servers: str,
@@ -123,16 +159,9 @@ def build_kafka_service(
         KafkaMetadataProvider,
     )
 
-    seeds = []
-    for hp in bootstrap_servers.split(","):
-        hp = hp.strip()
-        host, sep, port = hp.rpartition(":")
-        if not sep:  # bare hostname: Kafka's default port shorthand
-            host, port = hp, "9092"
-        if not port.isdigit():
-            raise ValueError(f"malformed bootstrap server {hp!r}")
-        seeds.append((host or "127.0.0.1", int(port)))
-    client = KafkaAdminClient(seeds, client_id=client_id)
+    client = KafkaAdminClient(
+        parse_bootstrap_servers(bootstrap_servers), client_id=client_id
+    )
     # fail fast with the full list of unsupported APIs rather than on the
     # first mid-operation decode error against an old broker
     client.check_api_support()

@@ -596,6 +596,16 @@ class CruiseControlApp:
                         except OSError:
                             pass
                         return
+                    # socketserver's shutdown_request only sees the pre-wrap
+                    # socket; close the wrapped one here (sends close_notify)
+                    try:
+                        self.RequestHandlerClass(request, client_address, self)
+                    finally:
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                    return
                 self.RequestHandlerClass(request, client_address, self)
 
         self._httpd = Server((self.host, self.port), Handler)

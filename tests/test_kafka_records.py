@@ -219,3 +219,44 @@ def test_kafka_sample_store_warm_restart():
     finally:
         client.close()
         cluster.stop()
+
+
+def test_kafka_sample_store_load_drains_past_one_fetch_round(monkeypatch):
+    """load() must replay the WHOLE persisted history, not one Fetch round —
+    the reference SampleLoadingTask consumes to the log end
+    (KafkaSampleStore.java:117-128).  A tiny per-fetch byte cap forces many
+    rounds; a single poll_records() call would silently truncate."""
+    import cruise_control_tpu.kafka.sample_store as ss
+    from cruise_control_tpu.monitor.sampling import (
+        MetricSample,
+        PartitionEntity,
+        SamplingResult,
+    )
+
+    class TinyFetchConsumer(KafkaMetricsConsumer):
+        def __init__(self, client, topic):
+            super().__init__(client, topic, max_bytes_per_fetch=512)
+
+    monkeypatch.setattr(ss, "KafkaMetricsConsumer", TinyFetchConsumer)
+
+    cluster = _cluster()
+    client = KafkaAdminClient(cluster.bootstrap(), timeout_s=5.0)
+    try:
+        store = ss.KafkaSampleStore(client, topic_name_fn=lambda _t: "alpha")
+        n_windows, per_window = 10, 8
+        for w in range(n_windows):
+            store.store(SamplingResult(
+                partition_samples=[
+                    MetricSample(PartitionEntity(0, p), w * 1000 + 500,
+                                 np.full(4, float(w * per_window + p), np.float32))
+                    for p in range(per_window)
+                ],
+                broker_samples=[],
+            ))
+        fresh = ss.KafkaSampleStore(client, topic_id_fn=lambda _n: 0)
+        replayed = fresh.load()
+        assert sum(len(r.partition_samples) for r in replayed) == n_windows * per_window
+        assert len(replayed) == n_windows
+    finally:
+        client.close()
+        cluster.stop()

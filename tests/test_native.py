@@ -169,3 +169,24 @@ def test_native_throughput_smoke():
     if native_available():
         # native columnar decode must not be slower than object-per-record
         assert native_s <= object_s
+
+
+def test_truncated_topic_record_rejected_by_both_paths():
+    """A record whose declared topic length overruns the record must fail in
+    BOTH decoders identically (ADVICE r2: the Python path silently produced
+    a truncated topic / misread partition)."""
+    import struct
+
+    good = MetricSerde.serialize(
+        PartitionMetric(MetricType.PARTITION_SIZE, 5, 1, 2.0, topic="abcdef", partition=3)
+    )
+    # corrupt the topic length field (offset 24) to overrun the record
+    bad_topic_len = good[:24] + struct.pack("<H", 1000) + good[26:]
+    # partition-class record too short for its partition id: declare a topic
+    # length that leaves <4 bytes for the partition
+    bad_part = good[:24] + struct.pack("<H", len(good) - 26 - 2) + good[26:]
+    for bad in (bad_topic_len, bad_part):
+        framed = frame_records([bad])
+        for force_python in (False, True):
+            with pytest.raises(ValueError):
+                batch_deserialize(framed, force_python=force_python)

@@ -566,3 +566,24 @@ def test_operation_audit_log(service, caplog):
     recs = [r for r in caplog.records if r.name == "cruisecontrol.operations"]
     assert recs and "GET state" in recs[-1].getMessage()
     assert "-> 200" in recs[-1].getMessage()
+
+
+def test_parse_bootstrap_servers():
+    """IPv4/hostname/IPv6 bootstrap parsing (ADVICE r2: rpartition(':')
+    mangled IPv6 literals)."""
+    import pytest
+
+    from cruise_control_tpu.service.main import parse_bootstrap_servers as parse
+
+    assert parse("h1:9092,h2:9093") == [("h1", 9092), ("h2", 9093)]
+    assert parse("h1") == [("h1", 9092)]
+    assert parse(":9094") == [("127.0.0.1", 9094)]
+    assert parse("::1") == [("::1", 9092)]
+    assert parse("[::1]") == [("::1", 9092)]
+    assert parse("[::1]:9095") == [("::1", 9095)]
+    assert parse("[2001:db8::2]:9096, h7:9097") == [
+        ("2001:db8::2", 9096), ("h7", 9097)
+    ]
+    for bad in ("h1:x", "[::1", "[::1]9092", "", "h1:"):
+        with pytest.raises(ValueError):
+            parse(bad)
