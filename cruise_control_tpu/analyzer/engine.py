@@ -82,8 +82,18 @@ class OptimizerConfig:
     intra_broker: bool = False
     #: stop annealing once the weighted goal violations (objective minus the
     #: dispersion tiebreaker) fall to this level — remaining rounds could
-    #: only polish dispersion, which no goal measures.  <0 disables.
-    early_stop_violations: float = 1e-9
+    #: only polish dispersion, which no goal measures.  Aligned with the
+    #: 1e-6 "goal satisfied" tolerance used by balancedness_score and the
+    #: bench's violated_goals_after (f32 noise floor at 500k-replica scale
+    #: is ~1e-8..1e-7; see analyzer/objective.py).  <0 disables.
+    early_stop_violations: float = 1e-6
+    #: extra T=0 polish rounds run past num_rounds while the FULL goal chain
+    #: still reports violations and each round keeps improving.  The
+    #: reference optimizes every goal to completion rather than on a fixed
+    #: budget (AbstractGoal.optimize loops until finished); a fixed schedule
+    #: tuned for steady-state rebalances runs out on much-worse starts
+    #: (mass decommissions).  0 disables.
+    max_extra_rounds: int = 8
 
 
 @partial(
@@ -527,6 +537,19 @@ class Engine:
         w = w + self.w.offline * jnp.where(
             dead, carry.broker_replica_count.astype(jnp.float32), 0.0
         ) / sx.n_valid
+        # topic-distribution violations live in [T, B] cells that
+        # _broker_terms cannot see — without this term the sampler goes
+        # blind exactly when topic imbalance is the last goal standing
+        # (post-decommission tails) and convergence stalls on uniform luck
+        if self.w.topic_dist != 0.0:
+            tt = self.constraint.topic_replica_count_balance_threshold
+            upper = jnp.ceil(g["topic_avg"] * tt)[:, None]
+            lower = jnp.floor(g["topic_avg"] * max(0.0, 2.0 - tt))[:, None]
+            cnt = carry.broker_topic_count.astype(jnp.float32)
+            cells = _relu(cnt - upper) + _relu(lower - cnt)  # [T, B]
+            w = w + self.w.topic_dist * jnp.where(
+                sx.alive, cells.sum(0), 0.0
+            ) / g["total_count"]
         w = jnp.maximum(jnp.where(st.broker_valid, w, 0.0), 0.0)
         total = w.sum()
         uni = jnp.where(st.broker_valid, 1.0, 0.0)
@@ -1577,4 +1600,23 @@ class Engine:
                     history[-1]["early_stop"] = True
                     break
                 full_checks_left -= 1
+        else:
+            # schedule exhausted with goals possibly unsatisfied (bad starts:
+            # mass decommission) — polish with extra greedy rounds while the
+            # full chain reports violations and they keep shrinking
+            if cfg.early_stop_violations >= 0.0:
+                tol = cfg.early_stop_violations
+                prev_v = None
+                for _ in range(cfg.max_extra_rounds):
+                    v = float(self._jit_violations(sx, carry))
+                    if v <= tol or (prev_v is not None and v > prev_v * 0.9):
+                        break
+                    prev_v = v
+                    temps = jnp.zeros((cfg.steps_per_round,), jnp.float32)
+                    carry, stats = self._scan(sx, carry, temps, plan)
+                    carry, plan, _cheap = self._jit_round_prep(sx, carry)
+                    history.append(dict(
+                        round=len(history), temperature=0.0, extra=True,
+                        accepted=int(jax.device_get(stats["accepted"]).sum()),
+                    ))
         return self.carry_to_state(carry), history

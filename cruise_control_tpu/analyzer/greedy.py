@@ -55,7 +55,8 @@ def greedy_optimize(
     candidate_dests: int = 10,
     seed: int = 0,
     time_budget_s: float | None = None,
-) -> ClusterState:
+    return_info: bool = False,
+):
     """Sequential greedy search over single moves, reference-style.
 
     For tractability the oracle samples `candidate_dests` destinations per
@@ -64,25 +65,48 @@ def greedy_optimize(
     `time_budget_s` bounds wall-clock: when exhausted, the best state so
     far is returned (the reference search at LinkedIn scale runs minutes;
     benchmarks cap it to keep rounds bounded).
+
+    With `return_info` returns (state, info) where info records whether the
+    run CONVERGED (terminated on its own: goals satisfied or no improving
+    move within the sampled neighborhood) vs hit the deadline — baseline
+    generation needs the distinction (a truncated oracle understates the
+    bar, VERDICT r2 weak #4).
     """
     rng = np.random.default_rng(seed)
     eval_fn = _make_eval(chain, constraint)
     cur = state
     viol = eval_fn(cur)
-    deadline = time.monotonic() + time_budget_s if time_budget_s else None
+    t0 = time.monotonic()
+    deadline = t0 + time_budget_s if time_budget_s else None
+    moves = 0
+    hit_deadline = False
 
     for gi in range(len(chain.goals)):
+        if hit_deadline:
+            break
         for _ in range(max_moves_per_goal):
             if viol[gi] <= 1e-12:
                 break
             if deadline is not None and time.monotonic() > deadline:
-                return cur
+                hit_deadline = True
+                break
             move = _find_improving_move(
                 cur, eval_fn, viol, gi, rng, candidate_dests, deadline
             )
             if move is None:
+                # a deadline that fired inside the move search is truncation,
+                # not convergence
+                if deadline is not None and time.monotonic() > deadline:
+                    hit_deadline = True
                 break
             cur, viol = move
+            moves += 1
+    if return_info:
+        return cur, dict(
+            converged=not hit_deadline,
+            moves=moves,
+            seconds=round(time.monotonic() - t0, 1),
+        )
     return cur
 
 
@@ -117,6 +141,8 @@ def _find_improving_move(cur, eval_fn, viol, gi, rng, candidate_dests, deadline)
 
         # 1. relocation (reference maybeApplyBalancingAction)
         for dst in dests:
+            if deadline is not None and time.monotonic() > deadline:
+                return None
             if dst == src:
                 continue
             if ((part == part[r]) & (brokers == dst) & valid).any():
@@ -136,6 +162,8 @@ def _find_improving_move(cur, eval_fn, viol, gi, rng, candidate_dests, deadline)
         # 3. swap with a replica on a destination broker (reference
         #    maybeApplySwapAction:236, ResourceDistributionGoal swap-in/out)
         for dst in dests:
+            if deadline is not None and time.monotonic() > deadline:
+                return None
             if dst == src:
                 continue
             on_dst = np.nonzero(valid & (brokers == dst) & (part != part[r]))[0]

@@ -99,7 +99,10 @@ def balancedness_score(
 
     The reference sums weight = priority_weight^rank * (strictness_weight if
     hard) over *violated* goals and scales to 100.  A goal is "violated" here
-    when its normalized violation exceeds 0.0 (epsilon-guarded).
+    when its normalized violation exceeds 1e-6 — violations are fractions of
+    cluster-wide totals computed in f32, whose noise floor at 500k-replica
+    scale is ~1e-8..1e-7; the reference's per-goal epsilons serve the same
+    role (its resource epsilons are far coarser than 1e-6 of total load).
     """
     n = len(chain.goals)
     weights = np.array(
@@ -110,7 +113,7 @@ def balancedness_score(
         np.float64,
     )
     total = weights.sum()
-    violated = np.asarray(violations) > 1e-9
+    violated = np.asarray(violations) > 1e-6
     return float(100.0 * (1.0 - weights[violated].sum() / total))
 
 

@@ -121,8 +121,14 @@ def extract_proposals(before: ClusterState, after: ClusterState) -> list[Executi
         for k in np.nonzero(has_disk)[0]
     }
 
-    # derived, not hand-written: stays aligned if fields are ever reordered
+    # the values tuple below is hand-ordered to match — this assert makes a
+    # field reorder/insert in ExecutionProposal fail loudly here instead of
+    # silently scrambling every proposal
     fields = tuple(f.name for f in dataclasses.fields(ExecutionProposal))
+    assert fields == (
+        "partition", "topic", "old_leader", "new_leader",
+        "old_replicas", "new_replicas", "disk_moves", "inter_broker_data_to_move",
+    ), fields
     new = ExecutionProposal.__new__
     cls = ExecutionProposal
     proposals: list[ExecutionProposal] = []

@@ -123,6 +123,10 @@ def _monitor_defs() -> ConfigDef:
              in_range(lo=1), group=g)
     d.define("metric.sampling.interval.ms", T.LONG, 120_000, I.MEDIUM, "sampler cadence",
              in_range(lo=1), group=g)
+    d.define("num.metric.fetchers", T.INT, 1, I.MEDIUM,
+             "parallel metric fetcher threads; each samples a disjoint "
+             "partition set per round (reference num.metric.fetchers)",
+             in_range(lo=1), group=g)
     d.define("min.valid.partition.ratio", T.DOUBLE, 0.95, I.MEDIUM,
              "monitored partition ratio gate", in_range(lo=0.0, hi=1.0), group=g)
     d.define("metric.sampler.class", T.CLASS,

@@ -83,6 +83,7 @@ class SimulatedClusterAdmin:
         *,
         link_rate_bytes_per_s: float = 50_000.0,
         fail_partitions: set[tuple[str, int]] | None = None,
+        drop_partitions: set[tuple[str, int]] | None = None,
     ):
         self.metadata = metadata
         self.link_rate = link_rate_bytes_per_s
@@ -90,6 +91,13 @@ class SimulatedClusterAdmin:
         self.throttled_topics: set[str] = set()
         self._inflight: dict[tuple[str, int], _Inflight] = {}
         self._fail = fail_partitions or set()
+        #: reassignments the "controller" silently forgets ONCE: on the next
+        #: tick the entry vanishes from in-progress without being applied
+        #: (models the dropped reassignments reference
+        #: Executor.maybeReexecuteTasks:1430 exists to catch); a re-submitted
+        #: reassignment for the same partition then proceeds normally
+        self._drop_once = set(drop_partitions or set())
+        self.dropped_reassignments: list[tuple[str, int]] = []
         self.reassign_calls = 0
         self.election_calls = 0
 
@@ -145,6 +153,11 @@ class SimulatedClusterAdmin:
             rate = min(rate, self.throttle_rate)
         done = []
         for key, fl in list(self._inflight.items()):
+            if key in self._drop_once:
+                self._drop_once.discard(key)
+                self.dropped_reassignments.append(key)
+                del self._inflight[key]  # vanishes, topology unchanged
+                continue
             if key in self._fail:
                 continue  # stuck forever (tests exercise DEAD handling)
             fl.remaining_bytes -= rate * seconds

@@ -63,6 +63,16 @@ class ExecutionOptions:
     progress_check_interval_s: float = 0.5
     #: tasks in progress longer than this raise an alert flag
     task_execution_alerting_s: float = 90.0
+    #: times a reassignment the controller dropped (vanished from the
+    #: in-progress set without landing) is re-submitted before the task is
+    #: declared DEAD.  The reference re-executes unboundedly
+    #: (Executor.maybeReexecuteTasks:1430); the bound here exists so a
+    #: pathologically dropping controller cannot loop forever, and defaults
+    #: HIGH because the landed-check reads topology metadata that can lag
+    #: the controller on a real cluster (a completed move that looks
+    #: unplaced for a few ticks must not be DEAD-marked — 64 ticks at the
+    #: 0.5s default interval tolerates ~30s of metadata staleness)
+    max_reexecution_attempts: int = 64
     max_ticks: int = 10_000  # simulation safety bound
 
 
@@ -111,6 +121,8 @@ class Executor:
         self.num_executions_started = 0
         self.num_executions_stopped = 0
         self._uuid: str | None = None
+        #: re-submission count per dropped reassignment key
+        self._reexecutions: dict[tuple[str, int], int] = {}
 
     # ------------------------------------------------------------------
 
@@ -158,6 +170,7 @@ class Executor:
             if demoted_brokers:
                 self.demoted_brokers |= demoted_brokers
             self.tracker = ExecutionTaskTracker()
+            self._reexecutions = {}
             self._planner = ExecutionTaskPlanner(self.strategy)
             tasks = self._planner.add_execution_proposals(proposals, strategy_context)
             for t in tasks:
@@ -196,12 +209,40 @@ class Executor:
             if self._stop_requested:
                 self._handle_stop(in_flight, now_ms())
                 break
-            # collect completions
+            # collect completions.  A key leaving the in-progress set does
+            # NOT prove the move landed: the controller may have dropped the
+            # reassignment (reference Executor.maybeReexecuteTasks:1430) —
+            # verify against the topology and re-submit dropped tasks, up to
+            # a bound, before declaring them DEAD.
             in_progress = self.admin.in_progress_reassignments()
+            placement = None
             for key, task in list(in_flight.items()):
                 if key not in in_progress:
-                    task.completed(now_ms())
-                    del in_flight[key]
+                    if placement is None:
+                        placement = {
+                            (p.topic, p.partition): set(p.replicas)
+                            for p in self.admin.topology().partitions
+                        }
+                    if placement.get(key) == set(task.proposal.new_replicas):
+                        task.completed(now_ms())
+                        del in_flight[key]
+                        continue
+                    n = self._reexecutions.get(key, 0)
+                    if n >= options.max_reexecution_attempts:
+                        task.kill(now_ms())
+                        del in_flight[key]
+                        continue
+                    self._reexecutions[key] = n + 1
+                    # reference Executor sensor analog for re-executed tasks
+                    self.sensors.counter("executor.task-reexecuted").inc()
+                    self.admin.reassign_partitions([
+                        ReassignmentSpec(
+                            topic=key[0],
+                            partition=key[1],
+                            new_replicas=tuple(task.proposal.new_replicas),
+                            data_to_move=task.proposal.inter_broker_data_to_move,
+                        )
+                    ])
                 elif (
                     task.alert_time_ms < 0
                     and now_ms() - task.start_time_ms
@@ -348,6 +389,10 @@ class Executor:
             "numFinishedMovements": self.tracker.count(state=TaskState.COMPLETED),
             "numTotalMovements": len(self.tracker.tasks()),
             "finishedDataMovementMB": self.tracker.finished_data_bytes(),
+            # per-type PENDING/IN_PROGRESS/ABORTING/ABORTED/DEAD/COMPLETED
+            # breakdown (reference ExecutorState task-state sets)
+            "taskStatus": self.tracker.status(),
+            "numReexecutedTasks": sum(self._reexecutions.values()),
             "recentlyRemovedBrokers": sorted(self.removed_brokers),
             "recentlyDemotedBrokers": sorted(self.demoted_brokers),
             "numExecutionsStarted": self.num_executions_started,

@@ -186,13 +186,46 @@ class FileSampleStore:
         pass
 
 
+class MetricSamplerPartitionAssignor:
+    """Splits the partition universe into per-fetcher disjoint sets
+    (reference monitor/sampling/MetricSamplerPartitionAssignor.java:1 —
+    the default assignor distributes each topic's partitions so fetcher
+    loads stay balanced while a topic's partitions stay together as far as
+    the balance allows).
+
+    A rotating round-robin walks topics in order and deals their partitions
+    across the fetcher sets, carrying the cursor between topics: every set
+    ends within one partition of even, and no topic can serialize a round
+    on one fetcher.
+    """
+
+    def assign(
+        self, partitions: list[PartitionEntity], num_fetchers: int
+    ) -> list[list[PartitionEntity]]:
+        if num_fetchers <= 1:
+            return [list(partitions)]
+        by_topic: dict[object, list[PartitionEntity]] = {}
+        for p in partitions:
+            by_topic.setdefault(p.topic, []).append(p)
+        sets: list[list[PartitionEntity]] = [[] for _ in range(num_fetchers)]
+        k = 0
+        for _topic, plist in sorted(by_topic.items(), key=lambda kv: str(kv[0])):
+            for p in plist:
+                sets[k].append(p)
+                k = (k + 1) % num_fetchers
+        return sets
+
+
 class MetricFetcherManager:
     """Schedules sampling rounds and feeds aggregators + sample store
-    (reference monitor/sampling/MetricFetcherManager.java:145,
-    SamplingFetcher.java:32).  Synchronous `fetch_once` plus an optional
-    background thread; partition assignment is a single list here because
-    the Python sampler SPI takes the whole batch (the reference splits
-    across fetcher threads — our samplers vectorize instead).
+    (reference monitor/sampling/MetricFetcherManager.java:35-56,145,
+    SamplingFetcher.java:32).  `num_fetchers > 1` splits each round's
+    partition universe across a thread pool via the assignor — the
+    reference's fetcher-pool parallelism (num.metric.fetchers) — and merges
+    the per-fetcher results; each fetch is timed and failure-counted into
+    the sensor registry, with monitor self-observability gauges
+    (monitored-partitions-percentage, num-partitions-with-flaw: reference
+    docs/wiki User Guide/Sensors.md:9-17).
     """
 
     def __init__(
@@ -203,27 +236,103 @@ class MetricFetcherManager:
         sample_store: SampleStore | None = None,
         *,
         sampling_interval_ms: int = 120_000,
+        num_fetchers: int = 1,
+        assignor: MetricSamplerPartitionAssignor | None = None,
+        sensors=None,
     ):
+        from cruise_control_tpu.common.sensors import REGISTRY
+
         self.sampler = sampler
         self.partition_aggregator = partition_aggregator
         self.broker_aggregator = broker_aggregator
         self.sample_store = sample_store or NoopSampleStore()
         self.sampling_interval_ms = sampling_interval_ms
+        self.num_fetchers = max(1, num_fetchers)
+        self.assignor = assignor or MetricSamplerPartitionAssignor()
+        self.sensors = sensors if sensors is not None else REGISTRY
+        self._pool = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.total_samples = 0
         self.failed_fetches = 0
+        #: last round's monitor-health numbers (also exported as gauges)
+        self.last_monitored_percentage = 100.0
+        self.last_partitions_with_flaw = 0
+        self.sensors.gauge(
+            "monitor.monitored-partitions-percentage",
+            lambda: self.last_monitored_percentage,
+        )
+        self.sensors.gauge(
+            "monitor.num-partitions-with-flaw",
+            lambda: self.last_partitions_with_flaw,
+        )
 
-    def fetch_once(self, partitions: list[PartitionEntity], start_ms: int, end_ms: int) -> int:
-        """One sampling round (reference fetchPartitionMetricSamples:145)."""
+    def _fetch_one(
+        self, partitions: list[PartitionEntity], start_ms: int, end_ms: int
+    ) -> SamplingResult:
+        """One fetcher's sampling call, timed + failure-counted
+        (reference MetricFetcherManager fetch timer/failure sensors :53-56)."""
         try:
-            result = self.sampler.get_samples(partitions, start_ms, end_ms)
+            with self.sensors.timer("monitor.metric-fetch").time():
+                return self.sampler.get_samples(partitions, start_ms, end_ms)
         except Exception:
             self.failed_fetches += 1
+            self.sensors.counter("monitor.metric-fetch-failures").inc()
             raise
+
+    def fetch_once(self, partitions: list[PartitionEntity], start_ms: int, end_ms: int) -> int:
+        """One sampling round (reference fetchPartitionMetricSamples:145);
+        with num_fetchers > 1 the round fans out over disjoint partition
+        sets and merges (reference MetricSamplerPartitionAssignor split)."""
+        if self.num_fetchers > 1 and len(partitions) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.num_fetchers, thread_name_prefix="metric-fetcher"
+                )
+            sets = [
+                s for s in self.assignor.assign(partitions, self.num_fetchers) if s
+            ]
+            futures = [
+                self._pool.submit(self._fetch_one, s, start_ms, end_ms) for s in sets
+            ]
+            parts: list[MetricSample] = []
+            brokers: list[MetricSample] = []
+            errors = []
+            for f in futures:
+                try:
+                    r = f.result()
+                    parts.extend(r.partition_samples)
+                    brokers.extend(r.broker_samples)
+                except Exception as e:  # noqa: BLE001 — surface after merging
+                    errors.append(e)
+            if errors and not parts and not brokers:
+                raise errors[0]
+            result = SamplingResult(parts, brokers)
+        else:
+            result = self._fetch_one(partitions, start_ms, end_ms)
+        self._update_health(partitions, result)
         n = self._absorb(result)
         self.sample_store.store(result)
         return n
+
+    def _update_health(
+        self, assigned: list[PartitionEntity], result: SamplingResult
+    ) -> None:
+        """Monitor self-observability (reference Sensors.md
+        monitored-partitions-percentage / num-partitions-with-flaw)."""
+        if not assigned:
+            return
+        sampled = {
+            (s.entity.topic, s.entity.partition) for s in result.partition_samples
+        }
+        n_ok = sum(1 for p in assigned if (p.topic, p.partition) in sampled)
+        self.last_monitored_percentage = 100.0 * n_ok / len(assigned)
+        flawed = sum(
+            1 for s in result.partition_samples if not np.all(np.isfinite(s.values))
+        )
+        self.last_partitions_with_flaw = flawed + (len(assigned) - n_ok)
 
     def _absorb(self, result: SamplingResult) -> int:
         n = 0
@@ -264,3 +373,6 @@ class MetricFetcherManager:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None

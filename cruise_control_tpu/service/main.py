@@ -58,12 +58,19 @@ def build_service(
         min_samples_per_window=config.get("min.samples.per.broker.metrics.window"),
         metric_def=KAFKA_METRIC_DEF,
     )
+    from cruise_control_tpu.common.sensors import SensorRegistry
+
+    # ONE registry shared by the fetcher and the facade stack — the monitor
+    # health gauges must surface in /state?substates=sensors
+    sensors = SensorRegistry()
     fetcher = MetricFetcherManager(
         sampler,
         partition_agg,
         broker_agg,
         sample_store=sample_store,
         sampling_interval_ms=config.get("metric.sampling.interval.ms"),
+        num_fetchers=config.get("num.metric.fetchers"),
+        sensors=sensors,
     )
     from cruise_control_tpu.monitor.cpu_model import LinearRegressionModelParameters
     from cruise_control_tpu.monitor.sampling import PartitionEntity
@@ -93,7 +100,7 @@ def build_service(
         window_ms=config.get("partition.metrics.window.ms"),
         regression=regression,
     )
-    cc = CruiseControl(config, monitor, admin)
+    cc = CruiseControl(config, monitor, admin, sensors=sensors)
     cc.task_runner = task_runner
     app = CruiseControlApp(cc)
     return app, fetcher

@@ -251,3 +251,44 @@ def test_optimizer_to_executor_full_loop():
         assert set(got.replicas) == set(p.new_replicas)
         if p.new_leader >= 0:
             assert got.leader == p.new_leader
+
+
+def test_dropped_reassignments_are_reexecuted(sim):
+    """A reassignment the controller silently drops must be detected (the
+    target placement never landed) and re-submitted until it completes —
+    reference Executor.maybeReexecuteTasks:1430."""
+    ex = Executor(sim, topic_names={0: "T0", 1: "T1"})
+    sim._drop_once.update({("T0", 0), ("T1", 0)})
+    props = [
+        proposal(0, 0, [0, 1], [2, 1], old_leader=0, new_leader=2, data=100.0),
+        proposal(0, 1, [1, 2], [1, 3], old_leader=1, new_leader=1, data=100.0),
+        proposal(1, 0, [2, 3], [0, 3], old_leader=2, new_leader=0, data=100.0),
+    ]
+    res = ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=0.5))
+    assert sim.dropped_reassignments == [("T0", 0), ("T1", 0)]
+    assert res.dead == 0 and res.completed == len(ex.tracker.tasks())
+    by_key = {(p.topic, p.partition): set(p.replicas) for p in sim.topology().partitions}
+    assert by_key[("T0", 0)] == {2, 1}
+    assert by_key[("T0", 1)] == {1, 3}
+    assert by_key[("T1", 0)] == {0, 3}
+    state = ex.executor_state()
+    assert state["numReexecutedTasks"] == 2
+    assert state["taskStatus"]["INTER_BROKER_REPLICA_ACTION"] == {"COMPLETED": 3}
+
+
+def test_reexecution_bound_marks_task_dead(sim):
+    """A reassignment dropped more times than max_reexecution_attempts goes
+    DEAD instead of looping forever (ExecutionTask.java:26-40 DEAD state)."""
+    ex = Executor(sim, topic_names={0: "T0"})
+    sim._drop_once.add(("T0", 0))
+    props = [proposal(0, 0, [0, 1], [2, 1], old_leader=0, new_leader=2, data=100.0)]
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(progress_check_interval_s=0.5, max_reexecution_attempts=0),
+    )
+    assert res.dead == 1
+    dead = ex.tracker.tasks(state=TaskState.DEAD)
+    assert len(dead) == 1 and dead[0].task_type == TaskType.INTER_BROKER_REPLICA_ACTION
+    # the topology still shows the OLD placement (the move never landed)
+    by_key = {(p.topic, p.partition): set(p.replicas) for p in sim.topology().partitions}
+    assert by_key[("T0", 0)] == {0, 1}

@@ -243,3 +243,120 @@ def test_monitor_to_optimizer_end_to_end(monitored_cluster):
     )
     res = GoalOptimizer(config=cfg).optimize(state)
     assert res.objective_after <= res.objective_before
+
+
+# ------------------------------------------------- parallel sampling
+
+
+def test_partition_assignor_disjoint_and_balanced():
+    """MetricSamplerPartitionAssignor splits the partition universe into
+    disjoint, balanced per-fetcher sets (reference
+    monitor/sampling/MetricSamplerPartitionAssignor.java:1)."""
+    from cruise_control_tpu.monitor.sampling import (
+        MetricSamplerPartitionAssignor,
+        PartitionEntity,
+    )
+
+    parts = [
+        PartitionEntity(t, p)
+        for t, n in enumerate([40, 17, 9, 5, 3, 1])
+        for p in range(n)
+    ]
+    sets = MetricSamplerPartitionAssignor().assign(parts, 4)
+    assert len(sets) == 4
+    seen = [pp for s in sets for pp in s]
+    assert len(seen) == len(parts) and len(set(seen)) == len(parts)
+    sizes = sorted(len(s) for s in sets)
+    assert sizes[-1] - sizes[0] <= 1  # balanced within one partition
+    # single fetcher: everything in one set
+    assert MetricSamplerPartitionAssignor().assign(parts, 1) == [parts]
+
+
+def test_multi_fetcher_sampling_parallel_and_observed():
+    """N fetchers sample DISJOINT partition sets whose union covers the
+    round; fetch timers/failure counters and monitor health gauges land in
+    the sensor registry (reference MetricFetcherManager.java:35-56,
+    Sensors.md monitored-partitions-percentage)."""
+    import threading
+
+    from cruise_control_tpu.common.sensors import SensorRegistry
+    from cruise_control_tpu.monitor.sampling import (
+        MetricSample,
+        MetricFetcherManager,
+        PartitionEntity,
+        SamplingResult,
+    )
+
+    calls: list[list] = []
+    lock = threading.Lock()
+
+    class RecordingSampler:
+        def get_samples(self, assigned, start_ms, end_ms):
+            with lock:
+                calls.append(list(assigned))
+            return SamplingResult(
+                [
+                    MetricSample(p, end_ms, np.ones(4, np.float32))
+                    for p in assigned
+                ],
+                [],
+            )
+
+    class NullAgg:
+        def add_sample(self, *a, **k):
+            return True
+
+    sensors = SensorRegistry()
+    parts = [PartitionEntity(t, p) for t in range(8) for p in range(10)]
+    mgr = MetricFetcherManager(
+        RecordingSampler(), NullAgg(), None, num_fetchers=4, sensors=sensors
+    )
+    n = mgr.fetch_once(parts, 0, 1000)
+    assert n == len(parts)
+    assert len(calls) == 4
+    seen = [p for c in calls for p in c]
+    assert len(seen) == len(parts) and len(set(seen)) == len(parts)
+    snap = sensors.snapshot()
+    assert snap["monitor.metric-fetch"]["count"] == 4
+    assert snap["monitor.monitored-partitions-percentage"]["value"] == 100.0
+    assert snap["monitor.num-partitions-with-flaw"]["value"] == 0
+
+
+def test_multi_fetcher_partial_failure_and_flaw_gauges():
+    """One failing fetcher must not sink the round: the other fetchers'
+    samples are absorbed, the failure is counted, and the missing
+    partitions show up in monitored-percentage / partitions-with-flaw."""
+    from cruise_control_tpu.common.sensors import SensorRegistry
+    from cruise_control_tpu.monitor.sampling import (
+        MetricSample,
+        MetricFetcherManager,
+        PartitionEntity,
+        SamplingResult,
+    )
+
+    class FlakySampler:
+        def get_samples(self, assigned, start_ms, end_ms):
+            # exactly one fetcher's disjoint set contains (topic 0, part 0)
+            if any(p.topic == 0 and p.partition == 0 for p in assigned):
+                raise RuntimeError("broker unreachable")
+            return SamplingResult(
+                [MetricSample(p, end_ms, np.ones(4, np.float32)) for p in assigned],
+                [],
+            )
+
+    class NullAgg:
+        def add_sample(self, *a, **k):
+            return True
+
+    sensors = SensorRegistry()
+    parts = [PartitionEntity(t, p) for t in range(4) for p in range(10)]
+    mgr = MetricFetcherManager(
+        FlakySampler(), NullAgg(), None, num_fetchers=4, sensors=sensors
+    )
+    n = mgr.fetch_once(parts, 0, 1000)
+    assert 0 < n < len(parts)
+    assert mgr.failed_fetches == 1
+    snap = sensors.snapshot()
+    assert snap["monitor.metric-fetch-failures"]["count"] == 1
+    assert snap["monitor.monitored-partitions-percentage"]["value"] == 75.0
+    assert snap["monitor.num-partitions-with-flaw"]["value"] == 10
