@@ -85,6 +85,29 @@ def _run_tpu(opt, state, chain):
     return res, time.monotonic() - t0, warm.wall_seconds
 
 
+def _baseline_fingerprint(state, chain) -> str:
+    """Cheap identity of (cluster, goal chain) a greedy baseline was built
+    for — a changed spec/seed/fixture/chain must invalidate the committed
+    number LOUDLY instead of silently comparing different clusters."""
+    import hashlib
+
+    s = state.shape
+    n_valid = int(np.asarray(state.replica_valid).sum())
+    # dead-broker topology is part of the problem (config5 decommission):
+    # changing WHICH brokers die must invalidate the baseline
+    alive = np.asarray(state.broker_valid) & np.asarray(state.broker_alive)
+    n_alive = int(alive.sum())
+    alive_sig = int(np.nonzero(~alive)[0].sum())
+    # 4 significant digits: fixtures built partly on-device differ CPU vs
+    # TPU in the last f32 bits, and the baseline is generated on CPU while
+    # the bench checks on TPU — the signature must survive that noise while
+    # still catching real spec/seed changes
+    load_sig = float(np.asarray(state.replica_load_leader, np.float64).sum())
+    names = ",".join(g.name for g in chain.goals)
+    raw = f"{s.B}x{s.P}x{n_valid}|{n_alive}|{alive_sig}|{load_sig:.4g}|{names}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
 def _greedy_objective(config_name, state, chain, budget_s, *, moves=400, dests=8, seed=0):
     """Greedy-oracle comparison numbers for one bench config.
 
@@ -100,9 +123,19 @@ def _greedy_objective(config_name, state, chain, budget_s, *, moves=400, dests=8
         with open(path) as f:
             entry = json.load(f).get(config_name)
         if entry is not None:
-            return float(entry["objective"]), float(entry["seconds"]), bool(
-                entry["converged"]
-            )
+            fp = _baseline_fingerprint(state, chain)
+            if entry.get("fingerprint") not in (None, fp):
+                print(
+                    f"greedy baseline {config_name} is STALE "
+                    f"(fingerprint {entry.get('fingerprint')} != {fp}); "
+                    "re-run scripts/gen_greedy_baselines.py — falling back "
+                    "to in-bench greedy",
+                    file=sys.stderr,
+                )
+            else:
+                return float(entry["objective"]), float(entry["seconds"]), bool(
+                    entry["converged"]
+                )
     from cruise_control_tpu.analyzer.greedy import greedy_optimize
 
     final, info = greedy_optimize(

@@ -202,17 +202,25 @@ class SamplingPlan:
     lead_cost: jax.Array  # f32 scalar: price per strayed partition leadership
 
 
-def partition_replica_table(state: ClusterState, max_rf: int | None = None) -> np.ndarray:
+def partition_replica_table(
+    state: ClusterState, max_rf: int | None = None, *, host: dict | None = None
+) -> np.ndarray:
     """i32[P, max_rf] replica indices per partition, padded with R.
 
     Membership never changes during optimization (only placement does), so
     this is built once on the host.  Mirrors reference model/Partition.java's
     replica list.  `max_rf` forces a uniform table width (the sharded engine
-    needs identical shapes across shards).
+    needs identical shapes across shards).  `host` supplies already-fetched
+    numpy copies (build_statics batches ALL device->host transfers into one
+    device_get — per-array np.asarray paid seconds of transfer sync at
+    500k-replica scale).
     """
-    valid = np.asarray(state.replica_valid)
-    part = np.asarray(state.replica_partition)
-    pos = np.asarray(state.replica_pos)
+    if host is not None:
+        valid, part, pos = host["replica_valid"], host["replica_partition"], host["replica_pos"]
+    else:
+        valid, part, pos = jax.device_get(
+            (state.replica_valid, state.replica_partition, state.replica_pos)
+        )
     P, R = state.shape.P, state.shape.R
     if max_rf is None:
         max_rf = 1
@@ -227,10 +235,21 @@ def partition_replica_table(state: ClusterState, max_rf: int | None = None) -> n
 
 
 def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineStatics:
-    """Host-side (numpy) preprocessing of one model generation."""
+    """Host-side (numpy) preprocessing of one model generation.
+
+    Every device array this needs comes down in ONE batched device_get —
+    at 500k-replica scale, per-array np.asarray syncs cost seconds each
+    and dominated engine construction.
+    """
     s = state.shape
-    alive = np.asarray(state.broker_valid) & np.asarray(state.broker_alive)
-    cap = np.asarray(state.broker_capacity)
+    h_keys = (
+        "broker_valid", "broker_alive", "broker_capacity", "broker_host",
+        "disk_alive", "disk_capacity", "replica_valid", "replica_partition",
+        "replica_pos",
+    )
+    h = dict(zip(h_keys, jax.device_get(tuple(getattr(state, k) for k in h_keys))))
+    alive = h["broker_valid"] & h["broker_alive"]
+    cap = h["broker_capacity"]
     dest = alive & options.dest_allowed(state)
     dest_idx = np.nonzero(dest)[0].astype(np.int32)
     if dest_idx.size == 0:
@@ -240,15 +259,15 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
     # cyclic pad to [B]: uniform sampling over the padded list stays uniform
     # over the allowed set while the array shape stays generation-invariant
     dest_pad = dest_idx[np.arange(s.B) % dest_idx.size]
-    host = np.asarray(state.broker_host)
-    valid_b = np.asarray(state.broker_valid)
+    host = h["broker_host"]
+    valid_b = h["broker_valid"]
     bph = np.bincount(host[valid_b], minlength=s.num_hosts)
     host_cap = np.zeros((s.num_hosts, NUM_RESOURCES), np.float32)
     np.add.at(host_cap, host[valid_b & alive], cap[valid_b & alive])
-    dmask = np.asarray(state.disk_alive) & alive[:, None]
+    dmask = h["disk_alive"] & alive[:, None]
     return EngineStatics(
         state=state,
-        part_replicas=jnp.asarray(partition_replica_table(state)),
+        part_replicas=jnp.asarray(partition_replica_table(state, host=h)),
         alive=jnp.asarray(alive),
         dest_ids=jnp.asarray(dest_pad),
         dest_ok=jnp.asarray(dest),
@@ -259,10 +278,10 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
         total_cap=jnp.asarray((cap * alive[:, None]).sum(0) + 1e-12, dtype=jnp.float32),
         n_alive=jnp.asarray(max(1.0, float(alive.sum())), jnp.float32),
         n_valid=jnp.asarray(
-            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
+            max(1.0, float(h["replica_valid"].sum())), jnp.float32
         ),
         total_disk_cap=jnp.asarray(
-            float((np.asarray(state.disk_capacity) * dmask).sum() + 1e-12), jnp.float32
+            float((h["disk_capacity"] * dmask).sum() + 1e-12), jnp.float32
         ),
     )
 

@@ -80,12 +80,19 @@ def greedy_optimize(
     deadline = t0 + time_budget_s if time_budget_s else None
     moves = 0
     hit_deadline = False
+    hit_move_cap = False
 
     for gi in range(len(chain.goals)):
         if hit_deadline:
             break
-        for _ in range(max_moves_per_goal):
+        moves_this_goal = 0
+        while True:
             if viol[gi] <= 1e-12:
+                break
+            if moves_this_goal >= max_moves_per_goal:
+                # ran out of per-goal move budget with the goal still
+                # violated — truncation, NOT convergence
+                hit_move_cap = True
                 break
             if deadline is not None and time.monotonic() > deadline:
                 hit_deadline = True
@@ -101,9 +108,10 @@ def greedy_optimize(
                 break
             cur, viol = move
             moves += 1
+            moves_this_goal += 1
     if return_info:
         return cur, dict(
-            converged=not hit_deadline,
+            converged=not hit_deadline and not hit_move_cap,
             moves=moves,
             seconds=round(time.monotonic() - t0, 1),
         )

@@ -60,7 +60,10 @@ class OptimizerResult:
     def data_to_move(self) -> float:
         return sum(p.inter_broker_data_to_move for p in self.proposals)
 
-    def violated_goals_after(self, tol: float = 1e-9) -> list[str]:
+    def violated_goals_after(self, tol: float = 1e-6) -> list[str]:
+        """Default tol matches balancedness_score's goal-satisfied epsilon
+        (analyzer/objective.py) — a response must not claim balancedness 100
+        while listing goals 'violated' by f32 noise."""
         return [n for n, v in zip(self.goal_names, self.violations_after) if v > tol]
 
     def summary(self) -> dict:

@@ -60,25 +60,32 @@ def extract_proposals(before: ClusterState, after: ClusterState) -> list[Executi
     LinkedIn scale a rebalance touches >100k partitions and per-partition
     numpy slicing would dominate the optimizer wall-clock.
     """
+    import jax
+
     from cruise_control_tpu.analyzer.engine import partition_replica_table
 
-    valid = np.asarray(before.replica_valid)
-    topic = np.asarray(before.replica_topic)
-    b_old = np.asarray(before.replica_broker)
-    b_new = np.asarray(after.replica_broker)
-    l_old = np.asarray(before.replica_is_leader)
-    l_new = np.asarray(after.replica_is_leader)
-    d_old = np.asarray(before.replica_disk)
-    d_new = np.asarray(after.replica_disk)
-    disk_bytes = np.asarray(before.replica_load_leader)[:, int(Resource.DISK)]
+    # one batched device->host transfer (per-array np.asarray syncs 10x)
+    (
+        valid, topic, b_old, b_new, l_old, l_new, d_old, d_new, load_l,
+        part_arr, pos_arr,
+    ) = jax.device_get((
+        before.replica_valid, before.replica_topic, before.replica_broker,
+        after.replica_broker, before.replica_is_leader, after.replica_is_leader,
+        before.replica_disk, after.replica_disk, before.replica_load_leader,
+        before.replica_partition, before.replica_pos,
+    ))
+    disk_bytes = load_l[:, int(Resource.DISK)]
+    host = {
+        "replica_valid": valid, "replica_partition": part_arr, "replica_pos": pos_arr,
+    }
 
     changed = valid & ((b_old != b_new) | (l_old != l_new) | (d_old != d_new))
     if not changed.any():
         return []
-    touched = np.unique(np.asarray(before.replica_partition)[changed])
+    touched = np.unique(part_arr[changed])
 
     # padded per-partition replica rows, already in preferred (pos) order
-    table = partition_replica_table(before)[touched]  # [N, max_rf]
+    table = partition_replica_table(before, host=host)[touched]  # [N, max_rf]
     R = before.shape.R
     mask = table < R  # [N, max_rf]
     rows = np.minimum(table, R - 1)

@@ -123,6 +123,16 @@ def _monitor_defs() -> ConfigDef:
              in_range(lo=1), group=g)
     d.define("metric.sampling.interval.ms", T.LONG, 120_000, I.MEDIUM, "sampler cadence",
              in_range(lo=1), group=g)
+    from cruise_control_tpu.monitor.reporter_sampler import (
+        CruiseControlMetricsReporterSampler as _sampler,
+    )
+
+    d.define("monitor.excluded.topics.pattern", T.STRING,
+             _sampler.DEFAULT_EXCLUDED,  # ONE source of truth with the sampler
+             I.MEDIUM,
+             "regex of topics invisible to the cluster model — the service's "
+             "own metrics/sample-store topics must not be modeled as workload",
+             group=g)
     d.define("num.metric.fetchers", T.INT, 1, I.MEDIUM,
              "parallel metric fetcher threads; each samples a disjoint "
              "partition set per round (reference num.metric.fetchers)",
@@ -217,6 +227,9 @@ def _webserver_defs() -> ConfigDef:
              "htpasswd-style user:password[:role] lines", group=g)
     d.define("jwt.secret.key", T.STRING, None, I.MEDIUM,
              "enables HS256 bearer-token auth when set", group=g)
+    d.define("jwt.authentication.certificate.location", T.STRING, None, I.MEDIUM,
+             "PEM public key or X.509 certificate enabling RS256 bearer-token "
+             "auth (reference servlet/security/jwt/JwtAuthenticator)", group=g)
     d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
              "POSTs park in the review purgatory first", group=g)
     # TLS for the REST listener (reference KafkaCruiseControlApp.java:100-120
@@ -229,6 +242,19 @@ def _webserver_defs() -> ConfigDef:
              "PEM private-key file (defaults to the certificate file)", group=g)
     d.define("webserver.ssl.key.password", T.STRING, None, I.LOW,
              "private-key passphrase", group=g)
+    # SASL toward the Kafka cluster (reference rides JAAS,
+    # config/cruise_control_jaas.conf_template; the wire client speaks
+    # SaslHandshake + SCRAM itself)
+    d.define("sasl.mechanism", T.STRING, None, I.MEDIUM,
+             "PLAIN | SCRAM-SHA-256 | SCRAM-SHA-512; unset disables SASL",
+             group=g)
+    d.define("sasl.username", T.STRING, None, I.MEDIUM,
+             "SASL username toward the Kafka cluster", group=g)
+    d.define("sasl.password", T.STRING, None, I.MEDIUM,
+             "SASL password (prefer sasl.password.file in production)", group=g)
+    d.define("sasl.password.file", T.STRING, None, I.MEDIUM,
+             "file holding the SASL password (overrides sasl.password)",
+             group=g)
     return d
 
 

@@ -170,9 +170,15 @@ class DiskFailureDetector:
 class SlowBrokerFinder:
     """Reference detector/SlowBrokerFinder.java:99,255-267.
 
-    A broker is slow when its latency-ish metric is simultaneously high
-    versus its own history (percentile) and versus current peers (ratio to
-    the peer median).  Persistent slowness escalates from demote to remove.
+    Multi-family evidence: each broker reports SEVERAL latency-ish metric
+    families (byte-rate-normalized log-flush time, request-latency means,
+    queue sizes — reference collectSlowBrokerMetrics uses byte rates AND
+    request latencies).  A family votes "slow" when the broker is
+    simultaneously high versus its own history (percentile) and versus
+    current peers (ratio to the peer median); a broker is flagged only when
+    a MAJORITY of its evaluated families agree — one noisy metric spiking
+    cannot false-positive a broker.  Persistent slowness escalates from
+    demote to remove.
     """
 
     def __init__(
@@ -188,34 +194,69 @@ class SlowBrokerFinder:
         self.peer_ratio = peer_ratio
         self.history_windows = history_windows
         self.removal_threshold = removal_threshold
-        self._history: dict[int, list[float]] = {}
+        self._history: dict[tuple[int, str], list[float]] = {}
         self._strikes: dict[int, int] = {}
 
-    def detect(self, broker_metric: dict[int, float]) -> SlowBrokers | None:
-        """broker_metric: current latency metric per alive broker (e.g.
-        BROKER_LOG_FLUSH_TIME_MS_MEAN window average)."""
-        if len(broker_metric) < 2:
-            return None
-        values = np.asarray(list(broker_metric.values()))
-        peer_median = float(np.median(values))
-        slow: dict[int, float] = {}
-        for b, v in broker_metric.items():
-            hist = self._history.setdefault(b, [])
+    def _family_votes(self, family: str, values: dict[int, float]) -> dict[int, float]:
+        """-> broker -> peer-ratio for brokers this family votes slow."""
+        peer_median = float(np.median(np.asarray(list(values.values()))))
+        votes: dict[int, float] = {}
+        for b, v in values.items():
+            hist = self._history.setdefault((b, family), [])
             slow_vs_peers = peer_median > 0 and v > self.peer_ratio * peer_median
             slow_vs_history = (
                 len(hist) >= 3 and v > float(np.percentile(hist, self.history_percentile))
             )
             if slow_vs_peers and (slow_vs_history or len(hist) < 3):
-                slow[b] = v / max(peer_median, 1e-9)
-                self._strikes[b] = self._strikes.get(b, 0) + 1
+                votes[b] = v / max(peer_median, 1e-9)
                 # anomalous samples stay out of the clean history so a
                 # persistently slow broker keeps comparing against its
                 # healthy baseline (reference keeps separate normal-state
                 # history, SlowBrokerFinder.java:255-267)
             else:
-                self._strikes.pop(b, None)
                 hist.append(v)
                 del hist[: -self.history_windows]
+        return votes
+
+    def detect(
+        self, broker_metrics: dict[int, float] | dict[int, dict[str, float]]
+    ) -> SlowBrokers | None:
+        """broker_metrics: per alive broker, either one latency value
+        (single-family compatibility) or {family: value} evidence."""
+        if len(broker_metrics) < 2:
+            return None
+        sample = next(iter(broker_metrics.values()))
+        if not isinstance(sample, dict):
+            broker_metrics = {b: {"metric": v} for b, v in broker_metrics.items()}
+
+        # evaluate each family across the brokers reporting it
+        by_family: dict[str, dict[int, float]] = {}
+        for b, fams in broker_metrics.items():
+            for f, v in fams.items():
+                by_family.setdefault(f, {})[b] = v
+        votes: dict[int, list[float]] = {}
+        evaluated: dict[int, int] = {}
+        for f, values in by_family.items():
+            # a family nobody reports a nonzero value for carries no signal
+            # — counting it toward the evidence bar would let unpopulated
+            # metric columns (a sampler that lacks the source) silently
+            # raise the majority threshold past what real data can reach
+            if len(values) < 2 or all(v == 0 for v in values.values()):
+                continue
+            for b in values:
+                evaluated[b] = evaluated.get(b, 0) + 1
+            for b, ratio in self._family_votes(f, values).items():
+                votes.setdefault(b, []).append(ratio)
+
+        slow: dict[int, float] = {}
+        for b, ratios in votes.items():
+            need = max(1, evaluated.get(b, 1) // 2 + 1)  # STRICT majority
+            if len(ratios) >= need:
+                slow[b] = float(np.mean(ratios))
+                self._strikes[b] = self._strikes.get(b, 0) + 1
+        for b in evaluated:
+            if b not in slow:
+                self._strikes.pop(b, None)
         if not slow:
             return None
         remove = any(self._strikes.get(b, 0) >= self.removal_threshold for b in slow)

@@ -215,13 +215,17 @@ class Executor:
             # verify against the topology and re-submit dropped tasks, up to
             # a bound, before declaring them DEAD.
             in_progress = self.admin.in_progress_reassignments()
+            # ONE topology snapshot per tick feeds both the landed-check and
+            # the dead-broker sweep below (on a real cluster each topology()
+            # is a wire Metadata round trip)
+            topo = self.admin.topology()
             placement = None
             for key, task in list(in_flight.items()):
                 if key not in in_progress:
                     if placement is None:
                         placement = {
                             (p.topic, p.partition): set(p.replicas)
-                            for p in self.admin.topology().partitions
+                            for p in topo.partitions
                         }
                     if placement.get(key) == set(task.proposal.new_replicas):
                         task.completed(now_ms())
@@ -250,14 +254,14 @@ class Executor:
                 ):
                     task.alert_time_ms = now_ms()
             # mark tasks dead when a destination broker died mid-move
-            alive = self.admin.topology().alive_broker_ids()
+            alive = topo.alive_broker_ids()
             for key, task in list(in_flight.items()):
                 if not set(task.proposal.new_replicas) <= alive:
                     task.kill(now_ms())
                     del in_flight[key]
 
             # drain new tasks within caps
-            ready = self._ready_brokers(options, in_flight)
+            ready = self._ready_brokers(options, in_flight, topo)
             new_tasks = planner.get_inter_broker_replica_movement_tasks(
                 ready, set(in_flight)
             )
@@ -350,9 +354,12 @@ class Executor:
                 task.aborted(now)
             in_flight.clear()
 
-    def _ready_brokers(self, options: ExecutionOptions, in_flight) -> dict[int, int]:
+    def _ready_brokers(
+        self, options: ExecutionOptions, in_flight, topo=None
+    ) -> dict[int, int]:
         cap = options.concurrent_partition_movements_per_broker
-        topo = self.admin.topology()
+        if topo is None:
+            topo = self.admin.topology()
         alive = topo.alive_broker_ids()
         used: dict[int, int] = {}
         for task in in_flight.values():

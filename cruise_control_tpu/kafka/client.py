@@ -31,21 +31,76 @@ class KafkaProtocolError(Exception):
 class BrokerConnection:
     """One socket to one broker; request/response are strictly serial."""
 
-    def __init__(self, host: str, port: int, client_id: str, timeout_s: float):
+    def __init__(
+        self, host: str, port: int, client_id: str, timeout_s: float, sasl=None
+    ):
         self.host = host
         self.port = port
         self.client_id = client_id
         self.timeout_s = timeout_s
+        #: optional SaslCredentials — every (re)connected socket
+        #: authenticates before it carries any other request
+        self.sasl = sasl
         self._sock: socket.socket | None = None
         self._correlation = 0
         self._lock = threading.Lock()
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(
+            sock = socket.create_connection(
                 (self.host, self.port), timeout=self.timeout_s
             )
+            if self.sasl is not None:
+                try:
+                    self._authenticate(sock)
+                except BaseException:
+                    sock.close()
+                    raise
+            self._sock = sock
         return self._sock
+
+    def _raw_request(self, sock: socket.socket, api: proto.Api, body: dict) -> dict:
+        """One framed request on an explicit socket — used during SASL
+        setup, before the connection is available to request()."""
+        self._correlation += 1
+        cid = self._correlation
+        sock.sendall(proto.encode_request(api, cid, self.client_id, body))
+        (size,) = struct.unpack(">i", self._read_exact(sock, 4))
+        got_cid, resp = proto.decode_response(api, self._read_exact(sock, size))
+        if got_cid != cid:
+            raise ConnectionError(f"correlation mismatch: sent {cid}, got {got_cid}")
+        return resp
+
+    def _authenticate(self, sock: socket.socket) -> None:
+        """SaslHandshake + SaslAuthenticate exchange (KIP-152 framing)."""
+        from cruise_control_tpu.kafka.sasl import ScramClient
+
+        creds = self.sasl
+        hs = self._raw_request(sock, proto.SASL_HANDSHAKE, {"mechanism": creds.mechanism})
+        if hs["error_code"] != NONE:
+            raise KafkaProtocolError(
+                "SaslHandshake", hs["error_code"],
+                f"mechanism {creds.mechanism} rejected; broker offers "
+                f"{hs.get('mechanisms')}",
+            )
+
+        def auth_round(payload: bytes) -> bytes:
+            resp = self._raw_request(
+                sock, proto.SASL_AUTHENTICATE, {"auth_bytes": payload}
+            )
+            if resp["error_code"] != NONE:
+                raise KafkaProtocolError(
+                    "SaslAuthenticate", resp["error_code"], resp.get("error_message")
+                )
+            return resp["auth_bytes"]
+
+        if creds.mechanism == "PLAIN":
+            auth_round(f"\0{creds.username}\0{creds.password}".encode())
+            return
+        scram = ScramClient(creds)
+        server_first = auth_round(scram.first())
+        server_final = auth_round(scram.final(server_first))
+        scram.verify(server_final)
 
     def close(self) -> None:
         if self._sock is not None:
@@ -107,12 +162,15 @@ class KafkaAdminClient:
         *,
         client_id: str = "cruise-control-tpu",
         timeout_s: float = 30.0,
+        sasl=None,
     ):
         if not bootstrap:
             raise ValueError("bootstrap servers required")
         self.bootstrap = bootstrap
         self.client_id = client_id
         self.timeout_s = timeout_s
+        #: optional kafka.sasl.SaslCredentials applied to every connection
+        self.sasl = sasl
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}  # node_id -> addr
         self._controller_id: int | None = None
@@ -126,7 +184,9 @@ class KafkaAdminClient:
         with self._route_lock:
             conn = self._conns.get(addr)
             if conn is None:
-                conn = BrokerConnection(addr[0], addr[1], self.client_id, self.timeout_s)
+                conn = BrokerConnection(
+                    addr[0], addr[1], self.client_id, self.timeout_s, sasl=self.sasl
+                )
                 self._conns[addr] = conn
             return conn
 

@@ -36,6 +36,7 @@ from cruise_control_tpu.kafka.codec import (
     Int16,
     Int32,
     Int64,
+    Bytes,
     NullableBytes,
     NullableString,
     String,
@@ -421,6 +422,30 @@ DESCRIBE_LOG_DIRS = Api(
     ),
 )
 
+# ------------------------------------------------------------------- SASL
+
+#: SaslHandshake v1 + SaslAuthenticate v0 (KIP-152 framed authentication;
+#: the reference rides the JVM client's identical exchange via JAAS,
+#: config/cruise_control_jaas.conf_template)
+SASL_HANDSHAKE = Api(
+    "SaslHandshake", 17, 1, False,
+    request=Struct(("mechanism", String)),
+    response=Struct(
+        ("error_code", Int16),
+        ("mechanisms", Array(String)),
+    ),
+)
+
+SASL_AUTHENTICATE = Api(
+    "SaslAuthenticate", 36, 0, False,
+    request=Struct(("auth_bytes", Bytes)),
+    response=Struct(
+        ("error_code", Int16),
+        ("error_message", NullableString),
+        ("auth_bytes", Bytes),
+    ),
+)
+
 ALL_APIS = [
     PRODUCE, FETCH, LIST_OFFSETS, CREATE_TOPICS,
     API_VERSIONS, METADATA, ALTER_PARTITION_REASSIGNMENTS,
@@ -428,7 +453,11 @@ ALL_APIS = [
     DESCRIBE_CONFIGS, ALTER_REPLICA_LOG_DIRS, DESCRIBE_LOG_DIRS,
 ]
 
-BY_KEY_VERSION = {(a.key, a.version): a for a in ALL_APIS}
+#: negotiated only when SASL is configured — deliberately NOT part of the
+#: check_api_support sweep (a PLAINTEXT listener does not advertise them)
+SASL_APIS = [SASL_HANDSHAKE, SASL_AUTHENTICATE]
+
+BY_KEY_VERSION = {(a.key, a.version): a for a in ALL_APIS + SASL_APIS}
 
 
 # ------------------------------------------------------------------ headers

@@ -78,11 +78,17 @@ class LoadMonitor:
         max_concurrent_model_generations: int = 1,
         replica_capacity: int | None = None,
         regression=None,
+        topic_filter=None,
     ):
         self.metadata = metadata
         self.capacity_resolver = capacity_resolver
         self.partition_aggregator = partition_aggregator
         self.metric_def = metric_def
+        #: optional str -> bool predicate; topics failing it are invisible
+        #: to the cluster model (the service's OWN metrics/sample-store
+        #: topics must not be modeled as workload — the reference processor
+        #: skips its metrics topic the same way)
+        self.topic_filter = topic_filter
         #: optional LinearRegressionModelParameters — once trained (via the
         #: task runner's /train flow) it replaces the static-coefficient
         #: follower-CPU estimate (reference ModelUtils.java:84)
@@ -180,6 +186,15 @@ class LoadMonitor:
         self, requirements: ModelCompletenessRequirements
     ) -> ClusterState:
         topology = self.metadata.refresh()
+        if self.topic_filter is not None:
+            import dataclasses as _dc
+
+            topology = _dc.replace(
+                topology,
+                partitions=tuple(
+                    p for p in topology.partitions if self.topic_filter(p.topic)
+                ),
+            )
         agg = self.partition_aggregator.aggregate(
             AggregationOptions(
                 min_valid_entity_ratio=requirements.min_monitored_partitions_percentage

@@ -41,11 +41,23 @@ _BROKER_METRIC_MAP = {
     MetricType.BROKER_LOG_FLUSH_RATE: "BROKER_LOG_FLUSH_RATE",
     MetricType.BROKER_LOG_FLUSH_TIME_MS_MAX: "BROKER_LOG_FLUSH_TIME_MS_MAX",
     MetricType.BROKER_LOG_FLUSH_TIME_MS_MEAN: "BROKER_LOG_FLUSH_TIME_MS_MEAN",
+    # slow-broker evidence + training inputs (reference
+    # SlowBrokerFinder.java:99 byte rates and request latencies)
+    MetricType.BROKER_PRODUCE_LOCAL_TIME_MS_MEAN: "BROKER_PRODUCE_LOCAL_TIME_MS_MEAN",
+    MetricType.BROKER_PRODUCE_LOCAL_TIME_MS_MAX: "BROKER_PRODUCE_LOCAL_TIME_MS_MAX",
+    MetricType.ALL_TOPIC_BYTES_IN: "LEADER_BYTES_IN",
+    MetricType.ALL_TOPIC_BYTES_OUT: "LEADER_BYTES_OUT",
+    MetricType.ALL_TOPIC_REPLICATION_BYTES_IN: "REPLICATION_BYTES_IN_RATE",
+    MetricType.ALL_TOPIC_REPLICATION_BYTES_OUT: "REPLICATION_BYTES_OUT_RATE",
 }
 
 
 class CruiseControlMetricsReporterSampler:
     """MetricSampler over an InMemoryTransport (Kafka consumer in prod)."""
+
+    #: the service's own topics never become workload samples (the
+    #: reference CruiseControlMetricsProcessor skips its metrics topic)
+    DEFAULT_EXCLUDED = r"^__(KafkaCruiseControl|CruiseControlMetrics).*"
 
     def __init__(
         self,
@@ -53,10 +65,17 @@ class CruiseControlMetricsReporterSampler:
         topology_provider,
         *,
         metric_def: MetricDef = KAFKA_METRIC_DEF,
+        topic_filter=None,
     ):
+        import re
+
         self.transport = transport
         self.topology_provider = topology_provider
         self.metric_def = metric_def
+        if topic_filter is None:
+            rx = re.compile(self.DEFAULT_EXCLUDED)
+            topic_filter = lambda name: not rx.match(str(name))  # noqa: E731
+        self.topic_filter = topic_filter
         self._topic_ids: dict[str, int] = {}
 
     def _topic_id(self, topic: str) -> int:
@@ -150,7 +169,8 @@ class CruiseControlMetricsReporterSampler:
         # leader partitions per (broker, topic) for byte attribution
         leaders: dict[tuple[int, str], list] = defaultdict(list)
         for p in topo.partitions:
-            leaders[(p.leader, p.topic)].append(p)
+            if self.topic_filter(p.topic):
+                leaders[(p.leader, p.topic)].append(p)
 
         t_mid = (start_ms + end_ms) // 2
         partition_samples: list[MetricSample] = []

@@ -240,7 +240,7 @@ class MetricFetcherManager:
         assignor: MetricSamplerPartitionAssignor | None = None,
         sensors=None,
     ):
-        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.sensors import SensorRegistry
 
         self.sampler = sampler
         self.partition_aggregator = partition_aggregator
@@ -249,7 +249,12 @@ class MetricFetcherManager:
         self.sampling_interval_ms = sampling_interval_ms
         self.num_fetchers = max(1, num_fetchers)
         self.assignor = assignor or MetricSamplerPartitionAssignor()
-        self.sensors = sensors if sensors is not None else REGISTRY
+        # per-instance default, NOT the module-global registry: the health
+        # gauges below close over self, so a global default would let a
+        # second manager silently take over the gauge names and would pin
+        # every stopped manager alive via the registry (the facade scopes
+        # its registry per instance for the same reason)
+        self.sensors = sensors if sensors is not None else SensorRegistry()
         self._pool = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()

@@ -154,8 +154,10 @@ class CruiseControl:
         )
 
         def slow_detect():
-            """Feed the finder the broker log-flush latency window average
-            (reference SlowBrokerFinder.java:99 metric sources)."""
+            """Feed the finder multi-family broker evidence: byte-rate-
+            normalized log-flush time plus raw request latencies and queue
+            depth (reference SlowBrokerFinder.java:99 collects byte rates
+            AND request latencies; one family spiking must not flag)."""
             runner = self.task_runner
             agg = getattr(getattr(runner, "fetcher", None), "broker_aggregator", None)
             if agg is None or not agg.num_entities():
@@ -164,19 +166,46 @@ class CruiseControl:
                 res = agg.aggregate()
             except ValueError:
                 return None
-            try:
-                mid = agg.metric_def.metric_id("BROKER_LOG_FLUSH_TIME_MS_MEAN")
-            except KeyError:
+            m = agg.metric_def
+
+            def mid(name):
+                try:
+                    return m.metric_id(name)
+                except KeyError:
+                    return None
+
+            flush = mid("BROKER_LOG_FLUSH_TIME_MS_MEAN")
+            if flush is None:
                 return None
-            latest: dict[int, float] = {}
+            families = {
+                "log_flush_time_ms_mean": flush,
+                "produce_local_time_ms_mean": mid("BROKER_PRODUCE_LOCAL_TIME_MS_MEAN"),
+                "request_queue_size": mid("BROKER_REQUEST_QUEUE_SIZE"),
+            }
+            bytes_ids = [mid("LEADER_BYTES_IN"), mid("REPLICATION_BYTES_IN_RATE")]
+            evidence: dict[int, dict[str, float]] = {}
             for i, entity in enumerate(agg.entities()):
                 valid = res.window_valid[i]
-                if valid.any():
-                    w = int(np.nonzero(valid)[0][0])  # newest valid window
-                    latest[int(getattr(entity, "broker_id", entity))] = float(
-                        res.values[i, w, mid]
-                    )
-            anomaly = slow.detect(latest)
+                if not valid.any():
+                    continue
+                w = int(np.nonzero(valid)[0][0])  # newest valid window
+                row = res.values[i, w]
+                fams: dict[str, float] = {}
+                for name, idx in families.items():
+                    if idx is not None:
+                        fams[name] = float(row[idx])
+                # byte-normalized flush time REPLACES the raw value when a
+                # byte rate exists (reference divides latency by the byte
+                # rate so a busier broker is not "slower"); keeping both
+                # would double-count one correlated signal toward the
+                # majority bar
+                rate = sum(float(row[j]) for j in bytes_ids if j is not None)
+                if rate > 0:
+                    fams["log_flush_time_per_mb"] = fams.pop(
+                        "log_flush_time_ms_mean"
+                    ) / max(rate, 1e-9)
+                evidence[int(getattr(entity, "broker_id", entity))] = fams
+            anomaly = slow.detect(evidence)
             # removal (decommission + rebuild) is destructive; the dedicated
             # switch gates it regardless of strike count (reference
             # AnomalyDetectorConfig slow.broker removal switches)
@@ -217,12 +246,19 @@ class CruiseControl:
         self.anomaly_detector.shutdown()
 
     def _precompute_loop(self):
-        """Reference GoalOptimizer.run precompute loop (GoalOptimizer.java:124-175)."""
-        while not self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
+        """Reference GoalOptimizer.run precompute loop (GoalOptimizer.java:124-175).
+
+        The FIRST pass runs immediately: it compiles the engine for the
+        live cluster shape and fills the proposal cache, so the first user
+        request pays cache-hit latency instead of the cold trace+compile+
+        optimize warmup."""
+        while True:
             try:
                 self.proposals(OperationProgress(), ignore_cache=True)
             except Exception:  # noqa: BLE001 — precompute failures surface on demand
                 pass
+            if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
+                return
 
     # ------------------------------------------------------------------
     # proposal computation + cache (reference optimizations():276-324,493)
@@ -493,6 +529,7 @@ class CruiseControl:
         drives evacuation of dead brokers/disks during a normal optimize."""
         result = self.proposals(progress, ignore_cache=True)
         out = result.summary()
+        out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
             out["execution"] = self._execute(result, progress)
         return out

@@ -76,21 +76,40 @@ def build_service(
     from cruise_control_tpu.monitor.sampling import PartitionEntity
     from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
 
+    import re
+
+    excluded_rx = re.compile(config.get("monitor.excluded.topics.pattern"))
+
+    def topic_filter(name: str) -> bool:
+        return not excluded_rx.match(str(name))
+
+    # one knob governs every layer: samplers that support a topic filter
+    # (CruiseControlMetricsReporterSampler) get the CONFIGURED pattern, not
+    # their built-in default — otherwise the model and the sample stream
+    # silently diverge on what "excluded" means
+    if hasattr(sampler, "topic_filter"):
+        sampler.topic_filter = topic_filter
+
     regression = LinearRegressionModelParameters()
-    monitor = LoadMonitor(metadata, capacity_resolver, partition_agg, regression=regression)
+    monitor = LoadMonitor(
+        metadata, capacity_resolver, partition_agg,
+        regression=regression, topic_filter=topic_filter,
+    )
 
     if partitions_fn is None:
         if hasattr(sampler, "all_partition_entities"):
             partitions_fn = sampler.all_partition_entities
         else:
             # derive entities from metadata, with the same first-appearance
-            # topic-id mapping LoadMonitor._build_state uses
+            # topic-id mapping LoadMonitor._build_state uses (and the same
+            # internal-topic exclusion)
             def partitions_fn():
                 topo = metadata.topology()
                 tids: dict = {}
                 return [
                     PartitionEntity(tids.setdefault(p.topic, len(tids)), p.partition)
                     for p in topo.partitions
+                    if topic_filter(p.topic)
                 ]
 
     task_runner = LoadMonitorTaskRunner(
@@ -166,8 +185,26 @@ def build_kafka_service(
         KafkaMetadataProvider,
     )
 
+    sasl = None
+    if config.get("sasl.mechanism"):
+        from cruise_control_tpu.kafka.sasl import SaslCredentials
+
+        password = config.get("sasl.password")
+        pw_file = config.get("sasl.password.file")
+        if pw_file:
+            with open(pw_file) as f:
+                password = f.read().strip()
+        if not config.get("sasl.username") or password is None:
+            raise ValueError(
+                "sasl.mechanism set but sasl.username/sasl.password missing"
+            )
+        sasl = SaslCredentials(
+            username=config.get("sasl.username"),
+            password=password,
+            mechanism=config.get("sasl.mechanism"),
+        )
     client = KafkaAdminClient(
-        parse_bootstrap_servers(bootstrap_servers), client_id=client_id
+        parse_bootstrap_servers(bootstrap_servers), client_id=client_id, sasl=sasl
     )
     # fail fast with the full list of unsupported APIs rather than on the
     # first mid-operation decode error against an old broker

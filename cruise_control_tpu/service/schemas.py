@@ -1,0 +1,196 @@
+"""Declared response schemas for every REST endpoint.
+
+Reference: servlet/response/JsonResponseField.java:1 annotates every
+response class's fields and ResponseTest.java:1 asserts each response
+declares its schema — API drift fails a test instead of surprising
+clients.  Here the declaration is data (FIELDS per endpoint) and
+`validate_response` is the single checker the schema test drives against
+a LIVE service (tests/test_schemas.py).
+
+A schema lists top-level fields: (name, types, required).  `item_schema`
+validates dict items of list fields one level down.  Endpoints whose
+successful body is an operation summary share OPTIMIZATION_RESULT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    types: tuple
+    required: bool = True
+    item_schema: "Schema | None" = None  # for list fields holding dicts
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple
+    #: False -> unknown top-level keys are schema violations
+    allow_extra: bool = False
+
+    def field_names(self):
+        return {f.name for f in self.fields}
+
+
+NUM = (int, float)
+STR = (str,)
+BOOL = (bool,)
+LIST = (list,)
+DICT = (dict,)
+
+PROPOSAL_ITEM = Schema((
+    Field("topicPartition", DICT),
+    Field("oldLeader", NUM),
+    Field("oldReplicas", LIST),
+    Field("newReplicas", LIST),
+))
+
+#: shared summary of every optimization-shaped response
+#: (OptimizerResult.summary() + facade additions)
+OPTIMIZATION_RESULT = Schema((
+    Field("numReplicaMovements", NUM),
+    Field("numLeaderMovements", NUM),
+    Field("dataToMoveMB", NUM),
+    Field("balancednessBefore", NUM),
+    Field("balancednessAfter", NUM),
+    Field("objectiveBefore", NUM),
+    Field("objectiveAfter", NUM),
+    Field("violatedGoalsAfter", LIST),
+    Field("wallSeconds", NUM),
+    Field("proposals", LIST, item_schema=PROPOSAL_ITEM),
+    Field("execution", DICT, required=False),
+    Field("_userTaskId", STR, required=False),
+))
+
+BROKER_LOAD_ITEM = Schema((
+    Field("Broker", NUM),
+    Field("BrokerState", STR),
+    Field("Leaders", NUM),
+    Field("Replicas", NUM),
+    Field("CPU", NUM), Field("CPUPct", NUM),
+    Field("DISK", NUM), Field("DISKPct", NUM),
+    Field("NW_IN", NUM), Field("NW_INPct", NUM),
+    Field("NW_OUT", NUM), Field("NW_OUTPct", NUM),
+))
+
+RESPONSE_SCHEMAS: dict[str, Schema] = {
+    "state": Schema((
+        Field("version", NUM, required=False),  # API-version negotiation
+        Field("MonitorState", DICT, required=False),
+        Field("ExecutorState", DICT, required=False),
+        Field("AnalyzerState", DICT, required=False),
+        Field("AnomalyDetectorState", DICT, required=False),
+        Field("Sensors", DICT, required=False),
+    )),
+    "kafka_cluster_state": Schema((
+        Field("KafkaBrokerState", DICT),
+        Field("KafkaPartitionState", DICT),
+    )),
+    "load": Schema((
+        Field("brokers", LIST, item_schema=BROKER_LOAD_ITEM),
+        Field("hosts", LIST),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "partition_load": Schema((
+        Field("records", LIST),
+        Field("resource", STR),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "proposals": OPTIMIZATION_RESULT,
+    "rebalance": OPTIMIZATION_RESULT,
+    "add_broker": OPTIMIZATION_RESULT,
+    "remove_broker": Schema(
+        tuple(f for f in OPTIMIZATION_RESULT.fields if f.name != "proposals")
+    ),
+    "fix_offline_replicas": OPTIMIZATION_RESULT,
+    "demote_broker": Schema((
+        Field("numLeaderMovements", NUM),
+        Field("proposals", LIST, item_schema=PROPOSAL_ITEM),
+        Field("execution", DICT, required=False),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "topic_configuration": Schema((
+        Field("numProposals", NUM),
+        Field("proposals", LIST, item_schema=PROPOSAL_ITEM),
+        Field("execution", DICT, required=False),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "user_tasks": Schema((Field("userTasks", LIST),)),
+    "review_board": Schema((Field("requestInfo", LIST),)),
+    "review": Schema((Field("requestInfo", LIST),)),
+    "bootstrap": Schema((
+        Field("mode", STR),
+        Field("samplesAbsorbed", NUM),
+        Field("monitorState", STR),
+        Field("bootstrapProgressPct", NUM),
+        Field("trainingState", DICT),
+        Field("totalSamples", NUM),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "train": Schema((
+        Field("trained", BOOL),
+        Field("_userTaskId", STR, required=False),
+    ), allow_extra=True),  # regression state keys are the model's business
+    "stop_proposal_execution": Schema((
+        Field("message", STR),
+        Field("force", BOOL),
+    )),
+    "pause_sampling": Schema((Field("message", STR),)),
+    "resume_sampling": Schema((Field("message", STR),)),
+    "admin": Schema((
+        Field("selfHealingEnabled", LIST, required=False),
+        Field("recentlyRemovedBrokers", LIST, required=False),
+    )),
+}
+
+#: non-200 body shapes (shared by every endpoint)
+ASYNC_PROGRESS_SCHEMA = Schema((  # 202
+    Field("progress", LIST),
+    Field("_userTaskId", STR),
+))
+ERROR_SCHEMA = Schema((  # 4xx/5xx
+    Field("errorMessage", STR),
+    Field("_userTaskId", STR, required=False),
+), allow_extra=True)
+
+
+def validate_response(endpoint: str, payload: dict, *, status: int = 200) -> list[str]:
+    """-> list of schema violations (empty = conforming)."""
+    if status == 202:
+        schema = ASYNC_PROGRESS_SCHEMA
+    elif status >= 400:
+        schema = ERROR_SCHEMA
+    else:
+        schema = RESPONSE_SCHEMAS.get(endpoint)
+        if schema is None:
+            return [f"no declared schema for endpoint {endpoint!r}"]
+    return _check(schema, payload, where=endpoint)
+
+
+def _check(schema: Schema, payload, *, where: str) -> list[str]:
+    problems: list[str] = []
+    if not isinstance(payload, dict):
+        return [f"{where}: expected object, got {type(payload).__name__}"]
+    for f in schema.fields:
+        if f.name not in payload:
+            if f.required:
+                problems.append(f"{where}: missing required field {f.name!r}")
+            continue
+        v = payload[f.name]
+        if v is not None and not isinstance(v, f.types):
+            problems.append(
+                f"{where}.{f.name}: expected {'/'.join(t.__name__ for t in f.types)},"
+                f" got {type(v).__name__}"
+            )
+            continue
+        if f.item_schema is not None and isinstance(v, list):
+            for i, item in enumerate(v[:5]):  # spot-check the head
+                problems += _check(f.item_schema, item, where=f"{where}.{f.name}[{i}]")
+    if not schema.allow_extra:
+        extra = set(payload) - schema.field_names() - {"_userTaskId"}
+        if extra:
+            problems.append(f"{where}: undeclared fields {sorted(extra)}")
+    return problems

@@ -125,6 +125,78 @@ def jwt_decode(token: str, secret: str) -> dict | None:
     return claims
 
 
+def jwt_decode_rs256(token: str, public_key) -> dict | None:
+    """Verify an RS256 (RSASSA-PKCS1-v1_5 / SHA-256) JWT against a public
+    key — certificate-based tokens, reference
+    servlet/security/jwt/JwtAuthenticator.java:1 (shared-secret HS256 across
+    services is a deployment blocker; the issuer signs with its private key
+    and the service verifies with the cert)."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(header_b64))
+        if header.get("alg") != "RS256":
+            return None
+        public_key.verify(
+            _b64url_decode(sig_b64),
+            f"{header_b64}.{payload_b64}".encode(),
+            padding.PKCS1v15(),
+            hashes.SHA256(),
+        )
+        claims = json.loads(_b64url_decode(payload_b64))
+    except InvalidSignature:
+        return None
+    except Exception:  # noqa: BLE001 — malformed token shapes
+        return None
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        return None
+    return claims
+
+
+def load_public_key(pem_path: str):
+    """Load an RSA public key from a PEM file holding either a bare public
+    key or an X.509 certificate (the reference's JwtLoginService takes a
+    certificate)."""
+    from cryptography.hazmat.primitives.serialization import load_pem_public_key
+    from cryptography.x509 import load_pem_x509_certificate
+
+    with open(pem_path, "rb") as f:
+        data = f.read()
+    if b"CERTIFICATE" in data:
+        return load_pem_x509_certificate(data).public_key()
+    return load_pem_public_key(data)
+
+
+class JwtRs256SecurityProvider:
+    """Public-key bearer-token auth (reference servlet/security/jwt/
+    JwtAuthenticator.java:1 + JwtLoginService certificate verification).
+
+    The service holds only the PUBLIC key/certificate
+    (jwt.authentication.certificate.location); tokens are minted elsewhere
+    with the private key — no shared secret crosses service boundaries.
+    """
+
+    def __init__(self, certificate_path: str, *, default_role: str = USER):
+        self.public_key = load_public_key(certificate_path)
+        self.default_role = default_role
+
+    def authenticate(self, headers):
+        header = headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            return None
+        claims = jwt_decode_rs256(header[7:], self.public_key)
+        if claims is None:
+            return None
+        return (claims.get("sub", "unknown"), claims.get("role", self.default_role))
+
+    def authorize(self, role, method, endpoint):
+        return _ROLE_RANK.get(role, -1) >= _ROLE_RANK[ENDPOINT_ROLE.get(method, ADMIN)]
+
+
 class JwtSecurityProvider:
     """HS256 bearer-token auth (reference servlet/security/jwt/).
 

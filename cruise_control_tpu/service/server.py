@@ -96,6 +96,7 @@ class CruiseControlApp:
         from cruise_control_tpu.service.security import (
             AllowAllSecurityProvider,
             BasicSecurityProvider,
+            JwtRs256SecurityProvider,
             JwtSecurityProvider,
             SessionManager,
         )
@@ -114,6 +115,11 @@ class CruiseControlApp:
         # security provider selection (reference webserver.security.provider)
         if not cc.config.get("webserver.security.enable"):
             self.security = AllowAllSecurityProvider()
+        elif cc.config.get("jwt.authentication.certificate.location"):
+            # certificate-based RS256 outranks shared-secret HS256
+            self.security = JwtRs256SecurityProvider(
+                cc.config.get("jwt.authentication.certificate.location")
+            )
         elif cc.config.get("jwt.secret.key"):
             self.security = JwtSecurityProvider(cc.config.get("jwt.secret.key"))
         else:

@@ -33,9 +33,13 @@ class FakeKafkaCluster:
         topics: dict[str, list[dict]],
         *,
         controller: int | None = None,
+        scram_users: dict[str, str] | None = None,
     ):
         """brokers: id -> {"rack": str, "logdirs": [path, ...]}
-        topics: name -> [{"partition", "leader", "replicas"}]"""
+        topics: name -> [{"partition", "leader", "replicas"}]
+        scram_users: username -> password; when set, every connection must
+        complete a SaslHandshake + SCRAM exchange before any other API
+        (a SASL-only listener, like a secured real cluster)."""
         self._lock = threading.RLock()
         self.controller = controller if controller is not None else min(brokers)
         self.brokers: dict[int, dict] = {}
@@ -54,6 +58,7 @@ class FakeKafkaCluster:
         #: at append like a real log
         self.logs: dict[tuple[str, int], list[bytes]] = {}
         self.log_end: dict[tuple[str, int], int] = {}
+        self.scram_users = scram_users or {}
         self._servers: list[_BrokerListener] = []
         for bid, spec in sorted(brokers.items()):
             self.brokers[bid] = {"rack": spec.get("rack", ""), "port": None}
@@ -449,6 +454,13 @@ class _BrokerListener(threading.Thread):
             ).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        # per-connection SASL gate (only when the cluster has scram users):
+        # handshake -> scram rounds -> authenticated; anything else first
+        # gets ILLEGAL_SASL_STATE and the connection is closed, like a real
+        # SASL listener
+        sasl_required = bool(self.cluster.scram_users)
+        scram = None
+        authenticated = not sasl_required
         try:
             while True:
                 head = self._read_exact(conn, 4)
@@ -459,7 +471,40 @@ class _BrokerListener(threading.Thread):
                 if payload is None:
                     return
                 api, cid, _client, body = proto.decode_request(payload)
-                resp = self.cluster.handle(self.node_id, api, body)
+                if api.name == "SaslHandshake":
+                    from cruise_control_tpu.kafka.sasl import _HASHES, ScramServer
+
+                    mech = body["mechanism"]
+                    if mech in _HASHES:
+                        scram = ScramServer(mech, self.cluster.scram_users)
+                        resp = {"error_code": 0, "mechanisms": sorted(_HASHES)}
+                    else:
+                        resp = {
+                            "error_code": 33,  # UNSUPPORTED_SASL_MECHANISM
+                            "mechanisms": sorted(_HASHES),
+                        }
+                elif api.name == "SaslAuthenticate":
+                    if scram is None:
+                        resp = {"error_code": 47, "error_message": "handshake first",
+                                "auth_bytes": b""}  # ILLEGAL_SASL_STATE
+                    else:
+                        msg, done, ok = scram.respond(body["auth_bytes"])
+                        if done and not ok:
+                            resp = {
+                                "error_code": 58,  # SASL_AUTHENTICATION_FAILED
+                                "error_message": msg.decode(),
+                                "auth_bytes": b"",
+                            }
+                            conn.sendall(proto.encode_response(api, cid, resp))
+                            return
+                        authenticated = authenticated or (done and ok)
+                        resp = {"error_code": 0, "error_message": None,
+                                "auth_bytes": msg}
+                elif not authenticated:
+                    # a real SASL listener disconnects on pre-auth requests
+                    return
+                else:
+                    resp = self.cluster.handle(self.node_id, api, body)
                 conn.sendall(proto.encode_response(api, cid, resp))
         except OSError:
             pass

@@ -45,7 +45,7 @@ def _state_and_chain(name):
     )
 
     if name == "config1":
-        return small_cluster(), DEFAULT_CHAIN, dict(moves=2000, dests=8)
+        return small_cluster(), DEFAULT_CHAIN, dict(moves=20000, dests=8)
     if name == "config2":
         chain = GoalChain.from_names([
             "ReplicaCapacityGoal",
@@ -55,7 +55,7 @@ def _state_and_chain(name):
             "CpuUsageDistributionGoal",
         ])
         state = random_cluster_fast(RandomClusterSpec(**bench.SMALL_SPEC), seed=42)
-        return state, chain, dict(moves=2000, dests=8)
+        return state, chain, dict(moves=20000, dests=8)
     if name == "config3":
         chain = GoalChain.from_names([
             "RackAwareGoal",
@@ -66,7 +66,7 @@ def _state_and_chain(name):
         state = random_cluster_fast(
             RandomClusterSpec(**{**bench.MID_SPEC, "disks_per_broker": 4}), seed=42
         )
-        return state, chain, dict(moves=2000, dests=8)
+        return state, chain, dict(moves=20000, dests=8)
     if name == "config5":
         import dataclasses as dc
 
@@ -116,6 +116,7 @@ def main():
             moves=info["moves"],
             converged=info["converged"],
             budget_s=BUDGET,
+            fingerprint=bench._baseline_fingerprint(state, chain),
         )
         print(f"{name}: {results[name]}", flush=True)
         with open(OUT, "w") as f:

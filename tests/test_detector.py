@@ -286,3 +286,38 @@ def test_slow_broker_detector_wired_into_service():
         assert isinstance(records, list)
     finally:
         app.stop()
+
+
+def test_slow_broker_finder_requires_majority_of_metric_families():
+    """One noisy family spiking must NOT flag a broker; a majority of the
+    evidence agreeing must (reference SlowBrokerFinder.java:99 multi-source
+    evidence: byte rates + request latencies)."""
+    finder = SlowBrokerFinder(peer_ratio=2.0, removal_threshold=3)
+
+    def evidence(flush, produce, queue, broker=2):
+        out = {}
+        for b in range(4):
+            out[b] = {
+                "log_flush_time_ms_mean": 10.0 + b,
+                "produce_local_time_ms_mean": 5.0 + b,
+                "request_queue_size": 3.0,
+            }
+        out[broker] = {
+            "log_flush_time_ms_mean": flush,
+            "produce_local_time_ms_mean": produce,
+            "request_queue_size": queue,
+        }
+        return out
+
+    for _ in range(5):
+        assert finder.detect(evidence(12.0, 6.0, 3.0)) is None
+    # only ONE of three families spikes: not slow
+    assert finder.detect(evidence(500.0, 6.0, 3.0)) is None
+    # two of three agree (majority): slow
+    a = finder.detect(evidence(500.0, 200.0, 3.0))
+    assert a is not None and set(a.slow_brokers) == {2}
+    assert not a.remove_slow_brokers
+    # recovery clears the strikes
+    assert finder.detect(evidence(12.0, 6.0, 3.0)) is None
+    a2 = finder.detect(evidence(500.0, 200.0, 3.0))
+    assert a2 is not None and not a2.remove_slow_brokers

@@ -1,0 +1,248 @@
+"""Full-stack embedded integration test.
+
+The one test that closes every seam at once, the role of the reference's
+CruiseControlIntegrationTestHarness (cruise-control/src/test/java/com/
+linkedin/kafka/cruisecontrol/CruiseControlIntegrationTestHarness.java:1-30)
++ ExecutorTest's embedded-cluster runs:
+
+  per-broker MetricsReporter -> KafkaMetricsTransport (wire produce)
+    -> fake_kafka reporter topic (live sockets)
+    -> CruiseControlMetricsReporterSampler (wire fetch, columnar decode)
+    -> MetricFetcherManager -> WindowedMetricSampleAggregator
+    -> LoadMonitor -> REST POST /rebalance?dryrun=false
+    -> Executor -> KafkaClusterAdmin.AlterPartitionReassignments
+    -> fake_kafka topology CHANGES
+  and the KafkaSampleStore replays the same history into a fresh
+  aggregator ("restart") without re-sampling.
+
+Each seam has its own contract test elsewhere; this exists to catch
+cross-seam wiring drift.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.config.app_config import CruiseControlConfig
+from cruise_control_tpu.kafka import KafkaAdminClient
+from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+from cruise_control_tpu.kafka.transport import (
+    KafkaMetricsConsumer,
+    KafkaMetricsTransport,
+)
+from cruise_control_tpu.monitor.reporter_sampler import (
+    CruiseControlMetricsReporterSampler,
+)
+from cruise_control_tpu.reporter.metrics import MetricType
+from cruise_control_tpu.reporter.reporter import (
+    MetricsRegistrySnapshotter,
+    MetricsReporter,
+)
+from cruise_control_tpu.testing.fake_kafka import FakeKafkaCluster
+
+WINDOW_MS = 60_000
+METRICS_TOPIC = "__CruiseControlMetrics"
+
+
+def _skewed_cluster() -> FakeKafkaCluster:
+    """4 brokers / 2 racks; every replica packed onto brokers 0+1 (brokers
+    2 and 3 idle) — a blatant distribution violation the rebalance must fix."""
+    parts = {}
+    for t, n in (("T0", 8), ("T1", 8)):
+        parts[t] = [
+            {"partition": p, "leader": p % 2, "replicas": [p % 2, 1 - p % 2]}
+            for p in range(n)
+        ]
+    # the reporter topic exists up front, as on a real cluster
+    parts[METRICS_TOPIC] = [
+        {"partition": p, "leader": p % 4, "replicas": [p % 4]} for p in range(4)
+    ]
+    return FakeKafkaCluster(
+        brokers={
+            0: {"rack": "r0"}, 1: {"rack": "r1"},
+            2: {"rack": "r0"}, 3: {"rack": "r1"},
+        },
+        topics=parts,
+    )
+
+
+def _broker_metric_source(cluster: FakeKafkaCluster, broker_id: int):
+    """Live per-broker metrics view: sizes/rates follow the CURRENT fake
+    topology (what a real broker's metrics registry would show)."""
+
+    def source():
+        topics: dict = {}
+        partitions: dict = {}
+        for t, pmap in cluster.topics.items():
+            led = [p for p in pmap.values() if p["leader"] == broker_id]
+            for p in led:
+                # partition p of topic t: deterministic size, heavier for T0
+                size = 1000.0 * (p["partition"] + 1) * (2.0 if t == "T0" else 1.0)
+                partitions[(t, p["partition"])] = size
+            if led:
+                topics[t] = {
+                    MetricType.TOPIC_BYTES_IN: 500.0 * len(led),
+                    MetricType.TOPIC_BYTES_OUT: 800.0 * len(led),
+                }
+        return {
+            "broker": {
+                MetricType.BROKER_CPU_UTIL: 10.0 + 5.0 * len(partitions),
+                MetricType.BROKER_PRODUCE_REQUEST_RATE: 100.0,
+            },
+            "topics": topics,
+            "partitions": partitions,
+        }
+
+    return source
+
+
+@pytest.mark.slow
+def test_full_stack_reporter_to_executor_round_trip():
+    cluster = _skewed_cluster().start()
+    clients: list[KafkaAdminClient] = []
+
+    def new_client() -> KafkaAdminClient:
+        c = KafkaAdminClient(cluster.bootstrap(), timeout_s=10.0)
+        clients.append(c)
+        return c
+
+    try:
+        # --- reporter side: one agent per broker over the wire ---
+        reporter_client = new_client()
+        transport = KafkaMetricsTransport(reporter_client, METRICS_TOPIC)
+        reporters = [
+            MetricsReporter(
+                MetricsRegistrySnapshotter(b, _broker_metric_source(cluster, b)),
+                transport,
+            )
+            for b in range(4)
+        ]
+
+        # --- service side: sampler consumes the reporter topic ---
+        from cruise_control_tpu.service.main import build_kafka_service
+
+        service_client = new_client()
+        sample_store = KafkaSampleStore(
+            new_client(),
+            topic_name_fn={0: "T0", 1: "T1"}.__getitem__,
+            topic_id_fn={"T0": 0, "T1": 1}.__getitem__,
+        )
+        config = CruiseControlConfig({
+            "num.partition.metrics.windows": "2",
+            "partition.metrics.window.ms": str(WINDOW_MS),
+            "min.samples.per.partition.metrics.window": "1",
+            "num.broker.metrics.windows": "2",
+            "broker.metrics.window.ms": str(WINDOW_MS),
+            "webserver.http.port": "0",
+        })
+        from cruise_control_tpu.kafka import KafkaMetadataProvider
+
+        metadata_for_sampler = KafkaMetadataProvider(new_client())
+        sampler = CruiseControlMetricsReporterSampler(
+            KafkaMetricsConsumer(service_client, METRICS_TOPIC),
+            metadata_for_sampler.topology,
+        )
+        app, fetcher, admin, client = build_kafka_service(
+            config, f"127.0.0.1:{cluster.bootstrap()[0][1]}", sampler,
+            sample_store=sample_store,
+        )
+        clients.append(client)
+
+        # --- drive three sampling windows through every seam ---
+        parts_fn = app.cc.task_runner.partitions_fn
+        entities = parts_fn()
+        assert len(entities) == 16
+        for w in range(3):
+            t_mid = w * WINDOW_MS + WINDOW_MS // 2
+            for r in reporters:
+                r.report_once(now_ms=t_mid)
+            n = fetcher.fetch_once(entities, w * WINDOW_MS, (w + 1) * WINDOW_MS - 1)
+            assert n > 0, f"window {w} absorbed no samples"
+        # the sampler interned topics in the declared order
+        assert sampler._topic_ids == {"T0": 0, "T1": 1}
+
+        # --- REST rebalance, non-dryrun, against the live fake cluster ---
+        app.start()
+        base = f"http://{app.host}:{app.port}{app.prefix}"
+
+        def req(method, ep, headers=None, **params):
+            q = "&".join(f"{k}={v}" for k, v in params.items())
+            r = urllib.request.Request(
+                f"{base}/{ep}" + (f"?{q}" if q else ""),
+                method=method, headers=headers or {},
+            )
+            with urllib.request.urlopen(r, timeout=120) as resp:
+                return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+        def workload_placement():
+            return {
+                (t, p["partition"]): tuple(p["replicas"])
+                for t, pmap in cluster.topics.items()
+                if t in ("T0", "T1")
+                for p in pmap.values()
+            }
+
+        before = workload_placement()
+        assert not any(
+            2 in r or 3 in r for r in before.values()
+        ), "fixture must start with brokers 2/3 empty"
+
+        cluster.auto_complete_after(2)
+        status, payload, headers = req("POST", "rebalance", dryrun="false")
+        tid = headers.get("User-Task-ID")
+        deadline = time.time() + 180
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.5)
+            status, payload, headers = req(
+                "POST", "rebalance", headers={"User-Task-ID": tid}, dryrun="false"
+            )
+        assert status == 200, payload
+        assert payload["numReplicaMovements"] > 0
+        assert payload["balancednessAfter"] >= payload["balancednessBefore"]
+        if "execution" in payload:
+            assert payload["execution"]["dead"] == 0
+
+        after = workload_placement()
+        assert after != before, "executor must have changed the fake topology"
+        touched_brokers = {b for r in after.values() for b in r}
+        assert {2, 3} & touched_brokers, "idle brokers must have received replicas"
+        # executor really went through the admin path
+        st, state, _ = req("GET", "state", substates="executor")
+        assert state["ExecutorState"]["numFinishedMovements"] > 0
+
+        # --- "restart": replay the sample store into a FRESH aggregator ---
+        from cruise_control_tpu.monitor import (
+            KAFKA_METRIC_DEF,
+            MetricFetcherManager,
+            WindowedMetricSampleAggregator,
+        )
+
+        fresh_agg = WindowedMetricSampleAggregator(
+            num_windows=2, window_ms=WINDOW_MS, min_samples_per_window=1,
+            metric_def=KAFKA_METRIC_DEF,
+        )
+        fresh_store = KafkaSampleStore(
+            new_client(),
+            topic_name_fn={0: "T0", 1: "T1"}.__getitem__,
+            topic_id_fn={"T0": 0, "T1": 1}.__getitem__,
+        )
+        fresh_fetcher = MetricFetcherManager(
+            sampler, fresh_agg, None, sample_store=fresh_store
+        )
+        replayed = fresh_fetcher.load_samples()
+        assert replayed > 0
+        res = fresh_agg.aggregate()
+        assert res.values.shape[1] >= 2  # both completed windows restored
+        assert bool(np.any(res.window_valid))
+
+        app.stop()
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        cluster.stop()
