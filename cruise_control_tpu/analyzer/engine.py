@@ -391,6 +391,7 @@ class Engine:
         self._jit_violations = jax.jit(self._violations_impl)
         self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
         self._jit_round_prep = jax.jit(self._round_prep_impl)
+        self._jit_init = jax.jit(self._init_impl)
 
     # convenience for call sites that held `engine.state`
     @property
@@ -413,7 +414,14 @@ class Engine:
     # ------------------------------------------------------------------
 
     def init_carry(self, key: jax.Array) -> EngineCarry:
-        st = self.statics.state
+        return self._jit_init(self.statics, key)
+
+    def _init_impl(self, sx: EngineStatics, key: jax.Array) -> EngineCarry:
+        """Zero carry + aggregate refresh as ONE program.  Building the
+        zero arrays eagerly cost ~10 tiny jit dispatches whose sub-second
+        compiles are not persisted — several seconds of per-process warmup
+        for literal zero-fills."""
+        st = sx.state
         B = self.shape.B
         zeros = EngineCarry(
             replica_broker=st.replica_broker,
@@ -430,7 +438,7 @@ class Engine:
             host_load=jnp.zeros((self.shape.num_hosts, NUM_RESOURCES), jnp.float32),
             key=key,
         )
-        return self._jit_refresh(self.statics, zeros)
+        return self._refresh_impl(sx, zeros)
 
     def carry_to_state(self, carry: EngineCarry, sx: EngineStatics | None = None) -> ClusterState:
         st = (sx or self.statics).state

@@ -36,9 +36,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
 
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # cache everything that took meaningfully long to compile; tiny
-        # programs are cheaper to rebuild than to hit disk for
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # persist even sub-second compiles: a cold process pays dozens of
+        # 0.1-0.5s "tiny" compiles (zero-fills, reductions) that add whole
+        # seconds to warmup; disk hits are ~ms
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _enabled = True
         return cache_dir

@@ -255,6 +255,21 @@ def _webserver_defs() -> ConfigDef:
     d.define("sasl.password.file", T.STRING, None, I.MEDIUM,
              "file holding the SASL password (overrides sasl.password)",
              group=g)
+    # per-endpoint parameter/request class override maps (reference
+    # config/constants/CruiseControlParametersConfig.java:1 +
+    # CruiseControlRequestConfig.java:1): every endpoint's parameter
+    # declaration and request execution are pluggable
+    from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS
+
+    for ep in sorted(ALL_ENDPOINTS):
+        d.define(f"{ep}.parameters.class", T.CLASS, None, I.LOW,
+                 f"dotted path of a custom parameters class for /{ep}; "
+                 "called with (endpoint, builtin_parameters), must expose "
+                 ".parse(raw_query_dict)", group=g)
+        d.define(f"{ep}.request.class", T.CLASS, None, I.LOW,
+                 f"dotted path of a custom request handler for /{ep}; "
+                 "called with (app, endpoint, params) -> (status, payload)",
+                 group=g)
     return d
 
 

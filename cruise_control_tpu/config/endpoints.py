@@ -1,0 +1,20 @@
+"""Canonical REST endpoint names — a LEAF module.
+
+The single source of truth consumed by the server's dispatch tables, the
+parameter registry, the response-schema registry, and the config defs
+({endpoint}.parameters.class / .request.class keys).  Lives in the config
+layer so building a CruiseControlConfig never imports the service package
+(app_config.py guards that layering: module imports here close cycles
+through package __init__s).
+"""
+
+GET_ENDPOINTS = (
+    "bootstrap", "train", "load", "partition_load", "proposals", "state",
+    "kafka_cluster_state", "user_tasks", "review_board",
+)
+POST_ENDPOINTS = (
+    "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
+    "stop_proposal_execution", "pause_sampling", "resume_sampling",
+    "demote_broker", "admin", "review", "topic_configuration",
+)
+ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS

@@ -1,0 +1,172 @@
+"""Declared request parameters per endpoint + pluggable override maps.
+
+Reference: config/constants/CruiseControlParametersConfig.java:1 and
+CruiseControlRequestConfig.java:1 — every endpoint maps to a parameters
+class (which declares and validates its query parameters) and a request
+class (which executes it), BOTH overridable per endpoint through config
+({endpoint}.parameters.class / {endpoint}.request.class).
+
+Here each endpoint declares its parameter set as data; `parse` validates
+types and REJECTS unknown parameters (the reference 400s unrecognized
+params — silently ignoring a typo like `dry_run` executes a rebalance the
+caller believed was a dry run).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable
+
+
+class ParameterError(ValueError):
+    pass
+
+
+def _bool(s: str):
+    if s.lower() in ("true", "1", "yes"):
+        return True
+    if s.lower() in ("false", "0", "no"):
+        return False
+    raise ParameterError(f"expected boolean, got {s!r}")
+
+
+def _int(s: str):
+    return int(s)
+
+
+def _float(s: str):
+    return float(s)
+
+
+def _int_list(s: str):
+    return [int(x) for x in s.split(",") if x != ""]
+
+
+def _str_list(s: str):
+    return [x for x in s.split(",") if x]
+
+
+def _regex(s: str):
+    re.compile(s)  # validation only; handlers re-compile as needed
+    return s
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    name: str
+    parse: Callable[[str], object]
+    doc: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class EndpointParameters:
+    """Declared parameter set for one endpoint (the reference's
+    *Parameters class).  Subclass / replace via {endpoint}.parameters.class
+    to accept custom parameters."""
+
+    endpoint: str
+    params: tuple
+
+    def parse(self, raw: dict) -> dict:
+        """raw: urllib parse_qs dict.  Validates every value; unknown
+        parameter names are rejected."""
+        by_name = {p.name: p for p in self.params}
+        out = {}
+        for name, values in raw.items():
+            p = by_name.get(name)
+            if p is None:
+                raise ParameterError(
+                    f"unknown parameter {name!r} for {self.endpoint} "
+                    f"(accepted: {sorted(by_name)})"
+                )
+            try:
+                out[name] = p.parse(values[0])
+            except ParameterError:
+                raise
+            except (ValueError, TypeError) as e:
+                raise ParameterError(f"bad {name}: {e}") from e
+        return out
+
+
+_EXECUTION = (
+    Param("concurrent_partition_movements_per_broker", _int),
+    Param("concurrent_leader_movements", _int),
+    Param("replication_throttle", _float),
+)
+_DRYRUN = Param("dryrun", _bool)
+_REVIEW_ID = Param("review_id", _int, "two-step verification approval id")
+_REASON = Param("reason", str)
+
+#: the builtin parameter map (reference CruiseControlParametersConfig's
+#: DEFAULT_* constants tree)
+ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {  # noqa: E305
+    ep: EndpointParameters(ep, params)
+    for ep, params in {
+        "bootstrap": (Param("start", _int), Param("end", _int),
+                      Param("clearmetrics", _bool)),
+        "train": (Param("start", _int), Param("end", _int)),
+        "load": (Param("allow_capacity_estimation", _bool),),
+        "partition_load": (Param("resource", str), Param("entries", _int),
+                           Param("allow_capacity_estimation", _bool)),
+        "proposals": (Param("ignore_proposal_cache", _bool),
+                      Param("allow_capacity_estimation", _bool)),
+        "state": (Param("substates", _str_list),),
+        "kafka_cluster_state": (),
+        "user_tasks": (Param("user_task_ids", _str_list),
+                       Param("fetch_completed_task", _bool)),
+        "review_board": (Param("review_ids", _int_list),),
+        "add_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID,
+                       *_EXECUTION),
+        "remove_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID,
+                          *_EXECUTION),
+        "fix_offline_replicas": (_DRYRUN, _REVIEW_ID, *_EXECUTION),
+        "rebalance": (_DRYRUN, Param("goals", _str_list),
+                      Param("destination_broker_ids", _int_list),
+                      Param("excluded_topics", _regex),
+                      Param("rebalance_disk", _bool), _REVIEW_ID, *_EXECUTION),
+        "stop_proposal_execution": (Param("force_stop", _bool), _REVIEW_ID),
+        "pause_sampling": (_REASON, _REVIEW_ID),
+        "resume_sampling": (_REASON, _REVIEW_ID),
+        "demote_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID),
+        "admin": (Param("enable_self_healing_for", _str_list),
+                  Param("disable_self_healing_for", _str_list),
+                  Param("drop_recently_removed_brokers", _int_list), _REVIEW_ID),
+        "review": (Param("approve", _int_list), Param("discard", _int_list),
+                   _REASON),
+        "topic_configuration": (Param("topic", str),
+                                Param("replication_factor", _int), _DRYRUN,
+                                _REVIEW_ID),
+    }.items()
+}
+
+
+# the canonical endpoint list and this registry must agree — a new
+# endpoint without declared parameters would silently skip validation
+from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS  # noqa: E402
+
+assert set(ENDPOINT_PARAMETERS) == set(ALL_ENDPOINTS), (
+    set(ENDPOINT_PARAMETERS) ^ set(ALL_ENDPOINTS)
+)
+
+
+def build_override_maps(config) -> tuple[dict, dict]:
+    """(parameter parsers, request handlers) per endpoint from config.
+
+    {endpoint}.parameters.class (T.CLASS, resolved by the config layer)
+    is called with (endpoint, builtin: EndpointParameters) and must expose
+    .parse(raw) — the builtin instance is passed so overrides can extend
+    rather than re-declare.  {endpoint}.request.class is called as
+    (app, endpoint, parsed_params) -> (status, payload).  Unset keys keep
+    the builtins.
+    """
+    parsers: dict[str, object] = dict(ENDPOINT_PARAMETERS)
+    handlers: dict[str, object] = {}
+    for ep in ENDPOINT_PARAMETERS:
+        p_cls = config.get(f"{ep}.parameters.class")
+        if p_cls:
+            parsers[ep] = p_cls(ep, ENDPOINT_PARAMETERS[ep])
+        r_cls = config.get(f"{ep}.request.class")
+        if r_cls:
+            handlers[ep] = r_cls
+    return parsers, handlers

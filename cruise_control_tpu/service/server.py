@@ -30,15 +30,7 @@ from cruise_control_tpu.service.facade import CruiseControl
 from cruise_control_tpu.service.purgatory import Purgatory
 from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTaskManager
 
-GET_ENDPOINTS = (
-    "bootstrap", "train", "load", "partition_load", "proposals", "state",
-    "kafka_cluster_state", "user_tasks", "review_board",
-)
-POST_ENDPOINTS = (
-    "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
-    "stop_proposal_execution", "pause_sampling", "resume_sampling",
-    "demote_broker", "admin", "review", "topic_configuration",
-)
+from cruise_control_tpu.config.endpoints import GET_ENDPOINTS, POST_ENDPOINTS
 
 
 class BadRequest(ValueError):
@@ -126,6 +118,11 @@ class CruiseControlApp:
             self.security = BasicSecurityProvider(
                 cc.config.get("basic.auth.credentials.file")
             )
+        # per-endpoint parameter/request override maps (reference
+        # CruiseControlParametersConfig / CruiseControlRequestConfig)
+        from cruise_control_tpu.service.parameters import build_override_maps
+
+        self.param_parsers, self.request_handlers = build_override_maps(cc.config)
         self.prefix = cc.config.get("webserver.api.urlprefix").rstrip("/")
         self.host = host or cc.config.get("webserver.http.address")
         self.port = port if port is not None else cc.config.get("webserver.http.port")
@@ -172,6 +169,21 @@ class CruiseControlApp:
             else None
         )
 
+        # declared-parameter validation BEFORE the purgatory: unknown names
+        # and malformed values 400 now (a `dry_run` typo must not execute
+        # the rebalance the caller believed was a dry run), and an invalid
+        # request must not park with a 200 only to burn its one approval
+        # when the resubmit finally validates
+        from cruise_control_tpu.service.parameters import ParameterError
+
+        parsed = params
+        parser = self.param_parsers.get(endpoint)
+        if parser is not None:
+            try:
+                parsed = parser.parse(params)
+            except ParameterError as e:
+                raise BadRequest(str(e)) from e
+
         # two-step verification parks POSTs in the purgatory first
         if (
             method == "POST"
@@ -188,6 +200,11 @@ class CruiseControlApp:
                 )
                 return 200, {"reviewId": info.review_id, "status": info.status.value}
 
+        custom = self.request_handlers.get(endpoint)
+        if custom is not None:
+            # custom request classes receive the PARSED parameter dict
+            # (build_override_maps contract)
+            return custom(self, endpoint, parsed)
         fn = getattr(self, f"_ep_{endpoint}")
         return fn(params)
 

@@ -587,3 +587,78 @@ def test_parse_bootstrap_servers():
     for bad in ("h1:x", "[::1", "[::1]9092", "", "h1:"):
         with pytest.raises(ValueError):
             parse(bad)
+
+
+def test_unknown_and_malformed_parameters_rejected(service):
+    """Declared-parameter validation (reference CruiseControlParametersConfig
+    parameter classes): unknown names and bad values 400 instead of being
+    silently ignored."""
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "POST", "rebalance", dry_run="true")
+    assert e.value.code == 400
+    assert "unknown parameter" in json.loads(e.value.read())["errorMessage"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "GET", "proposals", ignore_proposal_cache="maybe")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "POST", "add_broker", brokerid="zero")
+    assert e.value.code == 400
+
+
+class UpperCaseReasonParameters:
+    """Custom parameters class for tests: extends the builtin set."""
+
+    def __init__(self, endpoint, builtin):
+        self.builtin = builtin
+
+    def parse(self, raw):
+        out = self.builtin.parse({k: v for k, v in raw.items() if k != "shout"})
+        if "shout" in raw:
+            out["shout"] = raw["shout"][0]
+        return out
+
+
+def custom_pause_handler(app, endpoint, parsed):
+    # custom request classes receive the PARSED parameter dict
+    reason = parsed.get("reason", "user request")
+    app.cc.monitor.pause(reason.upper())
+    return 200, {"message": f"sampling paused: {reason.upper()}"}
+
+
+def test_parameter_and_request_class_override_maps():
+    """{endpoint}.parameters.class / {endpoint}.request.class plug custom
+    classes per endpoint (reference CruiseControlRequestConfig)."""
+    config = CruiseControlConfig({
+        "pause_sampling.parameters.class":
+            "tests.test_service.UpperCaseReasonParameters",
+        "pause_sampling.request.class":
+            "tests.test_service.custom_pause_handler",
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=21)
+    app.start()
+    try:
+        # the custom parameters class accepts `shout`, builtin would 400
+        status, payload, _ = _request(
+            app, "POST", "pause_sampling", reason="drill", shout="1"
+        )
+        assert status == 200
+        assert payload["message"] == "sampling paused: DRILL"
+    finally:
+        app.stop()
+
+
+def test_two_step_rejects_invalid_params_before_parking():
+    """An invalid request must 400 immediately, not park in the purgatory
+    with a 200 and burn its approval on resubmit."""
+    config = CruiseControlConfig({"two.step.verification.enabled": "true"})
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=22)
+    app.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _request(app, "POST", "rebalance", dry_run="true")
+        assert e.value.code == 400
+        # a VALID request still parks for review
+        status, payload, _ = _request(app, "POST", "rebalance", dryrun="true")
+        assert status == 200 and "reviewId" in payload
+    finally:
+        app.stop()
