@@ -60,7 +60,7 @@ SMALL_SPEC = dict(num_brokers=50, num_partitions=5000, num_racks=5, num_topics=2
 SEARCH = dict(
     num_candidates=16384,
     leadership_candidates=4096,
-    steps_per_round=64,
+    steps_per_round=int(os.environ.get("BENCH_STEPS", "64")),
     num_rounds=8,
     seed=0,
 )

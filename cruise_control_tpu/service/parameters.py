@@ -89,10 +89,26 @@ class EndpointParameters:
         return out
 
 
+def _min1_int(s: str):
+    v = int(s)
+    if not v >= 1:  # also rejects NaN-shaped junk; a 0 cap stalls the executor
+        raise ParameterError(f"must be >= 1, got {v}")
+    return v
+
+
+def _min1_float(s: str):
+    v = float(s)
+    if not v >= 1:
+        raise ParameterError(f"must be >= 1, got {v}")
+    return v
+
+
+# bounds MATCH server._parse_execution_overrides — the declared parser is
+# what custom request classes consume, so the two layers must agree
 _EXECUTION = (
-    Param("concurrent_partition_movements_per_broker", _int),
-    Param("concurrent_leader_movements", _int),
-    Param("replication_throttle", _float),
+    Param("concurrent_partition_movements_per_broker", _min1_int),
+    Param("concurrent_leader_movements", _min1_int),
+    Param("replication_throttle", _min1_float),
 )
 _DRYRUN = Param("dryrun", _bool)
 _REVIEW_ID = Param("review_id", _int, "two-step verification approval id")

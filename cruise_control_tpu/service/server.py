@@ -27,6 +27,7 @@ import numpy as np
 
 from cruise_control_tpu.common.resources import RESOURCE_NAMES, Resource
 from cruise_control_tpu.service.facade import CruiseControl
+from cruise_control_tpu.service.parameters import ParameterError, build_override_maps
 from cruise_control_tpu.service.purgatory import Purgatory
 from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTaskManager
 
@@ -120,8 +121,6 @@ class CruiseControlApp:
             )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
-        from cruise_control_tpu.service.parameters import build_override_maps
-
         self.param_parsers, self.request_handlers = build_override_maps(cc.config)
         self.prefix = cc.config.get("webserver.api.urlprefix").rstrip("/")
         self.host = host or cc.config.get("webserver.http.address")
@@ -174,8 +173,6 @@ class CruiseControlApp:
         # the rebalance the caller believed was a dry run), and an invalid
         # request must not park with a 200 only to burn its one approval
         # when the resubmit finally validates
-        from cruise_control_tpu.service.parameters import ParameterError
-
         parsed = params
         parser = self.param_parsers.get(endpoint)
         if parser is not None:
@@ -194,6 +191,11 @@ class CruiseControlApp:
                 rid = int(params["review_id"][0])
                 info = self.purgatory.take_approved(endpoint, rid)
                 params = {**{k: [str(v)] for k, v in info.params.items()}, **params}
+                if parser is not None:
+                    # re-parse the MERGED params: a custom request handler
+                    # consumes `parsed`, which must carry the parked
+                    # parameters, not just the resubmit's review_id
+                    parsed = parser.parse(params)
             else:
                 info = self.purgatory.add(
                     endpoint, {k: v[0] for k, v in params.items()}

@@ -505,3 +505,24 @@ def test_scram_client_rejects_forged_server_signature():
     c2.final(first2)
     with pytest.raises(PermissionError):
         c2.verify(b"v=" + __import__("base64").b64encode(b"x" * 32))
+
+
+def test_scram_sha256_rfc7677_test_vector():
+    """Exact-bytes conformance against the published SCRAM-SHA-256 test
+    vector (RFC 7677 §3) — the wire exchange must interoperate with real
+    brokers, not merely with our own server half."""
+    from cruise_control_tpu.kafka.sasl import SaslCredentials, ScramClient
+
+    c = ScramClient(
+        SaslCredentials("user", "pencil"), nonce="rOprNGfwEbeRWgbNEkqO"
+    )
+    assert c.first() == b"n,,n=user,r=rOprNGfwEbeRWgbNEkqO"
+    server_first = (
+        b"r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+    )
+    assert c.final(server_first) == (
+        b"c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+        b"p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+    )
+    c.verify(b"v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4=")  # no raise

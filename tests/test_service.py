@@ -662,3 +662,38 @@ def test_two_step_rejects_invalid_params_before_parking():
         assert status == 200 and "reviewId" in payload
     finally:
         app.stop()
+
+
+def capture_rebalance_handler(app, endpoint, parsed):
+    return 200, {"numReplicaMovements": 0, "numLeaderMovements": 0,
+                 "dataToMoveMB": 0, "balancednessBefore": 0.0,
+                 "balancednessAfter": 0.0, "objectiveBefore": 0.0,
+                 "objectiveAfter": 0.0, "violatedGoalsAfter": [],
+                 "wallSeconds": 0.0, "proposals": [],
+                 "execution": {"parsedSeen": {k: str(v) for k, v in parsed.items()}}}
+
+
+def test_two_step_resubmit_passes_merged_parsed_to_custom_handler():
+    """After approval, a custom request class must see the PARKED parameters
+    (merged + re-parsed), not just the resubmit's review_id."""
+    config = CruiseControlConfig({
+        "two.step.verification.enabled": "true",
+        "rebalance.request.class": "tests.test_service.capture_rebalance_handler",
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=23)
+    app.start()
+    try:
+        status, payload, _ = _request(
+            app, "POST", "rebalance", dryrun="true", excluded_topics="T0"
+        )
+        assert status == 200 and "reviewId" in payload
+        rid = payload["reviewId"]
+        status, payload, _ = _request(app, "POST", "review", approve=str(rid))
+        assert status == 200
+        status, payload, _ = _request(app, "POST", "rebalance", review_id=str(rid))
+        assert status == 200
+        seen = payload["execution"]["parsedSeen"]
+        assert seen.get("dryrun") == "True"
+        assert seen.get("excluded_topics") == "T0"
+    finally:
+        app.stop()
