@@ -105,17 +105,23 @@ class GoalOptimizer:
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         config: OptimizerConfig = OptimizerConfig(),
         parallel_mode: str = "single",
+        balancedness_weights: tuple[float, float] = (1.1, 1.5),
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (model sharded over every device,
         parallel/sharded.py), or "grid:RxM" (restart portfolio over model
-        shards, parallel/grid.py)."""
+        shards, parallel/grid.py).
+
+        balancedness_weights = (priority_weight, strictness_weight) for the
+        0-100 balancedness score (reference AnalyzerConfig
+        goal.balancedness.{priority,strictness}.weight)."""
         import jax
 
         self.chain = chain
         self.constraint = constraint
         self.config = config
         self.parallel_mode = parallel_mode
+        self.balancedness_weights = balancedness_weights
         self._grid_shape = parse_parallel_mode(parallel_mode)
         if self._grid_shape is not None:
             r, m = self._grid_shape
@@ -225,8 +231,18 @@ class GoalOptimizer:
             goal_names=self.chain.names(),
             violations_before=viol_b,
             violations_after=viol_a,
-            balancedness_before=balancedness_score(viol_b, self.chain),
-            balancedness_after=balancedness_score(viol_a, self.chain),
+            balancedness_before=balancedness_score(
+                viol_b,
+                self.chain,
+                priority_weight=self.balancedness_weights[0],
+                strictness_weight=self.balancedness_weights[1],
+            ),
+            balancedness_after=balancedness_score(
+                viol_a,
+                self.chain,
+                priority_weight=self.balancedness_weights[0],
+                strictness_weight=self.balancedness_weights[1],
+            ),
             objective_before=float(obj_b),
             objective_after=float(obj_a),
             wall_seconds=wall,

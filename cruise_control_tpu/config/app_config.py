@@ -62,6 +62,27 @@ def _analyzer_defs() -> ConfigDef:
              "slack multiplier for violation detection", in_range(lo=1.0), group=g)
     d.define("num.proposal.precompute.threads", T.INT, 1, I.LOW,
              "proposal precompute workers", in_range(lo=0), group=g)
+    d.define("goal.balancedness.priority.weight", T.DOUBLE, 1.1, I.LOW,
+             "weight multiplier between adjacent goal priorities in the "
+             "balancedness score (reference "
+             "KafkaCruiseControlUtils.balancednessCostByGoal:511-537)",
+             in_range(lo=1.0), group=g)
+    d.define("goal.balancedness.strictness.weight", T.DOUBLE, 1.5, I.LOW,
+             "extra weight of hard goals in the balancedness score",
+             in_range(lo=1.0), group=g)
+    d.define("topics.excluded.from.partition.movement", T.STRING, "", I.MEDIUM,
+             "regex of topics whose replicas never move in ANY optimization "
+             "(merged with per-request excluded_topics; reference "
+             "AnalyzerConfig topics.excluded.from.partition.movement)", group=g)
+    d.define("allow.capacity.estimation.on.proposal.precompute", T.BOOLEAN, True,
+             I.LOW, "precompute models may estimate missing broker capacities",
+             group=g)
+    from cruise_control_tpu.analyzer.goals import DEFAULT_INTRA_BROKER_GOAL_ORDER
+
+    d.define("intra.broker.goals", T.LIST,
+             ",".join(DEFAULT_INTRA_BROKER_GOAL_ORDER), I.MEDIUM,
+             "goal chain for rebalance_disk (JBOD) operations "
+             "(reference AnalyzerConfig.java:236)", group=g)
     # --- TPU optimizer knobs (new in this framework) ---
     g = "analyzer.tpu"
     d.define("tpu.num.candidates", T.INT, 2048, I.MEDIUM,
@@ -169,6 +190,22 @@ def _executor_defs() -> ConfigDef:
              "slow-task alert threshold", in_range(lo=1), group=g)
     d.define("default.replica.movement.strategies", T.LIST,
              "BaseReplicaMovementStrategy", I.LOW, "ordered strategy chain", group=g)
+    d.define("max.num.cluster.movements", T.INT, 1250, I.MEDIUM,
+             "global cap on concurrently ongoing movements (replica + "
+             "leadership) cluster-wide, regardless of the per-broker caps "
+             "(reference ExecutorConfig max.num.cluster.movements)",
+             in_range(lo=1), group=g)
+    d.define("leader.movement.timeout.ms", T.LONG, 180_000, I.LOW,
+             "a leadership move not confirmed by the topology within this "
+             "window is declared DEAD (reference ExecutorConfig "
+             "leader.movement.timeout.ms)", in_range(lo=1), group=g)
+    d.define("removal.history.retention.time.ms", T.LONG, 1_209_600_000, I.LOW,
+             "how long removed brokers stay in the recently-removed set "
+             "(default 14 days, reference ExecutorConfig "
+             "removal.history.retention.time.ms)", in_range(lo=1), group=g)
+    d.define("demotion.history.retention.time.ms", T.LONG, 1_209_600_000, I.LOW,
+             "how long demoted brokers stay in the recently-demoted set",
+             in_range(lo=1), group=g)
     return d
 
 
@@ -178,6 +215,40 @@ def _anomaly_defs() -> ConfigDef:
     g = "anomaly.detector"
     d.define("anomaly.detection.interval.ms", T.LONG, 300_000, I.MEDIUM,
              "detector cadence", in_range(lo=1), group=g)
+    # per-detector cadence overrides; unset falls back to
+    # anomaly.detection.interval.ms (reference AnomalyDetectorConfig:161-204)
+    for det in ("goal.violation", "metric.anomaly", "disk.failure", "topic.anomaly"):
+        d.define(f"{det}.detection.interval.ms", T.LONG, None, I.LOW,
+                 f"{det} detector cadence override", group=g)
+    d.define("broker.failure.detection.backoff.ms", T.LONG, 300_000, I.MEDIUM,
+             "broker-failure detector polling backoff "
+             "(reference AnomalyDetectorConfig:188)", in_range(lo=1), group=g)
+    d.define("anomaly.detection.goals", T.LIST,
+             "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal", I.MEDIUM,
+             "goals the violation detector watches "
+             "(reference AnomalyDetectorConfig:103-107)", group=g)
+    d.define("anomaly.detection.allow.capacity.estimation", T.BOOLEAN, True, I.LOW,
+             "detector models may estimate missing broker capacities", group=g)
+    d.define("self.healing.goals", T.LIST, "", I.MEDIUM,
+             "goal chain used by self-healing fixes; empty means the default "
+             "goals (reference AnomalyDetectorConfig:88)", group=g)
+    d.define("self.healing.exclude.recently.demoted.brokers", T.BOOLEAN, True,
+             I.MEDIUM, "self-healing never gives leadership to recently "
+             "demoted brokers", group=g)
+    d.define("self.healing.exclude.recently.removed.brokers", T.BOOLEAN, True,
+             I.MEDIUM, "self-healing never moves replicas onto recently "
+             "removed brokers", group=g)
+    d.define("num.cached.recent.anomaly.states", T.INT, 10, I.LOW,
+             "per-type anomaly history depth "
+             "(reference AnomalyDetectorConfig:48)", in_range(lo=1, hi=100), group=g)
+    d.define("fixable.failed.broker.count.threshold", T.INT, 10, I.MEDIUM,
+             "self-healing refuses to remove more than this many failed "
+             "brokers at once (reference AnomalyDetectorConfig:138)",
+             in_range(lo=1), group=g)
+    d.define("fixable.failed.broker.percentage.threshold", T.DOUBLE, 0.4, I.MEDIUM,
+             "self-healing refuses to remove more than this fraction of the "
+             "cluster (reference AnomalyDetectorConfig:147)",
+             in_range(lo=0.0, hi=1.0), group=g)
     d.define("anomaly.notifier.class", T.CLASS,
              "cruise_control_tpu.detector.notifier.SelfHealingNotifier", I.MEDIUM,
              "AnomalyNotifier plugin", group=g)
@@ -292,17 +363,17 @@ class CruiseControlConfig(AbstractConfig):
         self._sanity_check_goals()
 
     def _sanity_check_goals(self):
+        """Reference KafkaCruiseControlConfig.java:106-120 validates every
+        configured goal-name list against the registry."""
         from cruise_control_tpu.analyzer.goals import GOALS_BY_NAME
 
-        goals = self.get("default.goals")
-        hard = set(self.get("hard.goals"))
-        unknown = [g for g in goals if g not in GOALS_BY_NAME]
-        if unknown:
-            raise ConfigException(f"unknown goals in default.goals: {unknown}")
-        unknown_hard = [g for g in hard if g not in GOALS_BY_NAME]
-        if unknown_hard:
-            raise ConfigException(f"unknown goals in hard.goals: {unknown_hard}")
-        if not goals:
+        for key in ("default.goals", "hard.goals", "anomaly.detection.goals",
+                    "self.healing.goals", "intra.broker.goals"):
+            names = self.get(key)
+            unknown = [g for g in names if g not in GOALS_BY_NAME]
+            if unknown:
+                raise ConfigException(f"unknown goals in {key}: {unknown}")
+        if not self.get("default.goals"):
             raise ConfigException("default.goals must not be empty")
 
     def balancing_constraint(self) -> BalancingConstraint:

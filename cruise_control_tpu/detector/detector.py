@@ -131,12 +131,14 @@ class AnomalyDetector:
         *,
         now_ms=None,
         sensors=None,
+        history_size: int = 10,
     ):
         from cruise_control_tpu.common.sensors import REGISTRY
 
         self.notifier = notifier
         self.actions = actions
-        self.state = AnomalyDetectorState()
+        # history_size: reference num.cached.recent.anomaly.states (default 10)
+        self.state = AnomalyDetectorState(history_size=history_size)
         self.sensors = sensors if sensors is not None else REGISTRY
 
         def _healing_ratio() -> float:
@@ -147,7 +149,8 @@ class AnomalyDetector:
         self.sensors.gauge("anomaly-detector.self-healing-enabled-ratio", _healing_ratio)
         self._queue: list[tuple[int, int, Anomaly]] = []  # (priority, seq, anomaly)
         self._seq = 0
-        self._detectors: list = []
+        self._detectors: list = []  # (detect_fn, interval_s | None)
+        self._next_due: list[float] = []  # monotonic deadline per detector
         self._now = now_ms or (lambda: int(time.time() * 1000))
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -155,9 +158,23 @@ class AnomalyDetector:
         #: re-check delays scheduled by CHECK actions: (due_ms, anomaly)
         self._delayed: list[tuple[int, int, Anomaly]] = []
 
-    def register_detector(self, detect_fn):
-        """detect_fn() -> Anomaly | None (bound method of a detector)."""
-        self._detectors.append(detect_fn)
+    def register_detector(
+        self,
+        detect_fn,
+        *,
+        interval_s: float | None = None,
+        error_backoff_s: float | None = None,
+    ):
+        """detect_fn() -> Anomaly | None (bound method of a detector).
+
+        interval_s: per-detector cadence override (reference
+        AnomalyDetectorConfig {goal.violation,metric.anomaly,disk.failure,
+        topic.anomaly}.detection.interval.ms, :161-204); None means every
+        scheduled round.  error_backoff_s: after a detector raises, it is
+        not retried for this long (reference
+        broker.failure.detection.backoff.ms)."""
+        self._detectors.append((detect_fn, interval_s, error_backoff_s))
+        self._next_due.append(0.0)
 
     def add_anomaly(self, anomaly: Anomaly):
         with self._lock:
@@ -168,8 +185,12 @@ class AnomalyDetector:
 
     # ------------------------------------------------------------------
 
-    def run_once(self) -> list[AnomalyRecord]:
-        """One detection + handling round."""
+    def run_once(self, *, respect_intervals: bool = False) -> list[AnomalyRecord]:
+        """One detection + handling round.
+
+        respect_intervals=True (the scheduled loop) skips detectors whose
+        per-detector cadence has not elapsed; the default runs every
+        detector — deterministic for tests and for forced rounds."""
         now = self._now()
         with self._lock:
             # re-enqueue due delayed checks
@@ -177,10 +198,21 @@ class AnomalyDetector:
             self._delayed = [x for x in self._delayed if x[0] > now]
             for _, _, anomaly in due:
                 self.add_anomaly(anomaly)
-        for detect in self._detectors:
+        mono = time.monotonic()
+        for i, (detect, interval_s, error_backoff_s) in enumerate(self._detectors):
+            if respect_intervals and mono < self._next_due[i]:
+                continue
+            if respect_intervals:
+                # only scheduled rounds advance the cadence clock — a forced
+                # round must not postpone an already-due scheduled run
+                self._next_due[i] = mono + (interval_s or 0.0)
             try:
                 anomaly = detect()
             except Exception:  # noqa: BLE001 — a broken detector must not stop the loop
+                if error_backoff_s:
+                    self._next_due[i] = max(
+                        self._next_due[i], mono + error_backoff_s
+                    )
                 continue
             if anomaly is not None:
                 self.add_anomaly(anomaly)
@@ -256,9 +288,16 @@ class AnomalyDetector:
     # ------------------------------------------------------------------
 
     def start(self, interval_s: float = 30.0):
+        # detectors without an explicit cadence run at the base interval;
+        # the loop wakes often enough to honor the shortest cadence
+        self._detectors = [
+            (fn, i if i else interval_s, eb) for fn, i, eb in self._detectors
+        ]
+        tick = min([interval_s] + [i for _, i, _ in self._detectors])
+
         def loop():
-            while not self._stop.wait(interval_s):
-                self.run_once()
+            while not self._stop.wait(tick):
+                self.run_once(respect_intervals=True)
 
         self._thread = threading.Thread(target=loop, daemon=True, name="anomaly-detector")
         self._thread.start()

@@ -59,6 +59,13 @@ class ExecutionOptions:
     concurrent_partition_movements_per_broker: int = 5
     concurrent_intra_broker_partition_movements: int = 2
     concurrent_leader_movements: int = 1000
+    #: global cap on concurrently ongoing movements cluster-wide, on top of
+    #: the per-broker caps (reference ExecutorConfig
+    #: max.num.cluster.movements, default 1250)
+    max_num_cluster_movements: int = 1250
+    #: a leadership move the topology has not confirmed within this window
+    #: is declared DEAD (reference ExecutorConfig leader.movement.timeout.ms)
+    leader_movement_timeout_s: float = 180.0
     replication_throttle_bytes_per_s: float | None = None
     progress_check_interval_s: float = 0.5
     #: tasks in progress longer than this raise an alert flag
@@ -100,6 +107,8 @@ class Executor:
         topic_names: dict[int, str] | None = None,
         catalog=None,
         sensors=None,
+        removal_history_retention_ms: int = 1_209_600_000,
+        demotion_history_retention_ms: int = 1_209_600_000,
     ):
         from cruise_control_tpu.common.sensors import REGISTRY
 
@@ -115,9 +124,13 @@ class Executor:
         self._lock = threading.RLock()
         self.tracker = ExecutionTaskTracker()
         self._planner: ExecutionTaskPlanner | None = None
-        # reference Executor recentlyRemovedBrokers / recentlyDemotedBrokers
-        self.removed_brokers: set[int] = set()
-        self.demoted_brokers: set[int] = set()
+        # reference Executor recentlyRemovedBrokers / recentlyDemotedBrokers,
+        # timestamped so entries expire after the configured retention
+        # (reference ExecutorConfig {removal,demotion}.history.retention.time.ms)
+        self._removal_retention_ms = removal_history_retention_ms
+        self._demotion_retention_ms = demotion_history_retention_ms
+        self._removed_history: dict[int, int] = {}  # broker id -> recorded ms
+        self._demoted_history: dict[int, int] = {}
         self.num_executions_started = 0
         self.num_executions_stopped = 0
         self._uuid: str | None = None
@@ -125,6 +138,36 @@ class Executor:
         self._reexecutions: dict[tuple[str, int], int] = {}
 
     # ------------------------------------------------------------------
+
+    def _pruned(self, history: dict[int, int], retention_ms: int) -> set[int]:
+        # readers run on HTTP/detector threads while the execution thread
+        # inserts under the lock — prune must take it too
+        with self._lock:
+            cutoff = int(time.time() * 1000) - retention_ms
+            for b in [b for b, ts in history.items() if ts < cutoff]:
+                del history[b]
+            return set(history)
+
+    @property
+    def removed_brokers(self) -> set[int]:
+        """Recently removed brokers, expired per the retention window."""
+        return self._pruned(self._removed_history, self._removal_retention_ms)
+
+    @property
+    def demoted_brokers(self) -> set[int]:
+        """Recently demoted brokers, expired per the retention window."""
+        return self._pruned(self._demoted_history, self._demotion_retention_ms)
+
+    def drop_removed_brokers(self, broker_ids):
+        """Reference ADMIN drop_recently_removed_brokers."""
+        with self._lock:
+            for b in broker_ids:
+                self._removed_history.pop(b, None)
+
+    def drop_demoted_brokers(self, broker_ids):
+        with self._lock:
+            for b in broker_ids:
+                self._demoted_history.pop(b, None)
 
     @property
     def has_ongoing_execution(self) -> bool:
@@ -165,10 +208,11 @@ class Executor:
             self.num_executions_started += 1
             # reference Executor execution-started sensor (:118-125)
             self.sensors.counter("executor.execution-started").inc()
-            if removed_brokers:
-                self.removed_brokers |= removed_brokers
-            if demoted_brokers:
-                self.demoted_brokers |= demoted_brokers
+            now = int(time.time() * 1000)
+            for b in removed_brokers or ():
+                self._removed_history[b] = now
+            for b in demoted_brokers or ():
+                self._demoted_history[b] = now
             self.tracker = ExecutionTaskTracker()
             self._reexecutions = {}
             self._planner = ExecutionTaskPlanner(self.strategy)
@@ -260,10 +304,12 @@ class Executor:
                     task.kill(now_ms())
                     del in_flight[key]
 
-            # drain new tasks within caps
+            # drain new tasks within caps (per-broker AND the global
+            # max.num.cluster.movements budget)
             ready = self._ready_brokers(options, in_flight, topo)
+            budget = max(0, options.max_num_cluster_movements - len(in_flight))
             new_tasks = planner.get_inter_broker_replica_movement_tasks(
-                ready, set(in_flight)
+                ready, set(in_flight), max_total=budget
             )
             intra = planner.get_intra_broker_replica_movement_tasks(
                 {
@@ -308,9 +354,12 @@ class Executor:
         # --- phase 2: leadership movements ---
         if not self._stop_requested:
             self.state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
-            while True:
+            while not self._stop_requested:
                 batch = planner.get_leadership_movement_tasks(
-                    options.concurrent_leader_movements
+                    min(
+                        options.concurrent_leader_movements,
+                        options.max_num_cluster_movements,
+                    )
                 )
                 if not batch:
                     break
@@ -326,8 +375,57 @@ class Executor:
                         )
                     )
                 self.admin.elect_leaders(specs)
-                for t in batch:
-                    t.completed(now_ms())
+                # confirm against the topology; moves not confirmed within
+                # leader.movement.timeout.ms are DEAD (reference
+                # ExecutorConfig leader.movement.timeout.ms + the executor's
+                # leadership wait loop, Executor.java:1091-1136)
+                pending = {self._partition_key(t.proposal): t for t in batch}
+                deadline = now_ms() + int(options.leader_movement_timeout_s * 1000)
+                while pending:
+                    topo2 = self.admin.topology()
+                    alive2 = topo2.alive_broker_ids()
+                    parts = {(p.topic, p.partition): p for p in topo2.partitions}
+                    for key, t in list(pending.items()):
+                        target = t.proposal.new_leader
+                        p = parts.get(key)
+                        if p is not None and p.leader == target:
+                            t.completed(now_ms())
+                            del pending[key]
+                        elif target not in alive2:
+                            # target broker died — the election can never be
+                            # confirmed: DEAD immediately, don't burn the
+                            # timeout
+                            t.kill(now_ms())
+                            del pending[key]
+                        elif p is None or target not in p.replicas:
+                            # prerequisite replica placement never landed
+                            # (e.g. its move task went DEAD) — cancel the
+                            # dependent leadership move
+                            t.aborting(now_ms())
+                            t.aborted(now_ms())
+                            del pending[key]
+                    if not pending:
+                        break
+                    if self._stop_requested:
+                        # stop mid-confirmation: unconfirmed moves are
+                        # aborted, not left dangling
+                        for t in pending.values():
+                            t.aborting(now_ms())
+                            t.aborted(now_ms())
+                        pending.clear()
+                        break
+                    if now_ms() >= deadline:
+                        for t in pending.values():
+                            t.kill(now_ms())
+                            self.sensors.counter(
+                                "executor.leader-movement-timeout"
+                            ).inc()
+                        break
+                    if simulated:
+                        self.admin.tick(options.progress_check_interval_s)
+                        ticks += 1
+                    else:
+                        time.sleep(options.progress_check_interval_s)
 
         # abort anything still pending after a stop
         for t in self.tracker.tasks(state=TaskState.PENDING):

@@ -91,20 +91,25 @@ class ExecutionTaskPlanner:
         self,
         ready_brokers: dict[int, int],
         in_progress_partitions: set[tuple[int, int]],
+        max_total: int | None = None,
     ) -> list[ExecutionTask]:
         """Drain tasks whose source AND destination brokers have slots,
         round-robin across brokers so slots aren't starved
-        (reference getInterBrokerReplicaMovementTasks:314)."""
+        (reference getInterBrokerReplicaMovementTasks:314).  max_total
+        bounds the drain so the executor's global
+        max.num.cluster.movements budget is honored."""
         slots = dict(ready_brokers)
         chosen: list[ExecutionTask] = []
         chosen_ids: set[int] = set()
         partitions_involved = set(in_progress_partitions)
 
         new_task_added = True
-        while new_task_added:
+        while new_task_added and (max_total is None or len(chosen) < max_total):
             new_task_added = False
             brokers_involved: set[int] = set()
             for broker_id in list(slots):
+                if max_total is not None and len(chosen) >= max_total:
+                    break
                 if broker_id in brokers_involved or slots.get(broker_id, 0) <= 0:
                     continue
                 for t in self._inter:

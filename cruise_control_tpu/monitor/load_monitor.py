@@ -56,6 +56,12 @@ class NotEnoughValidWindowsError(Exception):
     """Reference NotEnoughValidWindowsException."""
 
 
+class BrokerCapacityEstimationError(Exception):
+    """A request forbade capacity estimation but a broker's capacity could
+    only be estimated (reference BrokerCapacityResolutionException +
+    sanityCheckCapacityEstimation)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelGeneration:
     """(metadata generation, load/sample generation) pair
@@ -180,10 +186,15 @@ class LoadMonitor:
 
         sensors = getattr(self, "sensors", None) or REGISTRY
         with sensors.timer("monitor.cluster-model-creation-timer").time():
-            return self._cluster_model_impl(requirements)
+            return self._cluster_model_impl(
+                requirements, allow_capacity_estimation=allow_capacity_estimation
+            )
 
     def _cluster_model_impl(
-        self, requirements: ModelCompletenessRequirements
+        self,
+        requirements: ModelCompletenessRequirements,
+        *,
+        allow_capacity_estimation: bool = True,
     ) -> ClusterState:
         topology = self.metadata.refresh()
         if self.topic_filter is not None:
@@ -213,7 +224,9 @@ class LoadMonitor:
                 f"valid partition ratio {agg.completeness.valid_entity_ratio:.3f} < "
                 f"required {requirements.min_monitored_partitions_percentage:.3f}"
             )
-        state = self._build_state(topology, agg)
+        state = self._build_state(
+            topology, agg, allow_capacity_estimation=allow_capacity_estimation
+        )
         with self._generation_lock:
             self._load_generation = agg.completeness.generation
         return state
@@ -250,7 +263,13 @@ class LoadMonitor:
         load[:, Resource.DISK] = latest[:, self._disk_id]
         return load
 
-    def _build_state(self, topology: ClusterTopology, agg) -> ClusterState:
+    def _build_state(
+        self,
+        topology: ClusterTopology,
+        agg,
+        *,
+        allow_capacity_estimation: bool = True,
+    ) -> ClusterState:
         entity_rows = self.partition_aggregator.entity_index()
         loads = self._window_reduced_loads(agg)
 
@@ -261,6 +280,15 @@ class LoadMonitor:
         builder = ClusterModelBuilder(replica_capacity=self._replica_capacity)
         for b in topology.brokers:
             info = self.capacity_resolver.capacity_for_broker(b.rack, b.host, b.broker_id)
+            if not allow_capacity_estimation and info.estimation_info:
+                # reference sanityCheckCapacityEstimation: requests that
+                # forbid estimation fail loudly when any broker capacity is
+                # an estimate rather than a resolved value
+                raise BrokerCapacityEstimationError(
+                    f"broker {b.broker_id} capacity is estimated "
+                    f"({info.estimation_info}) and the request disallows "
+                    "capacity estimation"
+                )
             disk_caps = None
             bad_disks = None
             if info.disk_capacities:

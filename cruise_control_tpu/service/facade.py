@@ -84,13 +84,30 @@ class CruiseControl:
         monitor.sensors = self.sensors
         self.constraint = config.balancing_constraint()
         self.chain = chain or GoalChain.from_names(config.get("default.goals"))
+        #: reference AnalyzerConfig goal.balancedness.{priority,strictness}.weight
+        #: — used by EVERY optimizer this facade builds, including the ad-hoc
+        #: per-request ones (custom goals / rebalance_disk)
+        self.balancedness_weights = (
+            config.get("goal.balancedness.priority.weight"),
+            config.get("goal.balancedness.strictness.weight"),
+        )
         self.optimizer = GoalOptimizer(
             chain=self.chain,
             constraint=self.constraint,
             config=config.optimizer_config(),
             parallel_mode=config.parallel_mode(),
+            balancedness_weights=self.balancedness_weights,
         )
-        self.executor = Executor(admin, sensors=self.sensors)
+        self.executor = Executor(
+            admin,
+            sensors=self.sensors,
+            removal_history_retention_ms=config.get(
+                "removal.history.retention.time.ms"
+            ),
+            demotion_history_retention_ms=config.get(
+                "demotion.history.retention.time.ms"
+            ),
+        )
         self._cache: _CachedResult | None = None
         self._cache_lock = threading.Lock()
         self._proposal_expiration_ms = config.get("proposal.expiration.ms")
@@ -122,7 +139,12 @@ class CruiseControl:
         )
         self.notifier = notifier
         self.actions = SelfHealingAdapter(self)
-        self.anomaly_detector = AnomalyDetector(notifier, self.actions, sensors=self.sensors)
+        self.anomaly_detector = AnomalyDetector(
+            notifier,
+            self.actions,
+            sensors=self.sensors,
+            history_size=config.get("num.cached.recent.anomaly.states"),
+        )
         self._wire_detectors()
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
@@ -135,8 +157,19 @@ class CruiseControl:
         from cruise_control_tpu.detector.detectors import SlowBrokerFinder
 
         req = ModelCompletenessRequirements(min_required_num_windows=1)
+        # the violation detector watches its own (usually smaller) goal list
+        # (reference AnomalyDetectorConfig anomaly.detection.goals:103-107)
+        detection_goals = self.config.get("anomaly.detection.goals")
+        detection_chain = (
+            GoalChain.from_names(detection_goals) if detection_goals else self.chain
+        )
+        allow_est = self.config.get("anomaly.detection.allow.capacity.estimation")
         gvd = GoalViolationDetector(
-            lambda: self.monitor.cluster_model(req), self.chain, self.constraint
+            lambda: self.monitor.cluster_model(
+                req, allow_capacity_estimation=allow_est
+            ),
+            detection_chain,
+            self.constraint,
         )
         bfd = BrokerFailureDetector(
             self.admin.topology,
@@ -219,11 +252,23 @@ class CruiseControl:
 
         self.broker_failure_detector = bfd
         self.slow_broker_finder = slow
-        self.anomaly_detector.register_detector(gvd.detect)
-        self.anomaly_detector.register_detector(bfd.detect)
-        self.anomaly_detector.register_detector(dfd.detect)
-        self.anomaly_detector.register_detector(rfd.detect)
-        self.anomaly_detector.register_detector(slow_detect)
+
+        def _interval(key: str) -> float | None:
+            ms = self.config.get(key)
+            return ms / 1000.0 if ms else None
+
+        reg = self.anomaly_detector.register_detector
+        reg(gvd.detect, interval_s=_interval("goal.violation.detection.interval.ms"))
+        # broker failures are watched every round (the reference's ZK
+        # watcher is effectively continuous); the backoff key only delays
+        # retries after a failed detection, it is NOT a cadence
+        reg(
+            bfd.detect,
+            error_backoff_s=_interval("broker.failure.detection.backoff.ms"),
+        )
+        reg(dfd.detect, interval_s=_interval("disk.failure.detection.interval.ms"))
+        reg(rfd.detect, interval_s=_interval("topic.anomaly.detection.interval.ms"))
+        reg(slow_detect, interval_s=_interval("metric.anomaly.detection.interval.ms"))
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp():162)
@@ -252,9 +297,14 @@ class CruiseControl:
         live cluster shape and fills the proposal cache, so the first user
         request pays cache-hit latency instead of the cold trace+compile+
         optimize warmup."""
+        allow_est = self.config.get("allow.capacity.estimation.on.proposal.precompute")
         while True:
             try:
-                self.proposals(OperationProgress(), ignore_cache=True)
+                self.proposals(
+                    OperationProgress(),
+                    ignore_cache=True,
+                    allow_capacity_estimation=allow_est,
+                )
             except Exception:  # noqa: BLE001 — precompute failures surface on demand
                 pass
             if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
@@ -264,7 +314,12 @@ class CruiseControl:
     # proposal computation + cache (reference optimizations():276-324,493)
     # ------------------------------------------------------------------
 
-    def _cluster_model(self, progress: OperationProgress) -> ClusterState:
+    def _cluster_model(
+        self,
+        progress: OperationProgress,
+        *,
+        allow_capacity_estimation: bool = True,
+    ) -> ClusterState:
         progress.add_step(WaitingForClusterModel())
         with self.monitor.acquire_for_model_generation():
             progress.add_step(GeneratingClusterModel())
@@ -274,7 +329,26 @@ class CruiseControl:
                     "min.valid.partition.ratio"
                 ),
             )
-            return self.monitor.cluster_model(req)
+            return self.monitor.cluster_model(
+                req, allow_capacity_estimation=allow_capacity_estimation
+            )
+
+    def _make_optimizer(
+        self, goals: list[str], *, intra_broker: bool = False
+    ) -> GoalOptimizer:
+        """Ad-hoc optimizer for a custom goal list (reference builds a
+        per-request goalsByPriority); carries the SAME constraint/config/
+        balancedness weights as the default optimizer so a request-scoped
+        knob cannot silently fall back to hardcoded defaults."""
+        cfg = self.config.optimizer_config()
+        if intra_broker:
+            cfg = dataclasses.replace(cfg, intra_broker=True)
+        return GoalOptimizer(
+            chain=GoalChain.from_names(goals),
+            constraint=self.constraint,
+            config=cfg,
+            balancedness_weights=self.balancedness_weights,
+        )
 
     def proposals(
         self,
@@ -283,27 +357,38 @@ class CruiseControl:
         ignore_cache: bool = False,
         options: OptimizationOptions | None = None,
         goals: list[str] | None = None,
+        allow_capacity_estimation: bool = True,
     ) -> OptimizerResult:
         """Cached unless options/goals are non-default
-        (reference ignoreProposalCache():469)."""
-        cacheable = options is None and goals is None
-        if cacheable and not ignore_cache:
+        (reference ignoreProposalCache():469).
+
+        A request that forbids capacity estimation must not be served from a
+        cache the precompute loop filled with estimation allowed (reference
+        sanity-checks capacityEstimationInfoByBrokerId on cached results) —
+        it bypasses the cache and builds its own model under the flag.  Its
+        RESULT is still stored: a no-estimation result is strictly safer
+        than an estimated one, so a no-estimation precompute loop fills the
+        cache rather than discarding every cycle."""
+        storable = options is None and goals is None
+        servable = storable and allow_capacity_estimation
+        if servable and not ignore_cache:
             cached = self._valid_cache()
             if cached is not None:
                 return cached
-        state = self._cluster_model(progress)
-        optimizer = self.optimizer
-        if goals is not None:
-            optimizer = GoalOptimizer(
-                chain=GoalChain.from_names(goals),
-                constraint=self.constraint,
-                config=self.config.optimizer_config(),
-            )
+        state = self._cluster_model(
+            progress, allow_capacity_estimation=allow_capacity_estimation
+        )
+        if options is None:
+            # config-level always-excluded topics apply to the default path
+            # too (reference AnalyzerConfig
+            # topics.excluded.from.partition.movement)
+            options = self._build_options(state)
+        optimizer = self.optimizer if goals is None else self._make_optimizer(goals)
         progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
         # reference GoalOptimizer proposal-computation-timer (:116,155)
         with self.sensors.timer("analyzer.proposal-computation-timer").time():
             result = optimizer.optimize(state, options=options or OptimizationOptions())
-        if cacheable:
+        if storable:
             with self._cache_lock:
                 self._cache = _CachedResult(
                     result, int(time.time() * 1000), self.monitor.model_generation()
@@ -365,6 +450,9 @@ class CruiseControl:
             concurrent_leader_movements=_ov(
                 "concurrent_leader_movements", "num.concurrent.leader.movements"
             ),
+            max_num_cluster_movements=self.config.get("max.num.cluster.movements"),
+            leader_movement_timeout_s=self.config.get("leader.movement.timeout.ms")
+            / 1000.0,
             replication_throttle_bytes_per_s=_ov(
                 "replication_throttle", "default.replication.throttle"
             ),
@@ -395,24 +483,52 @@ class CruiseControl:
         *,
         destination_broker_ids: list[int] | None = None,
         excluded_topics_pattern: str | None = None,
+        excluded_brokers_for_replica_move: list[int] | None = None,
+        excluded_brokers_for_leadership: list[int] | None = None,
     ) -> OptimizationOptions:
         """Translate request parameters into array masks
-        (reference OptimizationOptions construction in RunnableUtils)."""
+        (reference OptimizationOptions construction in RunnableUtils).
+
+        The config-level topics.excluded.from.partition.movement pattern is
+        always merged in (reference AnalyzerConfig; per-request
+        excluded_topics only ever widens the exclusion)."""
         import re
 
-        dest = None
-        if destination_broker_ids:
-            dest = np.zeros(state.shape.B, bool)
-            dest[list(destination_broker_ids)] = True
+        def _mask(ids):
+            # ids can outlive the topology (e.g. the recently-removed
+            # history retains a decommissioned broker for 14 days while the
+            # model shrinks) — ignore ids outside the current model
+            ids = [b for b in (ids or ()) if 0 <= b < state.shape.B]
+            if not ids:
+                return None
+            m = np.zeros(state.shape.B, bool)
+            m[ids] = True
+            return m
+
         excluded_topics = None
-        if excluded_topics_pattern and self.monitor.last_catalog is not None:
-            rx = re.compile(excluded_topics_pattern)
-            excluded_topics = np.array(
-                [bool(rx.fullmatch(t)) for t in self.monitor.last_catalog.topics], bool
+        patterns = [
+            p
+            for p in (
+                self.config.get("topics.excluded.from.partition.movement"),
+                excluded_topics_pattern,
             )
+            if p
+        ]
+        if patterns and self.monitor.last_catalog is not None:
+            rxs = [re.compile(p) for p in patterns]
+            excluded_topics = np.array(
+                [
+                    any(rx.fullmatch(t) for rx in rxs)
+                    for t in self.monitor.last_catalog.topics
+                ],
+                bool,
+            )
+
         return OptimizationOptions(
             excluded_topics=excluded_topics,
-            requested_destination_brokers=dest,
+            requested_destination_brokers=_mask(destination_broker_ids),
+            excluded_brokers_for_replica_move=_mask(excluded_brokers_for_replica_move),
+            excluded_brokers_for_leadership=_mask(excluded_brokers_for_leadership),
         )
 
     def rebalance(
@@ -423,7 +539,10 @@ class CruiseControl:
         goals: list[str] | None = None,
         destination_broker_ids: list[int] | None = None,
         excluded_topics_pattern: str | None = None,
+        excluded_brokers_for_replica_move: list[int] | None = None,
+        excluded_brokers_for_leadership: list[int] | None = None,
         rebalance_disk: bool = False,
+        allow_capacity_estimation: bool = True,
         execution_overrides: dict | None = None,
     ) -> dict:
         """Reference RebalanceRunnable.workWithoutClusterModel:116.
@@ -433,38 +552,35 @@ class CruiseControl:
         (reference rebalance_disk semantics; AnalyzerConfig.java:236
         default.intra.broker.goals)."""
         custom = bool(
-            destination_broker_ids or excluded_topics_pattern or goals or rebalance_disk
+            destination_broker_ids or excluded_topics_pattern or goals
+            or rebalance_disk or excluded_brokers_for_replica_move
+            or excluded_brokers_for_leadership
         )
         if custom:
-            state = self._cluster_model(progress)
+            state = self._cluster_model(
+                progress, allow_capacity_estimation=allow_capacity_estimation
+            )
             options = self._build_options(
                 state,
                 destination_broker_ids=destination_broker_ids,
                 excluded_topics_pattern=excluded_topics_pattern,
+                excluded_brokers_for_replica_move=excluded_brokers_for_replica_move,
+                excluded_brokers_for_leadership=excluded_brokers_for_leadership,
             )
             optimizer = self.optimizer
             if rebalance_disk:
-                from cruise_control_tpu.analyzer.goals import (
-                    DEFAULT_INTRA_BROKER_GOAL_ORDER,
-                )
-
-                optimizer = GoalOptimizer(
-                    chain=GoalChain.from_names(goals or DEFAULT_INTRA_BROKER_GOAL_ORDER),
-                    constraint=self.constraint,
-                    config=dataclasses.replace(
-                        self.config.optimizer_config(), intra_broker=True
-                    ),
+                optimizer = self._make_optimizer(
+                    goals or self.config.get("intra.broker.goals"),
+                    intra_broker=True,
                 )
             elif goals is not None:
-                optimizer = GoalOptimizer(
-                    chain=GoalChain.from_names(goals),
-                    constraint=self.constraint,
-                    config=self.config.optimizer_config(),
-                )
+                optimizer = self._make_optimizer(goals)
             progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
             result = optimizer.optimize(state, options=options)
         else:
-            result = self.proposals(progress)
+            result = self.proposals(
+                progress, allow_capacity_estimation=allow_capacity_estimation
+            )
         out = result.summary()
         out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
@@ -615,12 +731,53 @@ class SelfHealingAdapter:
         except Exception:  # noqa: BLE001 — fix failure is reported, not fatal
             return False
 
+    def _healing_kwargs(self) -> dict:
+        """Self-healing runs with its own goal list and keeps replicas and
+        leadership off recently removed/demoted brokers (reference
+        AnomalyDetectorConfig self.healing.goals +
+        self.healing.exclude.recently.{removed,demoted}.brokers)."""
+        cfg = self.cc.config
+        kwargs: dict = {}
+        healing_goals = cfg.get("self.healing.goals")
+        if healing_goals:
+            kwargs["goals"] = healing_goals
+        ex = self.cc.executor
+        if cfg.get("self.healing.exclude.recently.removed.brokers"):
+            removed = sorted(ex.removed_brokers)
+            if removed:
+                kwargs["excluded_brokers_for_replica_move"] = removed
+        if cfg.get("self.healing.exclude.recently.demoted.brokers"):
+            demoted = sorted(ex.demoted_brokers)
+            if demoted:
+                kwargs["excluded_brokers_for_leadership"] = demoted
+        return kwargs
+
     def rebalance(self, reason: str) -> bool:
-        return self._guarded(lambda: self.cc.rebalance(OperationProgress(), dryrun=False))
+        return self._guarded(
+            lambda: self.cc.rebalance(
+                OperationProgress(), dryrun=False, **self._healing_kwargs()
+            )
+        )
 
     def remove_brokers(self, broker_ids, reason: str) -> bool:
+        # destructive-removal guard (reference AnomalyDetectorConfig
+        # fixable.failed.broker.{count,percentage}.threshold:138-147): when
+        # too much of the cluster is implicated the anomaly is not fixable
+        # by removal and a human must intervene
+        cfg = self.cc.config
+        ids = list(broker_ids)
+        if len(ids) > cfg.get("fixable.failed.broker.count.threshold"):
+            return False
+        try:
+            total = len(self.cc.admin.topology().brokers)
+        except Exception:  # noqa: BLE001 — unknown size: fall back to count gate
+            total = 0
+        if total and len(ids) / total > cfg.get(
+            "fixable.failed.broker.percentage.threshold"
+        ):
+            return False
         return self._guarded(
-            lambda: self.cc.remove_brokers(OperationProgress(), list(broker_ids), dryrun=False)
+            lambda: self.cc.remove_brokers(OperationProgress(), ids, dryrun=False)
         )
 
     def demote_brokers(self, broker_ids, reason: str) -> bool:

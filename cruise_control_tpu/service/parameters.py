@@ -140,7 +140,11 @@ ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {  # noqa: E305
         "rebalance": (_DRYRUN, Param("goals", _str_list),
                       Param("destination_broker_ids", _int_list),
                       Param("excluded_topics", _regex),
-                      Param("rebalance_disk", _bool), _REVIEW_ID, *_EXECUTION),
+                      Param("rebalance_disk", _bool),
+                      Param("allow_capacity_estimation", _bool),
+                      Param("exclude_recently_removed_brokers", _bool),
+                      Param("exclude_recently_demoted_brokers", _bool),
+                      _REVIEW_ID, *_EXECUTION),
         "stop_proposal_execution": (Param("force_stop", _bool), _REVIEW_ID),
         "pause_sampling": (_REASON, _REVIEW_ID),
         "resume_sampling": (_REASON, _REVIEW_ID),

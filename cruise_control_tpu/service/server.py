@@ -341,9 +341,14 @@ class CruiseControlApp:
 
     def _ep_proposals(self, params) -> tuple[int, dict]:
         ignore_cache = _parse_bool(params, "ignore_proposal_cache", False)
+        allow_est = _parse_bool(params, "allow_capacity_estimation", True)
 
         def op(progress):
-            result = self.cc.proposals(progress, ignore_cache=ignore_cache)
+            result = self.cc.proposals(
+                progress,
+                ignore_cache=ignore_cache,
+                allow_capacity_estimation=allow_est,
+            )
             out = result.summary()
             out["proposals"] = [p.to_json() for p in result.proposals[:100]]
             return out
@@ -396,10 +401,23 @@ class CruiseControlApp:
     def _ep_rebalance(self, params) -> tuple[int, dict]:
         dryrun = _parse_bool(params, "dryrun", True)
         rebalance_disk = _parse_bool(params, "rebalance_disk", False)
+        allow_est = _parse_bool(params, "allow_capacity_estimation", True)
         goals = params.get("goals", [None])[0]
         dests = params.get("destination_broker_ids", [None])[0]
         excluded = params.get("excluded_topics", [None])[0]
         overrides = _parse_execution_overrides(params)
+        # reference rebalance parameters exclude recently removed/demoted
+        # brokers from receiving replicas/leadership
+        ex_removed = (
+            sorted(self.cc.executor.removed_brokers)
+            if _parse_bool(params, "exclude_recently_removed_brokers", False)
+            else None
+        )
+        ex_demoted = (
+            sorted(self.cc.executor.demoted_brokers)
+            if _parse_bool(params, "exclude_recently_demoted_brokers", False)
+            else None
+        )
 
         def op(progress):
             return self.cc.rebalance(
@@ -408,7 +426,10 @@ class CruiseControlApp:
                 goals=goals.split(",") if goals else None,
                 destination_broker_ids=[int(x) for x in dests.split(",")] if dests else None,
                 excluded_topics_pattern=excluded,
+                excluded_brokers_for_replica_move=ex_removed,
+                excluded_brokers_for_leadership=ex_demoted,
                 rebalance_disk=rebalance_disk,
+                allow_capacity_estimation=allow_est,
                 execution_overrides=overrides,
             )
 
@@ -496,8 +517,7 @@ class CruiseControlApp:
             ]
         drop = params.get("drop_recently_removed_brokers", [None])[0]
         if drop:
-            for b in drop.split(","):
-                self.cc.executor.removed_brokers.discard(int(b))
+            self.cc.executor.drop_removed_brokers(int(b) for b in drop.split(","))
             out["recentlyRemovedBrokers"] = sorted(self.cc.executor.removed_brokers)
         return 200, out
 

@@ -1,0 +1,258 @@
+"""Config-key wiring tests: every key added for reference parity must
+actually change behavior (VERDICT r2 missing #6 — config-key surface).
+
+Reference anchors: config/constants/AnomalyDetectorConfig.java,
+ExecutorConfig.java, AnalyzerConfig.java.
+"""
+
+import time
+
+import pytest
+
+from cruise_control_tpu.config import ConfigException, CruiseControlConfig
+from cruise_control_tpu.service.main import build_simulated_service
+
+
+def test_new_key_defaults_match_reference():
+    c = CruiseControlConfig({})
+    assert c.get("anomaly.detection.goals") == [
+        "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    ]
+    assert c.get("self.healing.goals") == []
+    assert c.get("num.cached.recent.anomaly.states") == 10
+    assert c.get("max.num.cluster.movements") == 1250
+    assert c.get("leader.movement.timeout.ms") == 180_000
+    assert c.get("removal.history.retention.time.ms") == 1_209_600_000
+    assert c.get("fixable.failed.broker.count.threshold") == 10
+    assert c.get("fixable.failed.broker.percentage.threshold") == 0.4
+    assert c.get("goal.balancedness.priority.weight") == 1.1
+    assert c.get("goal.balancedness.strictness.weight") == 1.5
+    # per-detector interval overrides default to unset (fall back to the
+    # base anomaly.detection.interval.ms)
+    assert c.get("goal.violation.detection.interval.ms") is None
+
+
+def test_goal_list_keys_are_validated():
+    for key in ("anomaly.detection.goals", "self.healing.goals",
+                "intra.broker.goals"):
+        with pytest.raises(ConfigException):
+            CruiseControlConfig({key: "NoSuchGoal"})
+
+
+def test_detector_interval_scheduling():
+    """Per-detector cadence: a detector with a long interval runs once per
+    window while unset-interval detectors run every scheduled round."""
+    from cruise_control_tpu.detector.detector import AnomalyDetector
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+
+    class _IdleActions:
+        is_busy = False
+
+    det = AnomalyDetector(SelfHealingNotifier(), _IdleActions())
+    calls = {"fast": 0, "slow": 0}
+    det.register_detector(lambda: calls.__setitem__("fast", calls["fast"] + 1))
+    det.register_detector(
+        lambda: calls.__setitem__("slow", calls["slow"] + 1), interval_s=3600
+    )
+    for _ in range(3):
+        det.run_once(respect_intervals=True)
+    assert calls["fast"] == 3
+    assert calls["slow"] == 1
+    # forced rounds (default) ignore cadence — deterministic for tests
+    det.run_once()
+    assert calls["slow"] == 2
+
+
+def test_anomaly_history_size_config():
+    from cruise_control_tpu.detector.detector import AnomalyDetector
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+
+    class _IdleActions:
+        is_busy = False
+
+    det = AnomalyDetector(SelfHealingNotifier(), _IdleActions(), history_size=2)
+    assert det.state.recent[next(iter(det.state.recent))].maxlen == 2
+
+
+def test_executor_history_retention_and_drop():
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(synthetic_topology(num_brokers=3, topics={"T0": 3}))
+    )
+    ex = Executor(admin, removal_history_retention_ms=50,
+                  demotion_history_retention_ms=10_000)
+    ex.execute_proposals([], removed_brokers={1}, demoted_brokers={2})
+    assert ex.removed_brokers == {1}
+    assert ex.demoted_brokers == {2}
+    time.sleep(0.06)
+    # removal history expired; demotion retention is longer
+    assert ex.removed_brokers == set()
+    assert ex.demoted_brokers == {2}
+    ex.drop_demoted_brokers([2])
+    assert ex.demoted_brokers == set()
+
+
+def test_planner_max_total_budget():
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+
+    planner = ExecutionTaskPlanner()
+    proposals = [
+        ExecutionProposal(
+            topic="T0", partition=i, old_leader=0, new_leader=1,
+            old_replicas=(0,), new_replicas=(1,),
+            inter_broker_data_to_move=1.0,
+        )
+        for i in range(10)
+    ]
+    planner.add_execution_proposals(proposals, None)
+    ready = {0: 100, 1: 100}
+    got = planner.get_inter_broker_replica_movement_tasks(ready, set(), max_total=3)
+    assert len(got) == 3
+    # the rest stay queued for later rounds
+    more = planner.get_inter_broker_replica_movement_tasks(
+        {0: 100, 1: 100}, set(), max_total=100
+    )
+    assert len(more) == 7
+
+
+@pytest.fixture(scope="module")
+def wired_service():
+    config = CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,
+            "tpu.num.candidates": 128,
+            "tpu.leadership.candidates": 32,
+            "tpu.steps.per.round": 16,
+            "tpu.num.rounds": 2,
+            "anomaly.detection.goals": "RackAwareGoal,ReplicaCapacityGoal",
+            "self.healing.goals": "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal",
+            "fixable.failed.broker.count.threshold": "2",
+            "fixable.failed.broker.percentage.threshold": "0.5",
+            "topics.excluded.from.partition.movement": "T1",
+        }
+    )
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=11)
+    yield app
+
+
+def test_anomaly_detection_goals_chain(wired_service):
+    cc = wired_service.cc
+    # the violation detector watches its own configured (smaller) chain
+    gvd_chain_names = None
+    for fn, _interval, _backoff in cc.anomaly_detector._detectors:
+        owner = getattr(fn, "__self__", None)
+        if owner is not None and hasattr(owner, "chain"):
+            gvd_chain_names = owner.chain.names()
+            break
+    assert gvd_chain_names == ["RackAwareGoal", "ReplicaCapacityGoal"]
+
+
+def test_self_healing_kwargs(wired_service):
+    cc = wired_service.cc
+    cc.executor._removed_history[4] = int(time.time() * 1000)
+    cc.executor._demoted_history[5] = int(time.time() * 1000)
+    try:
+        kwargs = cc.actions._healing_kwargs()
+        assert kwargs["goals"] == [
+            "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+        ]
+        assert kwargs["excluded_brokers_for_replica_move"] == [4]
+        assert kwargs["excluded_brokers_for_leadership"] == [5]
+    finally:
+        cc.executor.drop_removed_brokers([4])
+        cc.executor.drop_demoted_brokers([5])
+
+
+def test_fixable_failed_broker_thresholds(wired_service):
+    cc = wired_service.cc
+    # count gate: 3 > threshold 2
+    assert cc.actions.remove_brokers([0, 1, 2], reason="test") is False
+    # percentage gate: 2 of 6 brokers is fine by count (<=2) and <= 50%,
+    # so the guard passes through to the (dryrun=False) operation which we
+    # do not want to actually run here — patch the facade call
+    called = {}
+    orig = cc.remove_brokers
+    cc.remove_brokers = lambda *a, **k: called.setdefault("yes", True) or {}
+    try:
+        assert cc.actions.remove_brokers([0, 1], reason="test") is True
+        assert called
+    finally:
+        cc.remove_brokers = orig
+
+
+def test_config_excluded_topics_merged(wired_service):
+    cc = wired_service.cc
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    state = cc._cluster_model(OperationProgress())
+    opts = cc._build_options(state)
+    assert opts.excluded_topics is not None
+    catalog = cc.monitor.last_catalog
+    t1 = catalog.topics.index("T1")
+    assert bool(opts.excluded_topics[t1])
+    t0 = catalog.topics.index("T0")
+    assert not bool(opts.excluded_topics[t0])
+    # request pattern widens, never narrows
+    opts2 = cc._build_options(state, excluded_topics_pattern="T0")
+    assert bool(opts2.excluded_topics[t0]) and bool(opts2.excluded_topics[t1])
+
+
+def test_cache_not_served_when_estimation_forbidden(wired_service):
+    """A request with allow_capacity_estimation=false must not be served
+    from a cache filled with estimation allowed (reference sanity-checks
+    capacityEstimationInfoByBrokerId on cached results)."""
+    import dataclasses
+
+    from cruise_control_tpu.monitor.load_monitor import (
+        BrokerCapacityEstimationError,
+    )
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    cc = wired_service.cc
+    cc.proposals(OperationProgress())  # fill the cache (estimation allowed)
+    resolver = cc.monitor.capacity_resolver
+    orig = resolver.capacity_for_broker
+    resolver.capacity_for_broker = lambda r, h, b: dataclasses.replace(
+        orig(r, h, b), estimation_info="estimated"
+    )
+    try:
+        with pytest.raises(BrokerCapacityEstimationError):
+            cc.proposals(OperationProgress(), allow_capacity_estimation=False)
+    finally:
+        resolver.capacity_for_broker = orig
+        cc.invalidate_proposal_cache()
+
+
+def test_capacity_estimation_forbidden(wired_service):
+    import dataclasses
+
+    from cruise_control_tpu.monitor.load_monitor import (
+        BrokerCapacityEstimationError,
+    )
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    cc = wired_service.cc
+    resolver = cc.monitor.capacity_resolver
+    orig = resolver.capacity_for_broker
+
+    def estimated(rack, host, broker_id):
+        return dataclasses.replace(
+            orig(rack, host, broker_id), estimation_info="default capacity"
+        )
+
+    resolver.capacity_for_broker = estimated
+    try:
+        with pytest.raises(BrokerCapacityEstimationError):
+            cc._cluster_model(OperationProgress(), allow_capacity_estimation=False)
+        # allowed by default
+        cc._cluster_model(OperationProgress())
+    finally:
+        resolver.capacity_for_broker = orig

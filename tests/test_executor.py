@@ -191,7 +191,10 @@ def test_dead_destination_marks_task_dead(sim):
     sim.tick = kill_broker_2
     props = [proposal(0, 0, [0, 1], [2, 1], data=100_000.0)]
     res = ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=1.0))
-    assert res.dead == 1
+    # the replica move to dead broker 2 is DEAD, and so is the leadership
+    # transfer onto it (its election can never be confirmed)
+    assert res.dead == 2
+    assert res.completed == 0
 
 
 def test_ongoing_execution_guard(sim):
