@@ -291,16 +291,75 @@ def _webserver_defs() -> ConfigDef:
     d.define("webserver.http.address", T.STRING, "127.0.0.1", I.MEDIUM, "bind address", group=g)
     d.define("webserver.api.urlprefix", T.STRING, "/kafkacruisecontrol", I.LOW, "", group=g)
     d.define("webserver.session.maxExpiryPeriodMs", T.LONG, 3_600_000, I.LOW, "", group=g)
+    d.define("webserver.session.path", T.STRING, "/", I.LOW,
+             "session cookie Path attribute (reference webserver.session.path)",
+             group=g)
     d.define("max.cached.completed.user.tasks", T.INT, 100, I.LOW, "", group=g)
     d.define("completed.user.task.retention.time.ms", T.LONG, 86_400_000, I.LOW, "", group=g)
+    d.define("max.active.user.tasks", T.INT, 25, I.LOW,
+             "cap on concurrently Active async user tasks; beyond it new "
+             "operations are rejected (reference WebServerConfig "
+             "max.active.user.tasks)", in_range(lo=1), group=g)
+    # per-category completed-task caches (reference UserTaskManagerConfig:
+    # unset falls back to the general cap/retention above)
+    for cat in ("kafka.monitor", "cruise.control.monitor",
+                "kafka.admin", "cruise.control.admin"):
+        d.define(f"max.cached.completed.{cat}.user.tasks", T.INT, None, I.LOW,
+                 f"completed-task cache size for {cat} endpoints", group=g)
+        d.define(f"completed.{cat}.user.task.retention.time.ms", T.LONG, None,
+                 I.LOW, f"completed-task retention for {cat} endpoints", group=g)
+    d.define("request.reason.required", T.BOOLEAN, False, I.LOW,
+             "POST requests must carry a reason parameter "
+             "(reference WebServerConfig request.reason.required)", group=g)
+    d.define("two.step.purgatory.max.requests", T.INT, 25, I.LOW,
+             "cap on requests parked for review "
+             "(reference WebServerConfig:149)", in_range(lo=1), group=g)
+    d.define("two.step.purgatory.retention.time.ms", T.LONG, 1_209_600_000,
+             I.LOW, "how long parked requests stay reviewable "
+             "(reference WebServerConfig:141, default 336h)",
+             in_range(lo=1), group=g)
+    # CORS (reference WebServerConfig:42-70)
+    d.define("webserver.http.cors.enabled", T.BOOLEAN, False, I.LOW,
+             "emit CORS headers + answer OPTIONS preflight", group=g)
+    d.define("webserver.http.cors.origin", T.STRING, "*", I.LOW,
+             "Access-Control-Allow-Origin value", group=g)
+    d.define("webserver.http.cors.allowmethods", T.STRING, "OPTIONS, GET, POST",
+             I.LOW, "Access-Control-Allow-Methods value", group=g)
+    d.define("webserver.http.cors.exposeheaders", T.STRING, "User-Task-ID",
+             I.LOW, "Access-Control-Expose-Headers value", group=g)
+    # NCSA access log (reference WebServerConfig:119-134; Jetty NCSARequestLog)
+    d.define("webserver.accesslog.enabled", T.BOOLEAN, False, I.LOW,
+             "write an NCSA-format access log (reference defaults true; off "
+             "here so embedded instances stay hermetic)", group=g)
+    d.define("webserver.accesslog.path", T.STRING, "access.log", I.LOW,
+             "access log file; rolled daily", group=g)
+    d.define("webserver.accesslog.retention.days", T.INT, 7, I.LOW,
+             "rolled access logs older than this are deleted",
+             in_range(lo=1), group=g)
     d.define("webserver.security.enable", T.BOOLEAN, False, I.MEDIUM, "", group=g)
     d.define("basic.auth.credentials.file", T.STRING, None, I.MEDIUM,
              "htpasswd-style user:password[:role] lines", group=g)
+    d.define("webserver.auth.credentials.file", T.STRING, None, I.MEDIUM,
+             "reference name for basic.auth.credentials.file; takes "
+             "precedence when both are set (WebServerConfig:179)", group=g)
     d.define("jwt.secret.key", T.STRING, None, I.MEDIUM,
              "enables HS256 bearer-token auth when set", group=g)
     d.define("jwt.authentication.certificate.location", T.STRING, None, I.MEDIUM,
              "PEM public key or X.509 certificate enabling RS256 bearer-token "
              "auth (reference servlet/security/jwt/JwtAuthenticator)", group=g)
+    d.define("jwt.auth.certificate.location", T.STRING, None, I.MEDIUM,
+             "reference name for jwt.authentication.certificate.location; "
+             "takes precedence when both are set", group=g)
+    d.define("jwt.cookie.name", T.STRING, None, I.LOW,
+             "also accept the JWT from this cookie (reference "
+             "WebServerConfig:243; Authorization header still wins)", group=g)
+    d.define("jwt.expected.audiences", T.LIST, "", I.LOW,
+             "token aud claim must intersect this list when set "
+             "(reference JwtAuthenticator audience check)", group=g)
+    d.define("jwt.authentication.provider.url", T.STRING, None, I.LOW,
+             "unauthenticated browser requests are redirected (302) here; "
+             "{redirect} in the URL is replaced with the original request "
+             "(reference WebServerConfig:233)", group=g)
     d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
              "POSTs park in the review purgatory first", group=g)
     # TLS for the REST listener (reference KafkaCruiseControlApp.java:100-120
@@ -313,6 +372,9 @@ def _webserver_defs() -> ConfigDef:
              "PEM private-key file (defaults to the certificate file)", group=g)
     d.define("webserver.ssl.key.password", T.STRING, None, I.LOW,
              "private-key passphrase", group=g)
+    d.define("webserver.ssl.protocol", T.STRING, "TLS", I.LOW,
+             "minimum TLS version for the listener: TLS (library default), "
+             "TLSv1.2 or TLSv1.3 (reference WebServerConfig:226)", group=g)
     # SASL toward the Kafka cluster (reference rides JAAS,
     # config/cruise_control_jaas.conf_template; the wire client speaks
     # SaslHandshake + SCRAM itself)

@@ -18,3 +18,30 @@ POST_ENDPOINTS = (
     "demote_broker", "admin", "review", "topic_configuration",
 )
 ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS
+
+#: endpoint category (reference CruiseControlEndPoint.java:17-36) — drives
+#: the per-category completed-user-task caches/retention
+#: (config/constants/UserTaskManagerConfig.java)
+ENDPOINT_TYPES = {
+    "bootstrap": "CRUISE_CONTROL_ADMIN",
+    "train": "CRUISE_CONTROL_ADMIN",
+    "load": "KAFKA_MONITOR",
+    "partition_load": "KAFKA_MONITOR",
+    "proposals": "KAFKA_MONITOR",
+    "state": "CRUISE_CONTROL_MONITOR",
+    "add_broker": "KAFKA_ADMIN",
+    "remove_broker": "KAFKA_ADMIN",
+    "fix_offline_replicas": "KAFKA_ADMIN",
+    "rebalance": "KAFKA_ADMIN",
+    "stop_proposal_execution": "KAFKA_ADMIN",
+    "pause_sampling": "CRUISE_CONTROL_ADMIN",
+    "resume_sampling": "CRUISE_CONTROL_ADMIN",
+    "kafka_cluster_state": "KAFKA_MONITOR",
+    "demote_broker": "KAFKA_ADMIN",
+    "user_tasks": "CRUISE_CONTROL_MONITOR",
+    "review_board": "CRUISE_CONTROL_MONITOR",
+    "admin": "CRUISE_CONTROL_ADMIN",
+    "review": "CRUISE_CONTROL_ADMIN",
+    "topic_configuration": "KAFKA_ADMIN",
+}
+assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)

@@ -311,11 +311,14 @@ class Executor:
             new_tasks = planner.get_inter_broker_replica_movement_tasks(
                 ready, set(in_flight), max_total=budget
             )
+            # intra-broker moves share the global movement budget: whatever
+            # the inter-broker drain left of it this tick
             intra = planner.get_intra_broker_replica_movement_tasks(
                 {
                     b: options.concurrent_intra_broker_partition_movements
                     for b in alive
-                }
+                },
+                max_total=max(0, budget - len(new_tasks)),
             )
             if new_tasks:
                 specs = []
@@ -343,7 +346,11 @@ class Executor:
                 )
                 t.completed(now_ms())
 
-            if not in_flight and not planner.remaining_inter_broker_moves:
+            if (
+                not in_flight
+                and not planner.remaining_inter_broker_moves
+                and not planner.remaining_intra_broker_moves
+            ):
                 break
             ticks += 1
             if simulated:

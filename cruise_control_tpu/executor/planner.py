@@ -73,13 +73,16 @@ class ExecutionTaskPlanner:
         return out
 
     def get_intra_broker_replica_movement_tasks(
-        self, ready_brokers: dict[int, int]
+        self, ready_brokers: dict[int, int], max_total: int | None = None
     ) -> list[ExecutionTask]:
         out = []
         rest = []
         for t in self._intra:
             b = t.proposal.new_replicas[0] if t.proposal.new_replicas else -1
-            if ready_brokers.get(b, 0) > 0:
+            if (
+                ready_brokers.get(b, 0) > 0
+                and (max_total is None or len(out) < max_total)
+            ):
                 ready_brokers[b] -= 1
                 out.append(t)
             else:

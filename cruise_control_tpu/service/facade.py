@@ -494,10 +494,19 @@ class CruiseControl:
         excluded_topics only ever widens the exclusion)."""
         import re
 
-        def _mask(ids):
-            # ids can outlive the topology (e.g. the recently-removed
-            # history retains a decommissioned broker for 14 days while the
-            # model shrinks) — ignore ids outside the current model
+        def _mask(ids, *, strict: bool):
+            # strict (explicitly requested brokers, e.g. add_broker
+            # destinations): an unknown id must FAIL the request — silently
+            # dropping it would degrade add_broker into an unconstrained
+            # full-cluster rebalance.  Non-strict (history-derived
+            # exclusions): the recently-removed history legitimately
+            # retains brokers the shrunken model no longer has — drop those.
+            unknown = [b for b in (ids or ()) if not 0 <= b < state.shape.B]
+            if strict and unknown:
+                raise ValueError(
+                    f"broker ids {unknown} are not in the cluster model "
+                    f"(brokers 0..{state.shape.B - 1})"
+                )
             ids = [b for b in (ids or ()) if 0 <= b < state.shape.B]
             if not ids:
                 return None
@@ -526,9 +535,13 @@ class CruiseControl:
 
         return OptimizationOptions(
             excluded_topics=excluded_topics,
-            requested_destination_brokers=_mask(destination_broker_ids),
-            excluded_brokers_for_replica_move=_mask(excluded_brokers_for_replica_move),
-            excluded_brokers_for_leadership=_mask(excluded_brokers_for_leadership),
+            requested_destination_brokers=_mask(destination_broker_ids, strict=True),
+            excluded_brokers_for_replica_move=_mask(
+                excluded_brokers_for_replica_move, strict=False
+            ),
+            excluded_brokers_for_leadership=_mask(
+                excluded_brokers_for_leadership, strict=False
+            ),
         )
 
     def rebalance(

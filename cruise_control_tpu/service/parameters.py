@@ -115,10 +115,9 @@ _REVIEW_ID = Param("review_id", _int, "two-step verification approval id")
 _REASON = Param("reason", str)
 
 #: the builtin parameter map (reference CruiseControlParametersConfig's
-#: DEFAULT_* constants tree)
-ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {  # noqa: E305
-    ep: EndpointParameters(ep, params)
-    for ep, params in {
+#: DEFAULT_* constants tree).  Every POST endpoint accepts `reason`
+#: (enforced when request.reason.required is on; feeds the audit log).
+_RAW_PARAMETERS: dict[str, tuple] = {
         "bootstrap": (Param("start", _int), Param("end", _int),
                       Param("clearmetrics", _bool)),
         "train": (Param("start", _int), Param("end", _int)),
@@ -157,13 +156,26 @@ ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {  # noqa: E305
         "topic_configuration": (Param("topic", str),
                                 Param("replication_factor", _int), _DRYRUN,
                                 _REVIEW_ID),
-    }.items()
+}
+
+from cruise_control_tpu.config.endpoints import (  # noqa: E402
+    ALL_ENDPOINTS,
+    POST_ENDPOINTS,
+)
+
+ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {
+    ep: EndpointParameters(
+        ep,
+        params
+        if ep not in POST_ENDPOINTS or any(p.name == "reason" for p in params)
+        else (*params, _REASON),
+    )
+    for ep, params in _RAW_PARAMETERS.items()
 }
 
 
 # the canonical endpoint list and this registry must agree — a new
 # endpoint without declared parameters would silently skip validation
-from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS  # noqa: E402
 
 assert set(ENDPOINT_PARAMETERS) == set(ALL_ENDPOINTS), (
     set(ENDPOINT_PARAMETERS) ^ set(ALL_ENDPOINTS)

@@ -14,6 +14,11 @@ import threading
 import time
 
 
+class PurgatoryFullError(ValueError):
+    """Parked-request cap reached (two.step.purgatory.max.requests) — a
+    client error (429/400 class), not a server fault."""
+
+
 class ReviewStatus(enum.Enum):
     PENDING_REVIEW = "PENDING_REVIEW"
     APPROVED = "APPROVED"
@@ -51,14 +56,39 @@ class RequestInfo:
 
 
 class Purgatory:
-    def __init__(self, retention_ms: int = 7 * 86_400_000):
+    def __init__(self, retention_ms: int = 7 * 86_400_000, max_requests: int = 25):
+        """max_requests: cap on parked PENDING_REVIEW requests (reference
+        WebServerConfig two.step.purgatory.max.requests)."""
         self._requests: dict[int, RequestInfo] = {}
         self._ids = itertools.count()
         self._lock = threading.RLock()
         self.retention_ms = retention_ms
+        self.max_requests = max_requests
+
+    def _prune_expired(self):
+        now = int(time.time() * 1000)
+        for rid in [
+            r.review_id
+            for r in self._requests.values()
+            if now - r.submitted_ms > self.retention_ms
+        ]:
+            del self._requests[rid]
 
     def add(self, endpoint: str, params: dict, submitter: str = "") -> RequestInfo:
         with self._lock:
+            # expired parked requests must not count toward the cap (nobody
+            # polling review_board must not wedge the purgatory shut)
+            self._prune_expired()
+            pending = sum(
+                1 for r in self._requests.values()
+                if r.status == ReviewStatus.PENDING_REVIEW
+            )
+            if pending >= self.max_requests:
+                raise PurgatoryFullError(
+                    f"purgatory holds {pending} pending requests "
+                    f"(two.step.purgatory.max.requests={self.max_requests}); "
+                    "review or discard some first"
+                )
             info = RequestInfo(next(self._ids), endpoint, params, submitter)
             self._requests[info.review_id] = info
             return info
@@ -88,11 +118,5 @@ class Purgatory:
 
     def board(self) -> list[dict]:
         with self._lock:
-            now = int(time.time() * 1000)
-            for rid in [
-                r.review_id
-                for r in self._requests.values()
-                if now - r.submitted_ms > self.retention_ms
-            ]:
-                del self._requests[rid]
+            self._prune_expired()
             return [r.to_json() for r in self._requests.values()]

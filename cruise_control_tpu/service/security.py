@@ -157,6 +157,38 @@ def jwt_decode_rs256(token: str, public_key) -> dict | None:
     return claims
 
 
+def extract_bearer_token(headers, cookie_name: str | None = None) -> str | None:
+    """Token from `Authorization: Bearer ...`, else from the configured
+    cookie (reference WebServerConfig jwt.cookie.name; header wins)."""
+    header = headers.get("Authorization", "")
+    if header.startswith("Bearer "):
+        return header[7:]
+    if cookie_name:
+        from http.cookies import SimpleCookie
+
+        jar = SimpleCookie()
+        try:
+            jar.load(headers.get("Cookie", ""))
+        except Exception:  # noqa: BLE001 — malformed cookie header
+            return None
+        morsel = jar.get(cookie_name)
+        if morsel is not None:
+            return morsel.value
+    return None
+
+
+def audience_ok(claims: dict, expected: list[str] | None) -> bool:
+    """aud claim must intersect the configured audiences when set
+    (reference JwtAuthenticator expected-audiences check)."""
+    if not expected:
+        return True
+    aud = claims.get("aud")
+    if aud is None:
+        return False
+    auds = {aud} if isinstance(aud, str) else set(aud)
+    return bool(auds & set(expected))
+
+
 def load_public_key(pem_path: str):
     """Load an RSA public key from a PEM file holding either a bare public
     key or an X.509 certificate (the reference's JwtLoginService takes a
@@ -180,16 +212,25 @@ class JwtRs256SecurityProvider:
     with the private key — no shared secret crosses service boundaries.
     """
 
-    def __init__(self, certificate_path: str, *, default_role: str = USER):
+    def __init__(
+        self,
+        certificate_path: str,
+        *,
+        default_role: str = USER,
+        cookie_name: str | None = None,
+        expected_audiences: list[str] | None = None,
+    ):
         self.public_key = load_public_key(certificate_path)
         self.default_role = default_role
+        self.cookie_name = cookie_name
+        self.expected_audiences = expected_audiences or None
 
     def authenticate(self, headers):
-        header = headers.get("Authorization", "")
-        if not header.startswith("Bearer "):
+        token = extract_bearer_token(headers, self.cookie_name)
+        if token is None:
             return None
-        claims = jwt_decode_rs256(header[7:], self.public_key)
-        if claims is None:
+        claims = jwt_decode_rs256(token, self.public_key)
+        if claims is None or not audience_ok(claims, self.expected_audiences):
             return None
         return (claims.get("sub", "unknown"), claims.get("role", self.default_role))
 
@@ -204,9 +245,18 @@ class JwtSecurityProvider:
     `issue()` mints tokens for tests/trusted issuers.
     """
 
-    def __init__(self, secret: str, *, default_role: str = USER):
+    def __init__(
+        self,
+        secret: str,
+        *,
+        default_role: str = USER,
+        cookie_name: str | None = None,
+        expected_audiences: list[str] | None = None,
+    ):
         self.secret = secret
         self.default_role = default_role
+        self.cookie_name = cookie_name
+        self.expected_audiences = expected_audiences or None
 
     def issue(self, subject: str, role: str = ADMIN, ttl_s: int = 3600) -> str:
         return jwt_encode(
@@ -214,11 +264,11 @@ class JwtSecurityProvider:
         )
 
     def authenticate(self, headers):
-        header = headers.get("Authorization", "")
-        if not header.startswith("Bearer "):
+        token = extract_bearer_token(headers, self.cookie_name)
+        if token is None:
             return None
-        claims = jwt_decode(header[7:], self.secret)
-        if claims is None:
+        claims = jwt_decode(token, self.secret)
+        if claims is None or not audience_ok(claims, self.expected_audiences):
             return None
         return (claims.get("sub", "unknown"), claims.get("role", self.default_role))
 

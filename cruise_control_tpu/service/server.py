@@ -28,7 +28,7 @@ import numpy as np
 from cruise_control_tpu.common.resources import RESOURCE_NAMES, Resource
 from cruise_control_tpu.service.facade import CruiseControl
 from cruise_control_tpu.service.parameters import ParameterError, build_override_maps
-from cruise_control_tpu.service.purgatory import Purgatory
+from cruise_control_tpu.service.purgatory import Purgatory, PurgatoryFullError
 from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTaskManager
 
 from cruise_control_tpu.config.endpoints import GET_ENDPOINTS, POST_ENDPOINTS
@@ -43,6 +43,63 @@ class BadRequest(ValueError):
 #: authenticated principal and outcome.  Route to a file via standard logging
 #: config (`logging.getLogger("cruisecontrol.operations")`).
 OPERATION_LOGGER = logging.getLogger("cruisecontrol.operations")
+
+
+class AccessLog:
+    """NCSA-format access log with daily roll + day-based retention
+    (reference Jetty NCSARequestLog wiring, KafkaCruiseControlApp.java:133-148,
+    WebServerConfig webserver.accesslog.{enabled,path,retention.days})."""
+
+    def __init__(self, path: str, *, retention_days: int = 7):
+        import os
+
+        self.path = path
+        self.retention_days = retention_days
+        self._lock = threading.Lock()
+        self._day: str | None = None
+        self._file = None  # persistent handle; reopened only on the daily roll
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def log(self, client: str, user: str, method: str, path: str, status: int,
+            size: int):
+        import os
+        import time as _time
+
+        now = _time.time()
+        day = _time.strftime("%Y-%m-%d", _time.localtime(now))
+        stamp = _time.strftime("%d/%b/%Y:%H:%M:%S %z", _time.localtime(now))
+        line = (
+            f'{client} - {user or "-"} [{stamp}] "{method} {path} HTTP/1.1" '
+            f"{status} {size}\n"
+        )
+        with self._lock:
+            if self._day is not None and day != self._day:
+                # roll: current file -> path.YYYY-MM-DD, prune old rolls
+                if self._file is not None:
+                    self._file.close()
+                    self._file = None
+                try:
+                    os.replace(self.path, f"{self.path}.{self._day}")
+                except OSError:
+                    pass
+                self._prune(now)
+            self._day = day
+            if self._file is None:
+                self._file = open(self.path, "a")  # noqa: SIM115 — held open
+            self._file.write(line)
+            self._file.flush()
+
+    def _prune(self, now: float):
+        import glob
+        import os
+
+        cutoff = now - self.retention_days * 86_400
+        for rolled in glob.glob(f"{self.path}.*"):
+            try:
+                if os.path.getmtime(rolled) < cutoff:
+                    os.remove(rolled)
+            except OSError:
+                pass
 
 
 def _parse_bool(params: dict, name: str, default: bool) -> bool:
@@ -96,29 +153,82 @@ class CruiseControlApp:
 
         self.cc = cc
         self.config = cc.config
+
+        def _cat_map(fmt: str) -> dict:
+            cats = {
+                "KAFKA_MONITOR": "kafka.monitor",
+                "CRUISE_CONTROL_MONITOR": "cruise.control.monitor",
+                "KAFKA_ADMIN": "kafka.admin",
+                "CRUISE_CONTROL_ADMIN": "cruise.control.admin",
+            }
+            out = {}
+            for cat, key_part in cats.items():
+                v = cc.config.get(fmt.format(key_part))
+                if v is not None:
+                    out[cat] = v
+            return out
+
         self.user_tasks = UserTaskManager(
+            max_active_tasks=cc.config.get("max.active.user.tasks"),
             max_cached_completed=cc.config.get("max.cached.completed.user.tasks"),
             completed_retention_ms=cc.config.get("completed.user.task.retention.time.ms"),
+            category_max_cached=_cat_map("max.cached.completed.{}.user.tasks"),
+            category_retention_ms=_cat_map("completed.{}.user.task.retention.time.ms"),
         )
-        self.purgatory = Purgatory()
+        self.purgatory = Purgatory(
+            retention_ms=cc.config.get("two.step.purgatory.retention.time.ms"),
+            max_requests=cc.config.get("two.step.purgatory.max.requests"),
+        )
         self.two_step = cc.config.get("two.step.verification.enabled")
+        self.reason_required = cc.config.get("request.reason.required")
         self.sessions = SessionManager(
             max_expiry_ms=cc.config.get("webserver.session.maxExpiryPeriodMs")
         )
+        self.session_path = cc.config.get("webserver.session.path")
         # security provider selection (reference webserver.security.provider)
+        jwt_cert = cc.config.get("jwt.auth.certificate.location") or cc.config.get(
+            "jwt.authentication.certificate.location"
+        )
+        jwt_kwargs = dict(
+            cookie_name=cc.config.get("jwt.cookie.name"),
+            expected_audiences=cc.config.get("jwt.expected.audiences") or None,
+        )
+        self.auth_provider_url = cc.config.get("jwt.authentication.provider.url")
         if not cc.config.get("webserver.security.enable"):
             self.security = AllowAllSecurityProvider()
-        elif cc.config.get("jwt.authentication.certificate.location"):
+        elif jwt_cert:
             # certificate-based RS256 outranks shared-secret HS256
-            self.security = JwtRs256SecurityProvider(
-                cc.config.get("jwt.authentication.certificate.location")
-            )
+            self.security = JwtRs256SecurityProvider(jwt_cert, **jwt_kwargs)
         elif cc.config.get("jwt.secret.key"):
-            self.security = JwtSecurityProvider(cc.config.get("jwt.secret.key"))
-        else:
-            self.security = BasicSecurityProvider(
-                cc.config.get("basic.auth.credentials.file")
+            self.security = JwtSecurityProvider(
+                cc.config.get("jwt.secret.key"), **jwt_kwargs
             )
+        else:
+            # reference key name wins over the legacy alias
+            self.security = BasicSecurityProvider(
+                cc.config.get("webserver.auth.credentials.file")
+                or cc.config.get("basic.auth.credentials.file")
+            )
+        # CORS (reference WebServerConfig webserver.http.cors.*)
+        self.cors_headers: dict[str, str] = {}
+        if cc.config.get("webserver.http.cors.enabled"):
+            self.cors_headers = {
+                "Access-Control-Allow-Origin": cc.config.get("webserver.http.cors.origin"),
+                "Access-Control-Allow-Methods": cc.config.get(
+                    "webserver.http.cors.allowmethods"
+                ),
+                "Access-Control-Expose-Headers": cc.config.get(
+                    "webserver.http.cors.exposeheaders"
+                ),
+            }
+        self.access_log = (
+            AccessLog(
+                cc.config.get("webserver.accesslog.path"),
+                retention_days=cc.config.get("webserver.accesslog.retention.days"),
+            )
+            if cc.config.get("webserver.accesslog.enabled")
+            else None
+        )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
         self.param_parsers, self.request_handlers = build_override_maps(cc.config)
@@ -139,6 +249,18 @@ class CruiseControlApp:
             raise BadRequest(f"unknown GET endpoint {endpoint}")
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             raise BadRequest(f"unknown POST endpoint {endpoint}")
+        if (
+            method == "POST"
+            and self.reason_required
+            and not params.get("reason", [""])[0]
+            # an approved two-step resubmit carries only review_id — its
+            # reason rides the PARKED params (which passed this check when
+            # the request first parked)
+            and "review_id" not in params
+        ):
+            # reference WebServerConfig request.reason.required: mutating
+            # requests must say why (feeds the operation audit log)
+            raise BadRequest("parameter 'reason' is required on POST requests")
 
         # resume an async task by header (reference UserTaskManager flow)
         tid = headers.get(USER_TASK_ID_HEADER)
@@ -197,9 +319,12 @@ class CruiseControlApp:
                     # parameters, not just the resubmit's review_id
                     parsed = parser.parse(params)
             else:
-                info = self.purgatory.add(
-                    endpoint, {k: v[0] for k, v in params.items()}
-                )
+                try:
+                    info = self.purgatory.add(
+                        endpoint, {k: v[0] for k, v in params.items()}
+                    )
+                except PurgatoryFullError as e:
+                    raise BadRequest(str(e)) from e
                 return 200, {"reviewId": info.review_id, "status": info.status.value}
 
         custom = self.request_handlers.get(endpoint)
@@ -542,6 +667,26 @@ class CruiseControlApp:
 
             def _dispatch(self, method: str):
                 parsed = urllib.parse.urlparse(self.path)
+                self._new_session_id = None
+                if "X-Client" not in self.headers:
+                    # browser flow: sticky client identity via a session
+                    # cookie so header-less clients still get session->task
+                    # rebind (reference servlet HTTP sessions; cookie Path
+                    # from webserver.session.path)
+                    from http.cookies import SimpleCookie
+
+                    jar = SimpleCookie()
+                    try:
+                        jar.load(self.headers.get("Cookie", ""))
+                    except Exception:  # noqa: BLE001 — malformed cookie header
+                        jar = SimpleCookie()
+                    if "CCSESSION" in jar:
+                        self.headers["X-Client"] = "cookie:" + jar["CCSESSION"].value
+                    else:
+                        import uuid as _uuid
+
+                        self._new_session_id = _uuid.uuid4().hex
+                        self.headers["X-Client"] = "cookie:" + self._new_session_id
                 if not parsed.path.startswith(app.prefix + "/"):
                     self._send(404, {"errorMessage": "unknown path"})
                     return
@@ -557,6 +702,23 @@ class CruiseControlApp:
                     OPERATION_LOGGER.info(
                         "%s %s by <unauthenticated> -> 401", method, endpoint
                     )
+                    if app.auth_provider_url:
+                        # reference jwt.authentication.provider.url: browsers
+                        # are bounced to the token issuer with the original
+                        # URL so they come back authenticated
+                        loc = app.auth_provider_url.replace(
+                            "{redirect}", urllib.parse.quote(self.path, safe="")
+                        )
+                        self.send_response(302)
+                        self.send_header("Location", loc)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        if app.access_log:
+                            app.access_log.log(
+                                self.client_address[0], "", method, self.path,
+                                302, 0,
+                            )
+                        return
                     body = json.dumps({"errorMessage": "authentication required"}).encode()
                     self.send_response(401)
                     self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
@@ -564,6 +726,11 @@ class CruiseControlApp:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    if app.access_log:
+                        app.access_log.log(
+                            self.client_address[0], "", method, self.path,
+                            401, len(body),
+                        )
                     return
                 principal, role = auth
                 if not app.security.authorize(role, method, endpoint):
@@ -586,6 +753,7 @@ class CruiseControlApp:
                     "%s %s by %s(%s) -> %d",
                     method, endpoint, principal, role, status,
                 )
+                self._user = principal
                 self._send(status, payload)
 
             def _send(self, status: int, payload: dict):
@@ -593,17 +761,46 @@ class CruiseControlApp:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in app.cors_headers.items():
+                    self.send_header(k, v)
+                if getattr(self, "_new_session_id", None):
+                    self.send_header(
+                        "Set-Cookie",
+                        f"CCSESSION={self._new_session_id}; "
+                        f"Path={app.session_path}; HttpOnly",
+                    )
                 tid = payload.get("_userTaskId") if isinstance(payload, dict) else None
                 if tid:
                     self.send_header(USER_TASK_ID_HEADER, tid)
                 self.end_headers()
                 self.wfile.write(body)
+                if app.access_log:
+                    app.access_log.log(
+                        self.client_address[0],
+                        getattr(self, "_user", ""),
+                        self.command,
+                        self.path,
+                        status,
+                        len(body),
+                    )
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")
 
             def do_POST(self):  # noqa: N802
                 self._dispatch("POST")
+
+            def do_OPTIONS(self):  # noqa: N802 — CORS preflight
+                self.send_response(200 if app.cors_headers else 405)
+                for k, v in app.cors_headers.items():
+                    self.send_header(k, v)
+                if app.cors_headers:
+                    self.send_header(
+                        "Access-Control-Allow-Headers",
+                        "Authorization, Content-Type, " + USER_TASK_ID_HEADER,
+                    )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
         # TLS listener (reference KafkaCruiseControlApp.java:100-120 wraps the
         # Jetty connector in an SslContextFactory).  The handshake runs in
@@ -620,6 +817,20 @@ class CruiseControlApp:
                     "webserver.ssl.enable requires webserver.ssl.certificate.location"
                 )
             ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            # reference webserver.ssl.protocol (WebServerConfig:226):
+            # "TLS" keeps the library default; TLSv1.2/TLSv1.3 pin a floor
+            proto = (self.config.get("webserver.ssl.protocol") or "TLS").upper()
+            floors = {
+                "TLSV1.2": ssl.TLSVersion.TLSv1_2,
+                "TLSV1.3": ssl.TLSVersion.TLSv1_3,
+            }
+            if proto in floors:
+                ssl_ctx.minimum_version = floors[proto]
+            elif proto != "TLS":
+                raise ValueError(
+                    f"unsupported webserver.ssl.protocol {proto!r}; "
+                    "use TLS, TLSv1.2 or TLSv1.3"
+                )
             ssl_ctx.load_cert_chain(
                 certfile=cert,
                 keyfile=self.config.get("webserver.ssl.key.location") or None,

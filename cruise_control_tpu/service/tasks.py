@@ -55,7 +55,14 @@ class UserTaskManager:
         max_cached_completed: int = 100,
         completed_retention_ms: int = 86_400_000,
         num_threads: int = 3,
+        category_max_cached: dict[str, int] | None = None,
+        category_retention_ms: dict[str, int] | None = None,
     ):
+        """category_*: per-endpoint-category overrides keyed by the
+        CruiseControlEndPoint type (KAFKA_MONITOR / CRUISE_CONTROL_MONITOR /
+        KAFKA_ADMIN / CRUISE_CONTROL_ADMIN) — reference
+        config/constants/UserTaskManagerConfig.java; unset categories fall
+        back to the general cap/retention."""
         # reference AsyncKafkaCruiseControl uses 3 session threads
         self._pool = ThreadPoolExecutor(max_workers=num_threads, thread_name_prefix="user-task")
         self._tasks: dict[str, UserTask] = {}
@@ -63,6 +70,8 @@ class UserTaskManager:
         self.max_active_tasks = max_active_tasks
         self.max_cached_completed = max_cached_completed
         self.completed_retention_ms = completed_retention_ms
+        self.category_max_cached = category_max_cached or {}
+        self.category_retention_ms = category_retention_ms or {}
 
     def submit(self, endpoint: str, fn, *, request_url: str = "", task_id: str | None = None) -> UserTask:
         """Run fn(progress) on the session pool; returns the UserTask."""
@@ -94,15 +103,38 @@ class UserTaskManager:
         with self._lock:
             return list(self._tasks.values())
 
+    def _category(self, task: UserTask) -> str | None:
+        from cruise_control_tpu.config.endpoints import ENDPOINT_TYPES
+
+        return ENDPOINT_TYPES.get(task.endpoint)
+
     def _maybe_evict(self):
         now = int(time.time() * 1000)
         completed = [t for t in self._tasks.values() if t.status != "Active"]
         completed.sort(key=lambda t: t.created_ms)
-        # retention by age then by count (reference scanner, 5s cadence)
+        # retention by age then by count, with per-category overrides
+        # (reference UserTaskManager scanner + UserTaskManagerConfig)
         for t in completed:
-            expired = now - t.created_ms > self.completed_retention_ms
-            overflow = len([x for x in self._tasks.values() if x.status != "Active"]) > self.max_cached_completed
-            if expired or overflow:
+            cat = self._category(t)
+            retention = self.category_retention_ms.get(cat, self.completed_retention_ms)
+            if now - t.created_ms > retention:
+                del self._tasks[t.task_id]
+        for t in [t for t in completed if t.task_id in self._tasks]:
+            cat = self._category(t)
+            cap = self.category_max_cached.get(cat)
+            if cap is not None:
+                in_cat = [
+                    x for x in self._tasks.values()
+                    if x.status != "Active" and self._category(x) == cat
+                ]
+                if len(in_cat) > cap:
+                    del self._tasks[t.task_id]
+                    continue
+            overflow = (
+                len([x for x in self._tasks.values() if x.status != "Active"])
+                > self.max_cached_completed
+            )
+            if overflow:
                 del self._tasks[t.task_id]
 
     def shutdown(self):

@@ -205,6 +205,183 @@ def test_config_excluded_topics_merged(wired_service):
     assert bool(opts2.excluded_topics[t0]) and bool(opts2.excluded_topics[t1])
 
 
+# ------------------------------------------------------------- webserver
+
+
+def test_jwt_cookie_and_audience():
+    from cruise_control_tpu.service.security import JwtSecurityProvider
+
+    p = JwtSecurityProvider("s3cret", cookie_name="CCJWT",
+                            expected_audiences=["cruise-control"])
+    from cruise_control_tpu.service.security import jwt_encode
+
+    good = jwt_encode({"sub": "u", "role": "ADMIN", "aud": "cruise-control"},
+                      "s3cret")
+    wrong_aud = jwt_encode({"sub": "u", "role": "ADMIN", "aud": "other"},
+                           "s3cret")
+    no_aud = jwt_encode({"sub": "u", "role": "ADMIN"}, "s3cret")
+    assert p.authenticate({"Authorization": f"Bearer {good}"}) == ("u", "ADMIN")
+    assert p.authenticate({"Cookie": f"CCJWT={good}"}) == ("u", "ADMIN")
+    assert p.authenticate({"Authorization": f"Bearer {wrong_aud}"}) is None
+    assert p.authenticate({"Authorization": f"Bearer {no_aud}"}) is None
+    # header outranks cookie
+    assert p.authenticate(
+        {"Authorization": f"Bearer {wrong_aud}", "Cookie": f"CCJWT={good}"}
+    ) is None
+
+
+def test_purgatory_max_requests():
+    from cruise_control_tpu.service.purgatory import Purgatory
+
+    p = Purgatory(max_requests=2)
+    p.add("rebalance", {})
+    p.add("rebalance", {})
+    with pytest.raises(ValueError):
+        p.add("rebalance", {})
+    # reviewing one frees a slot
+    info = p.board()[0]
+    p.review(info["Id"] if isinstance(info, dict) else info.review_id, approve=False)
+    p.add("rebalance", {})
+
+
+def test_access_log_ncsa_and_retention(tmp_path):
+    import os
+
+    from cruise_control_tpu.service.server import AccessLog
+
+    path = tmp_path / "logs" / "access.log"
+    log = AccessLog(str(path), retention_days=1)
+    log.log("127.0.0.1", "admin", "GET", "/kafkacruisecontrol/state", 200, 42)
+    line = path.read_text().strip()
+    assert line.startswith("127.0.0.1 - admin [")
+    assert '"GET /kafkacruisecontrol/state HTTP/1.1" 200 42' in line
+    # a rolled file older than retention is pruned on the next roll
+    old = tmp_path / "logs" / "access.log.2020-01-01"
+    old.write_text("old\n")
+    os.utime(old, (0, 0))
+    log._day = "2020-01-02"  # force a roll on next write
+    log.log("127.0.0.1", "-", "GET", "/x", 200, 1)
+    assert not old.exists()
+
+
+def test_user_task_category_retention():
+    import time as _time
+
+    from cruise_control_tpu.service.tasks import UserTaskManager
+
+    m = UserTaskManager(
+        completed_retention_ms=3_600_000,
+        category_retention_ms={"KAFKA_MONITOR": 0},  # evict instantly
+    )
+    t_monitor = m.submit("proposals", lambda p: {})
+    t_admin = m.submit("rebalance", lambda p: {})
+    t_monitor.future.result()
+    t_admin.future.result()
+    _time.sleep(0.01)
+    m._maybe_evict()
+    assert m.get(t_monitor.task_id) is None  # KAFKA_MONITOR retention 0
+    assert m.get(t_admin.task_id) is not None  # general retention applies
+
+
+def test_endpoint_types_cover_all_endpoints():
+    from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS, ENDPOINT_TYPES
+
+    assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
+    assert set(ENDPOINT_TYPES.values()) == {
+        "KAFKA_MONITOR", "CRUISE_CONTROL_MONITOR",
+        "KAFKA_ADMIN", "CRUISE_CONTROL_ADMIN",
+    }
+
+
+@pytest.fixture(scope="module")
+def http_service(tmp_path_factory):
+    """Live HTTP service exercising the CORS/access-log/reason-required keys."""
+    import urllib.request
+
+    from cruise_control_tpu.config import CruiseControlConfig
+
+    logdir = tmp_path_factory.mktemp("accesslog")
+    config = CruiseControlConfig(
+        {
+            "partition.metrics.window.ms": 1000,
+            "min.samples.per.partition.metrics.window": 1,
+            "execution.progress.check.interval.ms": 100,
+            "webserver.http.port": 0,
+            "tpu.num.candidates": 128,
+            "tpu.leadership.candidates": 32,
+            "tpu.steps.per.round": 8,
+            "tpu.num.rounds": 2,
+            "webserver.http.cors.enabled": "true",
+            "webserver.http.cors.origin": "https://ops.example.com",
+            "webserver.accesslog.enabled": "true",
+            "webserver.accesslog.path": str(logdir / "access.log"),
+            "request.reason.required": "true",
+        }
+    )
+    app, fetcher, admin, sampler = build_simulated_service(config, seed=13)
+    app.start()
+    yield app, logdir
+    app.stop()
+
+
+def test_cors_headers_and_preflight(http_service):
+    import http.client
+    import json as _json
+    import urllib.request
+
+    app, _ = http_service
+    url = f"http://{app.host}:{app.port}{app.prefix}/state"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] == "https://ops.example.com"
+        assert "User-Task-ID" in resp.headers["Access-Control-Expose-Headers"]
+        _json.loads(resp.read())
+    conn = http.client.HTTPConnection(app.host, app.port, timeout=30)
+    conn.request("OPTIONS", f"{app.prefix}/state")
+    pre = conn.getresponse()
+    assert pre.status == 200
+    assert pre.headers["Access-Control-Allow-Methods"] == "OPTIONS, GET, POST"
+    assert "Authorization" in pre.headers["Access-Control-Allow-Headers"]
+    conn.close()
+
+
+def test_session_cookie_issued(http_service):
+    import urllib.request
+
+    app, _ = http_service
+    url = f"http://{app.host}:{app.port}{app.prefix}/state"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        cookie = resp.headers.get("Set-Cookie", "")
+    assert cookie.startswith("CCSESSION=")
+    assert "Path=/" in cookie and "HttpOnly" in cookie
+
+
+def test_reason_required_on_posts(http_service):
+    import urllib.error
+    import urllib.request
+
+    app, _ = http_service
+    base = f"http://{app.host}:{app.port}{app.prefix}"
+    req = urllib.request.Request(f"{base}/pause_sampling", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+    req = urllib.request.Request(
+        f"{base}/pause_sampling?reason=maintenance", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+    req = urllib.request.Request(
+        f"{base}/resume_sampling?reason=done", method="POST"
+    )
+    urllib.request.urlopen(req, timeout=30).read()
+
+
+def test_access_log_written(http_service):
+    app, logdir = http_service
+    content = (logdir / "access.log").read_text()
+    assert '"GET ' in content and "HTTP/1.1" in content
+
+
 def test_cache_not_served_when_estimation_forbidden(wired_service):
     """A request with allow_capacity_estimation=false must not be served
     from a cache filled with estimation allowed (reference sanity-checks
