@@ -168,7 +168,45 @@ def _monitor_defs() -> ConfigDef:
              "SampleStore plugin", group=g)
     d.define("capacity.config.file", T.STRING, None, I.MEDIUM,
              "broker capacity JSON (reference config/capacity.json schema)", group=g)
-    d.define("max.allowed.extrapolations.per.partition", T.INT, 5, I.LOW, "", group=g)
+    d.define("max.allowed.extrapolations.per.partition", T.INT, 5, I.LOW,
+             "partitions extrapolating more windows than this are invalid "
+             "(reference MonitorConfig:135)", in_range(lo=0), group=g)
+    d.define("max.allowed.extrapolations.per.broker", T.INT, 5, I.LOW,
+             "broker-window analog (reference MonitorConfig:179)",
+             in_range(lo=0), group=g)
+    d.define("skip.loading.samples", T.BOOLEAN, False, I.LOW,
+             "do not replay the sample store on startup "
+             "(reference MonitorConfig skip.loading.samples)", group=g)
+    d.define("sampling.allow.cpu.capacity.estimation", T.BOOLEAN, True, I.LOW,
+             "sampling may attribute CPU for brokers that reported no CPU "
+             "metric (reference MonitorConfig:293-295)", group=g)
+    d.define("use.linear.regression.model", T.BOOLEAN, False, I.LOW,
+             "train the CPU regression continuously from broker samples and "
+             "use it once bucket coverage suffices (reference "
+             "MonitorConfig:302)", group=g)
+    d.define("linear.regression.model.cpu.util.bucket.size", T.INT, 5, I.LOW,
+             "CPU-util bucket width in percent points "
+             "(reference MonitorConfig:268)", in_range(lo=1, hi=100), group=g)
+    d.define("linear.regression.model.required.samples.per.bucket", T.INT, 100,
+             I.LOW, "samples per bucket before it counts as covered "
+             "(reference MonitorConfig:277)", in_range(lo=1), group=g)
+    d.define("linear.regression.model.min.num.cpu.util.buckets", T.INT, 5,
+             I.LOW, "distinct covered buckets required to train "
+             "(reference MonitorConfig:286)", in_range(lo=1), group=g)
+    d.define("broker.capacity.config.resolver.class", T.CLASS, None, I.MEDIUM,
+             "custom BrokerCapacityConfigResolver; called with the "
+             "CruiseControlConfig (reference "
+             "config/BrokerCapacityConfigResolver.java); unset uses "
+             "capacity.config.file / fixed defaults", group=g)
+    d.define("metric.sampler.partition.assignor.class", T.CLASS, None, I.LOW,
+             "custom MetricSamplerPartitionAssignor; called with no args "
+             "(reference monitor/sampling/MetricSamplerPartitionAssignor.java)",
+             group=g)
+    d.define("topic.config.provider.class", T.CLASS, None, I.LOW,
+             "custom TopicConfigProvider; called with (config, admin) "
+             "(reference config/TopicConfigProvider.java) — "
+             "KafkaTopicConfigProvider pulls the wire client off the admin",
+             group=g)
     return d
 
 
@@ -189,7 +227,21 @@ def _executor_defs() -> ConfigDef:
     d.define("task.execution.alerting.threshold.ms", T.LONG, 90_000, I.LOW,
              "slow-task alert threshold", in_range(lo=1), group=g)
     d.define("default.replica.movement.strategies", T.LIST,
-             "BaseReplicaMovementStrategy", I.LOW, "ordered strategy chain", group=g)
+             "BaseReplicaMovementStrategy", I.LOW,
+             "ordered strategy chain applied to every execution unless the "
+             "request overrides it", group=g)
+    d.define("replica.movement.strategies", T.LIST,
+             "PostponeUrpReplicaMovementStrategy,"
+             "PrioritizeLargeReplicaMovementStrategy,"
+             "PrioritizeSmallReplicaMovementStrategy,"
+             "BaseReplicaMovementStrategy", I.LOW,
+             "the pool of strategies requests may reference (reference "
+             "ExecutorConfig replica.movement.strategies); dotted paths "
+             "register custom classes", group=g)
+    d.define("executor.notifier.class", T.CLASS, None, I.LOW,
+             "object notified after every execution finishes; called with "
+             "no args, must expose on_execution_finished(result, uuid) "
+             "(reference ExecutorConfig executor.notifier.class)", group=g)
     d.define("max.num.cluster.movements", T.INT, 1250, I.MEDIUM,
              "global cap on concurrently ongoing movements (replica + "
              "leadership) cluster-wide, regardless of the per-broker caps "
@@ -272,6 +324,16 @@ def _anomaly_defs() -> ConfigDef:
              "file persisting broker-failure times across restarts "
              "(reference persists to a ZK node)", group=g)
     d.define("topic.anomaly.target.replication.factor", T.INT, 2, I.LOW, "", group=g)
+    d.define("metric.anomaly.finder.class", T.CLASS, None, I.LOW,
+             "custom metric-anomaly finder (reference AnomalyDetectorConfig "
+             "metric.anomaly.finder.class); called with the "
+             "CruiseControlConfig, must expose detect(evidence) -> "
+             "Anomaly | None; unset uses the built-in SlowBrokerFinder",
+             group=g)
+    d.define("topic.anomaly.finder.class", T.CLASS, None, I.LOW,
+             "custom topic-anomaly finder; called with (topology_provider, "
+             "config), must expose detect() -> Anomaly | None; unset uses "
+             "the built-in TopicReplicationFactorAnomalyFinder", group=g)
     # Slack alerting (reference detector/notifier/SlackSelfHealingNotifier.java)
     d.define("slack.self.healing.notifier.webhook", T.STRING, None, I.LOW,
              "Slack incoming-webhook URL; enables the Slack notifier", group=g)

@@ -267,16 +267,36 @@ class TopicReplicationFactorAnomalyFinder:
     """Reference detector/TopicReplicationFactorAnomalyFinder.java — topics
     whose partitions run below the target replication factor."""
 
-    def __init__(self, topology_provider: Callable[[], ClusterTopology], target_rf: int = 2):
+    def __init__(
+        self,
+        topology_provider: Callable[[], ClusterTopology],
+        target_rf: int = 2,
+        topic_config_provider=None,
+    ):
+        """topic_config_provider (reference topic.config.provider.class):
+        when present, a topic's effective floor is
+        max(target_rf, min.insync.replicas + 1) — RF == minISR cannot
+        survive a broker loss without dropping under min-ISR."""
         self.topology_provider = topology_provider
         self.target_rf = target_rf
+        self.topic_config_provider = topic_config_provider
 
     def detect(self) -> TopicReplicationFactorAnomaly | None:
+        from cruise_control_tpu.monitor.topic_config import min_insync_replicas_map
+
         topo = self.topology_provider()
+        topics = sorted({p.topic for p in topo.partitions})
+        floors = {t: self.target_rf for t in topics}
+        if self.topic_config_provider is not None:
+            # one batch DescribeConfigs for ALL topics per detection tick
+            for t, min_isr in min_insync_replicas_map(
+                self.topic_config_provider, topics
+            ).items():
+                floors[t] = max(floors[t], min_isr + 1)
         bad: dict[str, int] = {}
         for p in topo.partitions:
             rf = len(p.replicas)
-            if rf < self.target_rf:
+            if rf < floors[p.topic]:
                 bad[p.topic] = min(bad.get(p.topic, rf), rf)
         if not bad:
             return None

@@ -109,12 +109,17 @@ class Executor:
         sensors=None,
         removal_history_retention_ms: int = 1_209_600_000,
         demotion_history_retention_ms: int = 1_209_600_000,
+        notifier=None,
     ):
+        """notifier (reference ExecutorConfig executor.notifier.class): an
+        object with on_execution_finished(result, uuid), called after every
+        execution — success, stop or abort."""
         from cruise_control_tpu.common.sensors import REGISTRY
 
         self.sensors = sensors if sensors is not None else REGISTRY
         self.admin = admin
         self.strategy = strategy
+        self.notifier = notifier
         self.topic_names = topic_names or {}
         #: ClusterCatalog resolving global partition ids -> (topic, partition)
         self.catalog = catalog
@@ -195,8 +200,12 @@ class Executor:
         removed_brokers: set[int] | None = None,
         demoted_brokers: set[int] | None = None,
         strategy_context: dict | None = None,
+        strategy: ReplicaMovementStrategy | None = None,
     ) -> ExecutionResult:
-        """Reference Executor.executeProposals():395 (synchronous variant)."""
+        """Reference Executor.executeProposals():395 (synchronous variant).
+
+        strategy: per-execution ordering override (reference per-request
+        replica_movement_strategies); falls back to the configured default."""
         options = options or ExecutionOptions()
         with self._lock:
             if self.has_ongoing_execution:
@@ -215,7 +224,7 @@ class Executor:
                 self._demoted_history[b] = now
             self.tracker = ExecutionTaskTracker()
             self._reexecutions = {}
-            self._planner = ExecutionTaskPlanner(self.strategy)
+            self._planner = ExecutionTaskPlanner(strategy or self.strategy)
             tasks = self._planner.add_execution_proposals(proposals, strategy_context)
             for t in tasks:
                 self.tracker.add(t)
@@ -231,6 +240,11 @@ class Executor:
             with self._lock:
                 self.state = ExecutorState.NO_TASK_IN_PROGRESS
                 self._planner = None
+        if self.notifier is not None:
+            try:
+                self.notifier.on_execution_finished(result, uuid)
+            except Exception:  # noqa: BLE001 — a broken notifier must not fail the execution
+                pass
         return result
 
     # ------------------------------------------------------------------

@@ -79,3 +79,40 @@ STRATEGIES_BY_NAME = {
         PostponeUrpReplicaMovementStrategy(),
     )
 }
+
+
+def resolve_strategy_chain(
+    names: list[str], allowed: set[str] | None = None
+) -> ReplicaMovementStrategy:
+    """Resolve an ordered strategy-name list into one chained strategy
+    (reference ExecutorConfig default.replica.movement.strategies +
+    per-request replica_movement_strategies).
+
+    Names resolve from the builtin registry or as dotted paths to custom
+    classes; `allowed` (reference replica.movement.strategies — the pool of
+    supported strategies) restricts what callers may reference."""
+    if not names:
+        raise ValueError("empty strategy list")
+    resolved = []
+    for n in names:
+        if allowed is not None and n not in allowed:
+            raise ValueError(
+                f"strategy {n!r} is not in replica.movement.strategies {sorted(allowed)}"
+            )
+        if n in STRATEGIES_BY_NAME:
+            resolved.append(STRATEGIES_BY_NAME[n])
+            continue
+        if "." in n:
+            import importlib
+
+            mod, _, cls = n.rpartition(".")
+            resolved.append(getattr(importlib.import_module(mod), cls)())
+            continue
+        raise ValueError(
+            f"unknown replica movement strategy {n!r}; "
+            f"builtins: {sorted(STRATEGIES_BY_NAME)}"
+        )
+    chain = resolved[0]
+    for s in resolved[1:]:
+        chain = chain.chain(s)
+    return chain

@@ -54,9 +54,22 @@ class LinearRegressionModelParameters:
 
     Accumulates training samples; `train` solves least squares; once
     trained, `estimate` replaces the static-coefficient path.
+
+    Bucketed readiness (reference MonitorConfig
+    linear.regression.model.{cpu.util.bucket.size,
+    required.samples.per.bucket, min.num.cpu.util.buckets}): samples are
+    binned by CPU utilization percent, and training requires enough
+    DISTINCT load levels — a model fit only on idle-broker samples would
+    extrapolate garbage at peak.
     """
 
     min_samples_to_train: int = 100
+    #: CPU-util bucket width in percent points
+    cpu_util_bucket_size: int = 5
+    #: samples a bucket needs before it counts as covered
+    required_samples_per_bucket: int = 100
+    #: covered buckets required before training may run
+    min_num_cpu_util_buckets: int = 5
 
     def __post_init__(self):
         self._x: list[np.ndarray] = []
@@ -76,8 +89,32 @@ class LinearRegressionModelParameters:
     def trained(self) -> bool:
         return self.coefficients is not None
 
-    def train(self) -> bool:
+    def bucket_coverage(self) -> dict[int, int]:
+        """{bucket index: sample count}, bucketing CPU util (0..1) by
+        cpu_util_bucket_size percent points."""
+        counts: dict[int, int] = {}
+        width = max(1, self.cpu_util_bucket_size)
+        for y in self._y:
+            b = int(min(max(y, 0.0), 1.0) * 100) // width
+            counts[b] = counts.get(b, 0) + 1
+        return counts
+
+    def ready_to_train(self) -> bool:
         if len(self._y) < self.min_samples_to_train:
+            return False
+        covered = sum(
+            1 for n in self.bucket_coverage().values()
+            if n >= self.required_samples_per_bucket
+        )
+        return covered >= self.min_num_cpu_util_buckets
+
+    def train(self, *, force: bool = False) -> bool:
+        """force (the explicit /train path) skips the bucket-COVERAGE gate —
+        an operator may fit on whatever load levels exist — but never the
+        minimum-sample floor: a fit on a handful of points is noise."""
+        if len(self._y) < self.min_samples_to_train:
+            return False
+        if not force and not self.ready_to_train():
             return False
         x = np.stack(self._x)
         y = np.asarray(self._y)

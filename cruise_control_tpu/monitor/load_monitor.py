@@ -85,7 +85,11 @@ class LoadMonitor:
         replica_capacity: int | None = None,
         regression=None,
         topic_filter=None,
+        max_allowed_extrapolations: int = 5,
     ):
+        #: reference MonitorConfig max.allowed.extrapolations.per.partition —
+        #: partitions whose windows extrapolate more than this are invalid
+        self.max_allowed_extrapolations = max_allowed_extrapolations
         self.metadata = metadata
         self.capacity_resolver = capacity_resolver
         self.partition_aggregator = partition_aggregator
@@ -159,7 +163,8 @@ class LoadMonitor:
         try:
             agg = self.partition_aggregator.aggregate(
                 AggregationOptions(
-                    min_valid_entity_ratio=requirements.min_monitored_partitions_percentage
+                    min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+                    max_allowed_extrapolations_per_entity=self.max_allowed_extrapolations,
                 )
             )
         except ValueError:
@@ -208,7 +213,8 @@ class LoadMonitor:
             )
         agg = self.partition_aggregator.aggregate(
             AggregationOptions(
-                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage
+                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+                max_allowed_extrapolations_per_entity=self.max_allowed_extrapolations,
             )
         )
         if agg.completeness.valid_windows.size < requirements.min_required_num_windows:

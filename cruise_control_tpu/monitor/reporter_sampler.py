@@ -66,12 +66,19 @@ class CruiseControlMetricsReporterSampler:
         *,
         metric_def: MetricDef = KAFKA_METRIC_DEF,
         topic_filter=None,
+        allow_cpu_estimation: bool = True,
     ):
+        """allow_cpu_estimation (reference MonitorConfig
+        sampling.allow.cpu.capacity.estimation): when False, partitions on
+        a broker that reported no CPU metric are NOT sampled at all — a
+        byte-share CPU attribution against an unknown broker CPU would be
+        an estimate the operator forbade."""
         import re
 
         self.transport = transport
         self.topology_provider = topology_provider
         self.metric_def = metric_def
+        self.allow_cpu_estimation = allow_cpu_estimation
         if topic_filter is None:
             rx = re.compile(self.DEFAULT_EXCLUDED)
             topic_filter = lambda name: not rx.match(str(name))  # noqa: E731
@@ -186,6 +193,8 @@ class CruiseControlMetricsReporterSampler:
             shares = sizes / total if total > 0 else np.full(len(parts), 1.0 / max(len(parts), 1))
             # CPU attribution: broker CPU split across leader partitions by
             # their byte share (reference CruiseControlMetricsProcessor)
+            if not self.allow_cpu_estimation and broker not in broker_cpu:
+                continue
             b_cpu = broker_cpu.get(broker, 0.0)
             b_total_in = sum(
                 topic_bytes_in.get((broker, t2), 0.0) for (b2, t2) in topic_bytes_in if b2 == broker

@@ -32,14 +32,24 @@ class LoadMonitorTaskRunner:
         *,
         window_ms: int,
         regression: LinearRegressionModelParameters | None = None,
+        auto_train: bool = False,
     ):
+        """auto_train (reference MonitorConfig use.linear.regression.model):
+        harvest broker samples continuously and train the CPU regression
+        as soon as its bucket coverage suffices — no explicit /train
+        needed."""
         self.monitor = monitor
         self.fetcher = fetcher
         self.partitions_fn = partitions_fn
         self.window_ms = window_ms
         self.regression = regression or LinearRegressionModelParameters()
+        self.auto_train = auto_train
         self._lock = threading.Lock()
         self._bootstrap_progress = 0.0
+        self._harvested_until = 0
+        self._harvest_lock = threading.Lock()
+        self._auto_stop = threading.Event()
+        self._auto_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------------
 
@@ -63,8 +73,28 @@ class LoadMonitorTaskRunner:
     def start(self, *, interval_s: float | None = None):
         self.monitor.start()
         self.fetcher.start(self.partitions_fn, interval_s=interval_s)
+        if self.auto_train and self._auto_thread is None:
+            # a stop()/start() cycle must revive auto-training
+            self._auto_stop.clear()
+            tick = interval_s or self.window_ms / 1000.0
+
+            def loop():
+                while not self._auto_stop.wait(tick):
+                    try:
+                        self.maybe_auto_train()
+                    except Exception:  # noqa: BLE001 — keep the loop alive
+                        pass
+
+            self._auto_thread = threading.Thread(
+                target=loop, daemon=True, name="cpu-model-auto-train"
+            )
+            self._auto_thread.start()
 
     def stop(self):
+        self._auto_stop.set()
+        if self._auto_thread is not None:
+            self._auto_thread.join(timeout=5)
+            self._auto_thread = None
         self.fetcher.stop()
 
     def load_samples(self) -> int:
@@ -120,44 +150,78 @@ class LoadMonitorTaskRunner:
         finally:
             self._exit()
 
+    def _harvest(self, start_ms: int, end_ms: int) -> int:
+        """Feed broker windows inside [start_ms, end_ms) into the
+        regression; returns the number of samples added.
+
+        Windows at or below the watermark are ALWAYS skipped and the
+        watermark always advances — the explicit /train path and the
+        auto-train thread share one regression, and either re-harvesting
+        the other's windows would double-count samples and skew the fit.
+        Serialized by a lock for the same reason."""
+        with self._harvest_lock:
+            start_ms = max(start_ms, self._harvested_until)
+            agg = self.fetcher.broker_aggregator
+            if agg is None or not agg.num_entities():
+                return 0
+            try:
+                res = agg.aggregate()
+            except ValueError:  # no completed broker windows yet
+                return 0
+            m = KAFKA_METRIC_DEF
+            added = 0
+            for e_idx in range(res.values.shape[0]):
+                for w in range(res.values.shape[1]):
+                    if not res.window_valid[e_idx, w]:
+                        continue
+                    # NB: broker windows have their OWN span (reference
+                    # broker.metrics.window.ms), not the partition span
+                    # this runner was built with
+                    w_start = int(res.window_indices[w]) * agg.window_ms
+                    if not (start_ms <= w_start < end_ms):
+                        continue
+                    v = res.values[e_idx, w]
+                    self.regression.add_sample(
+                        float(v[m.metric_id("LEADER_BYTES_IN")]),
+                        float(v[m.metric_id("LEADER_BYTES_OUT")]),
+                        float(v[m.metric_id("REPLICATION_BYTES_IN_RATE")]),
+                        float(v[m.metric_id("CPU_USAGE")]),
+                    )
+                    added += 1
+                    self._harvested_until = max(
+                        self._harvested_until, w_start + agg.window_ms
+                    )
+            return added
+
     def train(self, start_ms: int, end_ms: int) -> dict:
         """Reference TrainingTask: harvest (bytes-in, bytes-out, follower
         bytes-in, cpu) tuples from broker samples into the regression —
         restricted to windows inside [start_ms, end_ms) as requested
-        (reference LoadMonitor.train:354 passes the range through)."""
+        (reference LoadMonitor.train:354 passes the range through).
+
+        An explicit /train is an operator decision: it fits the model even
+        when bucket coverage is below the auto-train gate (force=True)."""
         self._enter(MonitorState.TRAINING)
         try:
-            agg = self.fetcher.broker_aggregator
-            if agg is not None and agg.num_entities():
-                try:
-                    res = agg.aggregate()
-                except ValueError:  # no completed broker windows yet
-                    res = None
-            else:
-                res = None
-            if res is not None:
-                m = KAFKA_METRIC_DEF
-                for e_idx in range(res.values.shape[0]):
-                    for w in range(res.values.shape[1]):
-                        if not res.window_valid[e_idx, w]:
-                            continue
-                        # NB: broker windows have their OWN span (reference
-                        # broker.metrics.window.ms), not the partition span
-                        # this runner was built with
-                        w_start = int(res.window_indices[w]) * agg.window_ms
-                        if not (start_ms <= w_start < end_ms):
-                            continue
-                        v = res.values[e_idx, w]
-                        self.regression.add_sample(
-                            float(v[m.metric_id("LEADER_BYTES_IN")]),
-                            float(v[m.metric_id("LEADER_BYTES_OUT")]),
-                            float(v[m.metric_id("REPLICATION_BYTES_IN_RATE")]),
-                            float(v[m.metric_id("CPU_USAGE")]),
-                        )
-            trained = self.regression.train()
+            self._harvest(start_ms, end_ms)
+            trained = self.regression.train(force=True)
             return {"trained": trained, **self.regression.state()}
         finally:
             self._exit()
+
+    def maybe_auto_train(self) -> bool:
+        """Continuous training loop body (use.linear.regression.model):
+        harvest only windows NEWER than the watermark (repeat harvesting
+        would double-count and skew the fit), then train once the
+        bucket-coverage gate passes."""
+        if self.regression.trained:
+            return True
+        import time as _time
+
+        self._harvest(self._harvested_until, int(_time.time() * 1000))
+        if self.regression.ready_to_train():
+            return self.regression.train()
+        return False
 
     def state(self) -> dict:
         return {

@@ -98,8 +98,19 @@ class CruiseControl:
             parallel_mode=config.parallel_mode(),
             balancedness_weights=self.balancedness_weights,
         )
+        from cruise_control_tpu.executor.strategy import resolve_strategy_chain
+
+        #: the configured strategy pool gates what requests may reference
+        #: (reference ExecutorConfig replica.movement.strategies); dotted
+        #: paths in the pool register custom classes on first resolve
+        self.allowed_strategies = set(config.get("replica.movement.strategies"))
+        notifier_cls = config.get("executor.notifier.class")
         self.executor = Executor(
             admin,
+            strategy=resolve_strategy_chain(
+                config.get("default.replica.movement.strategies"),
+                allowed=self.allowed_strategies,
+            ),
             sensors=self.sensors,
             removal_history_retention_ms=config.get(
                 "removal.history.retention.time.ms"
@@ -107,6 +118,7 @@ class CruiseControl:
             demotion_history_retention_ms=config.get(
                 "demotion.history.retention.time.ms"
             ),
+            notifier=notifier_cls() if notifier_cls is not None else None,
         )
         self._cache: _CachedResult | None = None
         self._cache_lock = threading.Lock()
@@ -176,9 +188,24 @@ class CruiseControl:
             persist_path=self.config.get("broker.failure.persisted.path"),
         )
         dfd = DiskFailureDetector(self.admin.topology)
-        rfd = TopicReplicationFactorAnomalyFinder(
-            self.admin.topology,
-            target_rf=self.config.get("topic.anomaly.target.replication.factor"),
+        # pluggable topic-config provider feeds min.insync.replicas into RF
+        # anomaly detection (reference topic.config.provider.class)
+        tcp_cls = self.config.get("topic.config.provider.class")
+        topic_config_provider = (
+            tcp_cls(self.config, self.admin) if tcp_cls is not None else None
+        )
+        rf_finder_cls = self.config.get("topic.anomaly.finder.class")
+        if rf_finder_cls is not None:
+            rfd = rf_finder_cls(self.admin.topology, self.config)
+        else:
+            rfd = TopicReplicationFactorAnomalyFinder(
+                self.admin.topology,
+                target_rf=self.config.get("topic.anomaly.target.replication.factor"),
+                topic_config_provider=topic_config_provider,
+            )
+        slow_finder_cls = self.config.get("metric.anomaly.finder.class")
+        custom_slow = (
+            slow_finder_cls(self.config) if slow_finder_cls is not None else None
         )
         slow = SlowBrokerFinder(
             history_percentile=self.config.get("slow.broker.history.percentile"),
@@ -196,7 +223,15 @@ class CruiseControl:
             if agg is None or not agg.num_entities():
                 return None
             try:
-                res = agg.aggregate()
+                from cruise_control_tpu.monitor.aggregator import AggregationOptions
+
+                res = agg.aggregate(
+                    AggregationOptions(
+                        max_allowed_extrapolations_per_entity=self.config.get(
+                            "max.allowed.extrapolations.per.broker"
+                        )
+                    )
+                )
             except ValueError:
                 return None
             m = agg.metric_def
@@ -238,7 +273,8 @@ class CruiseControl:
                         "log_flush_time_ms_mean"
                     ) / max(rate, 1e-9)
                 evidence[int(getattr(entity, "broker_id", entity))] = fams
-            anomaly = slow.detect(evidence)
+            # a configured metric.anomaly.finder.class replaces the builtin
+            anomaly = (custom_slow or slow).detect(evidence)
             # removal (decommission + rebuild) is destructive; the dedicated
             # switch gates it regardless of strike count (reference
             # AnomalyDetectorConfig slow.broker removal switches)
@@ -465,9 +501,17 @@ class CruiseControl:
             )
             / 1000.0,
         )
+        strategy = None
+        if ov.get("replica_movement_strategies"):
+            from cruise_control_tpu.executor.strategy import resolve_strategy_chain
+
+            strategy = resolve_strategy_chain(
+                ov["replica_movement_strategies"], allowed=self.allowed_strategies
+            )
         self.executor.catalog = self.monitor.last_catalog
         out = self.executor.execute_proposals(
-            proposals, exec_options, removed_brokers=removed, demoted_brokers=demoted
+            proposals, exec_options, removed_brokers=removed, demoted_brokers=demoted,
+            strategy=strategy,
         )
         self.invalidate_proposal_cache()
         return {

@@ -40,12 +40,17 @@ def build_service(
 
     enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
     if capacity_resolver is None:
+        resolver_cls = config.get("broker.capacity.config.resolver.class")
         path = config.get("capacity.config.file")
-        capacity_resolver = (
-            FileCapacityResolver(path)
-            if path
-            else FixedCapacityResolver([100.0, 1e5, 1e5, 1e6])
-        )
+        if resolver_cls is not None:
+            # pluggable resolver (reference broker.capacity.config.resolver.class)
+            capacity_resolver = resolver_cls(config)
+        else:
+            capacity_resolver = (
+                FileCapacityResolver(path)
+                if path
+                else FixedCapacityResolver([100.0, 1e5, 1e5, 1e6])
+            )
     partition_agg = WindowedMetricSampleAggregator(
         num_windows=config.get("num.partition.metrics.windows"),
         window_ms=config.get("partition.metrics.window.ms"),
@@ -63,6 +68,7 @@ def build_service(
     # ONE registry shared by the fetcher and the facade stack — the monitor
     # health gauges must surface in /state?substates=sensors
     sensors = SensorRegistry()
+    assignor_cls = config.get("metric.sampler.partition.assignor.class")
     fetcher = MetricFetcherManager(
         sampler,
         partition_agg,
@@ -70,6 +76,7 @@ def build_service(
         sample_store=sample_store,
         sampling_interval_ms=config.get("metric.sampling.interval.ms"),
         num_fetchers=config.get("num.metric.fetchers"),
+        assignor=assignor_cls() if assignor_cls is not None else None,
         sensors=sensors,
     )
     from cruise_control_tpu.monitor.cpu_model import LinearRegressionModelParameters
@@ -89,11 +96,28 @@ def build_service(
     # silently diverge on what "excluded" means
     if hasattr(sampler, "topic_filter"):
         sampler.topic_filter = topic_filter
+    # reference sampling.allow.cpu.capacity.estimation: samplers that can
+    # skip CPU attribution for CPU-less brokers get the configured flag
+    if hasattr(sampler, "allow_cpu_estimation"):
+        sampler.allow_cpu_estimation = config.get(
+            "sampling.allow.cpu.capacity.estimation"
+        )
 
-    regression = LinearRegressionModelParameters()
+    regression = LinearRegressionModelParameters(
+        cpu_util_bucket_size=config.get("linear.regression.model.cpu.util.bucket.size"),
+        required_samples_per_bucket=config.get(
+            "linear.regression.model.required.samples.per.bucket"
+        ),
+        min_num_cpu_util_buckets=config.get(
+            "linear.regression.model.min.num.cpu.util.buckets"
+        ),
+    )
     monitor = LoadMonitor(
         metadata, capacity_resolver, partition_agg,
         regression=regression, topic_filter=topic_filter,
+        max_allowed_extrapolations=config.get(
+            "max.allowed.extrapolations.per.partition"
+        ),
     )
 
     if partitions_fn is None:
@@ -118,10 +142,19 @@ def build_service(
         partitions_fn,
         window_ms=config.get("partition.metrics.window.ms"),
         regression=regression,
+        auto_train=config.get("use.linear.regression.model"),
     )
     cc = CruiseControl(config, monitor, admin, sensors=sensors)
     cc.task_runner = task_runner
     app = CruiseControlApp(cc)
+    # warm restart: replay the sample store off the startup path (reference
+    # SampleLoadingTask runs async; skip.loading.samples disables it)
+    if sample_store is not None and not config.get("skip.loading.samples"):
+        import threading
+
+        threading.Thread(
+            target=task_runner.load_samples, daemon=True, name="sample-loading"
+        ).start()
     return app, fetcher
 
 

@@ -105,10 +105,15 @@ def _min1_float(s: str):
 
 # bounds MATCH server._parse_execution_overrides — the declared parser is
 # what custom request classes consume, so the two layers must agree
+_STRATEGIES = Param(
+    "replica_movement_strategies", _str_list,
+    "ordered strategy names from the replica.movement.strategies pool",
+)
 _EXECUTION = (
     Param("concurrent_partition_movements_per_broker", _min1_int),
     Param("concurrent_leader_movements", _min1_int),
     Param("replication_throttle", _min1_float),
+    _STRATEGIES,
 )
 _DRYRUN = Param("dryrun", _bool)
 _REVIEW_ID = Param("review_id", _int, "two-step verification approval id")

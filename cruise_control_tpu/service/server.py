@@ -108,9 +108,13 @@ def _parse_bool(params: dict, name: str, default: bool) -> bool:
     return params[name][0].lower() in ("true", "1", "yes")
 
 
-def _parse_execution_overrides(params: dict) -> dict:
+def _parse_execution_overrides(params: dict, allowed_strategies=None) -> dict:
     """Per-request execution knobs (reference ParameterUtils: concurrency
-    caps + replication_throttle request parameters)."""
+    caps + replication_throttle request parameters).
+
+    allowed_strategies: the configured replica.movement.strategies pool —
+    an unknown strategy name 400s HERE, before a full proposal computation
+    is wasted on a request that can never execute."""
     out = {}
     for name, cast, lo in (
         ("concurrent_partition_movements_per_broker", int, 1),
@@ -127,6 +131,22 @@ def _parse_execution_overrides(params: dict) -> dict:
                 # reject loudly rather than hang the user task
                 raise BadRequest(f"{name} must be >= {lo}, got {v}")
             out[name] = v
+    if "replica_movement_strategies" in params:
+        # per-request task-ordering override (reference ParameterUtils
+        # replica_movement_strategies)
+        names = [
+            s.strip()
+            for s in params["replica_movement_strategies"][0].split(",")
+            if s.strip()
+        ]
+        if allowed_strategies is not None:
+            unknown = [n for n in names if n not in allowed_strategies]
+            if unknown:
+                raise BadRequest(
+                    f"unknown replica movement strategies {unknown}; "
+                    f"allowed: {sorted(allowed_strategies)}"
+                )
+        out["replica_movement_strategies"] = names
     return out
 
 
@@ -530,7 +550,7 @@ class CruiseControlApp:
         goals = params.get("goals", [None])[0]
         dests = params.get("destination_broker_ids", [None])[0]
         excluded = params.get("excluded_topics", [None])[0]
-        overrides = _parse_execution_overrides(params)
+        overrides = _parse_execution_overrides(params, self.cc.allowed_strategies)
         # reference rebalance parameters exclude recently removed/demoted
         # brokers from receiving replicas/leadership
         ex_removed = (
@@ -563,7 +583,7 @@ class CruiseControlApp:
     def _ep_add_broker(self, params) -> tuple[int, dict]:
         ids = _parse_int_list(params, "brokerid")
         dryrun = _parse_bool(params, "dryrun", True)
-        overrides = _parse_execution_overrides(params)
+        overrides = _parse_execution_overrides(params, self.cc.allowed_strategies)
         return self._async_op(
             "add_broker",
             lambda progress: self.cc.add_brokers(
@@ -574,7 +594,7 @@ class CruiseControlApp:
     def _ep_remove_broker(self, params) -> tuple[int, dict]:
         ids = _parse_int_list(params, "brokerid")
         dryrun = _parse_bool(params, "dryrun", True)
-        overrides = _parse_execution_overrides(params)
+        overrides = _parse_execution_overrides(params, self.cc.allowed_strategies)
         return self._async_op(
             "remove_broker",
             lambda progress: self.cc.remove_brokers(

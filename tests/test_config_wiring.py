@@ -205,6 +205,122 @@ def test_config_excluded_topics_merged(wired_service):
     assert bool(opts2.excluded_topics[t0]) and bool(opts2.excluded_topics[t1])
 
 
+# ------------------------------------------------------------- pluggables
+
+
+def test_strategy_chain_resolution_and_pool():
+    from cruise_control_tpu.executor.strategy import (
+        PrioritizeLargeReplicaMovementStrategy,
+        resolve_strategy_chain,
+    )
+
+    chain = resolve_strategy_chain(
+        ["PostponeUrpReplicaMovementStrategy", "PrioritizeLargeReplicaMovementStrategy"]
+    )
+    assert "PostponeUrp" in chain.name and "PrioritizeLarge" in chain.name
+    # pool restriction (reference replica.movement.strategies)
+    with pytest.raises(ValueError):
+        resolve_strategy_chain(
+            ["PrioritizeLargeReplicaMovementStrategy"],
+            allowed={"BaseReplicaMovementStrategy"},
+        )
+    # dotted path resolves a custom class
+    custom = resolve_strategy_chain(
+        ["cruise_control_tpu.executor.strategy.PrioritizeSmallReplicaMovementStrategy"]
+    )
+    assert custom.name == "PrioritizeSmallReplicaMovementStrategy"
+    with pytest.raises(ValueError):
+        resolve_strategy_chain(["NoSuchStrategy"])
+
+
+def test_executor_notifier_called():
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    calls = []
+
+    class Notifier:
+        def on_execution_finished(self, result, uuid):
+            calls.append((result.completed, uuid))
+
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(synthetic_topology(num_brokers=3, topics={"T0": 3}))
+    )
+    ex = Executor(admin, notifier=Notifier())
+    ex.execute_proposals([], uuid="op-1")
+    assert calls == [(0, "op-1")]
+
+
+def test_regression_bucket_gate_and_auto_train():
+    import numpy as np
+
+    from cruise_control_tpu.monitor.cpu_model import LinearRegressionModelParameters
+
+    lr = LinearRegressionModelParameters(
+        min_samples_to_train=6,
+        cpu_util_bucket_size=10,
+        required_samples_per_bucket=2,
+        min_num_cpu_util_buckets=3,
+    )
+    rng = np.random.default_rng(1)
+    # all samples in one CPU bucket: floor met but coverage insufficient
+    for _ in range(6):
+        x = rng.uniform(0, 1000, 3)
+        lr.add_sample(*x, cpu_util=0.05)
+    assert not lr.ready_to_train()
+    assert not lr.train()
+    # force (explicit /train) overrides coverage, not the sample floor
+    assert lr.train(force=True)
+    lr2 = LinearRegressionModelParameters(
+        min_samples_to_train=6, cpu_util_bucket_size=10,
+        required_samples_per_bucket=2, min_num_cpu_util_buckets=3,
+    )
+    for cpu in (0.05, 0.05, 0.35, 0.35, 0.65, 0.65):
+        x = rng.uniform(0, 1000, 3)
+        lr2.add_sample(*x, cpu_util=cpu)
+    assert lr2.ready_to_train()
+    assert lr2.train()
+
+
+def test_rf_finder_uses_topic_config_provider():
+    import dataclasses
+
+    from cruise_control_tpu.detector.detectors import (
+        TopicReplicationFactorAnomalyFinder,
+    )
+    from cruise_control_tpu.monitor.topic_config import StaticTopicConfigProvider
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    topo = synthetic_topology(num_brokers=4, topics={"T0": 2, "T1": 2}, seed=0)
+    # force both topics to RF 2
+    parts = tuple(
+        dataclasses.replace(p, replicas=tuple(p.replicas[:2])) for p in topo.partitions
+    )
+    topo = dataclasses.replace(topo, partitions=parts)
+    provider = StaticTopicConfigProvider({"T0": {"min.insync.replicas": "2"}})
+    finder = TopicReplicationFactorAnomalyFinder(
+        lambda: topo, target_rf=2, topic_config_provider=provider
+    )
+    anomaly = finder.detect()
+    # T0 needs RF >= minISR+1 = 3 -> flagged; T1 (minISR 1) is fine at RF 2
+    assert anomaly is not None
+    assert set(anomaly.bad_topics) == {"T0"}
+    # without a provider, RF 2 meets the global target -> no anomaly
+    assert TopicReplicationFactorAnomalyFinder(lambda: topo, target_rf=2).detect() is None
+
+
+def test_sampler_cpu_estimation_flag():
+    from cruise_control_tpu.config import CruiseControlConfig
+
+    c = CruiseControlConfig({})
+    assert c.get("sampling.allow.cpu.capacity.estimation") is True
+    assert c.get("use.linear.regression.model") is False
+    assert c.get("skip.loading.samples") is False
+    assert c.get("max.allowed.extrapolations.per.broker") == 5
+
+
 # ------------------------------------------------------------- webserver
 
 

@@ -146,7 +146,13 @@ def test_follower_cpu_model():
     )
     assert follower_cpu_util(0.0, 0.0, 10.0) == 0.0
 
-    lr = LinearRegressionModelParameters(min_samples_to_train=10)
+    lr = LinearRegressionModelParameters(
+        min_samples_to_train=10,
+        # relax the bucket-coverage gate: this fixture's synthetic loads
+        # land in few CPU-util buckets (gate itself tested separately)
+        required_samples_per_bucket=1,
+        min_num_cpu_util_buckets=1,
+    )
     rng = np.random.default_rng(0)
     true_w = np.array([0.002, 0.001, 0.0005])
     for _ in range(50):
