@@ -193,6 +193,13 @@ def _monitor_defs() -> ConfigDef:
     d.define("linear.regression.model.min.num.cpu.util.buckets", T.INT, 5,
              I.LOW, "distinct covered buckets required to train "
              "(reference MonitorConfig:286)", in_range(lo=1), group=g)
+    d.define("leader.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.7, I.LOW,
+             "static follower-CPU model coefficient "
+             "(reference MonitorConfig:241)", in_range(lo=0.0), group=g)
+    d.define("leader.network.outbound.weight.for.cpu.util", T.DOUBLE, 0.15,
+             I.LOW, "(reference MonitorConfig:250)", in_range(lo=0.0), group=g)
+    d.define("follower.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.15,
+             I.LOW, "(reference MonitorConfig:259)", in_range(lo=0.0), group=g)
     d.define("broker.capacity.config.resolver.class", T.CLASS, None, I.MEDIUM,
              "custom BrokerCapacityConfigResolver; called with the "
              "CruiseControlConfig (reference "
@@ -238,6 +245,9 @@ def _executor_defs() -> ConfigDef:
              "the pool of strategies requests may reference (reference "
              "ExecutorConfig replica.movement.strategies); dotted paths "
              "register custom classes", group=g)
+    d.define("inter.broker.replica.movement.rate.alerting.threshold", T.DOUBLE,
+             0.1, I.LOW, "MB/s floor; slower long-running inter-broker moves "
+             "alert (reference ExecutorConfig:142)", in_range(lo=0.0), group=g)
     d.define("executor.notifier.class", T.CLASS, None, I.LOW,
              "object notified after every execution finishes; called with "
              "no args, must expose on_execution_finished(result, uuid) "
@@ -399,6 +409,18 @@ def _webserver_defs() -> ConfigDef:
              "rolled access logs older than this are deleted",
              in_range(lo=1), group=g)
     d.define("webserver.security.enable", T.BOOLEAN, False, I.MEDIUM, "", group=g)
+    d.define("webserver.security.provider", T.CLASS, None, I.MEDIUM,
+             "custom SecurityProvider (reference WebServerConfig:164); "
+             "called with the CruiseControlConfig, must expose "
+             "authenticate(headers) and authorize(role, method, endpoint); "
+             "unset selects JWT/basic from the other keys", group=g)
+    # static UI serving (reference WebServerConfig:84-91 serves
+    # cruise-control-ui from disk)
+    d.define("webserver.ui.diskpath", T.STRING, None, I.LOW,
+             "directory of UI static files; unset disables UI serving",
+             group=g)
+    d.define("webserver.ui.urlprefix", T.STRING, "/ui", I.LOW,
+             "URL prefix the UI is served under", group=g)
     d.define("basic.auth.credentials.file", T.STRING, None, I.MEDIUM,
              "htpasswd-style user:password[:role] lines", group=g)
     d.define("webserver.auth.credentials.file", T.STRING, None, I.MEDIUM,
@@ -454,7 +476,7 @@ def _webserver_defs() -> ConfigDef:
     # config/constants/CruiseControlParametersConfig.java:1 +
     # CruiseControlRequestConfig.java:1): every endpoint's parameter
     # declaration and request execution are pluggable
-    from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS
+    from cruise_control_tpu.config.endpoints import ALL_ENDPOINTS, reference_key_name
 
     for ep in sorted(ALL_ENDPOINTS):
         d.define(f"{ep}.parameters.class", T.CLASS, None, I.LOW,
@@ -465,6 +487,14 @@ def _webserver_defs() -> ConfigDef:
                  f"dotted path of a custom request handler for /{ep}; "
                  "called with (app, endpoint, params) -> (status, payload)",
                  group=g)
+        ref = reference_key_name(ep)
+        if ref != ep:
+            # accept the reference's dotted spelling too, so an existing
+            # cruisecontrol.properties keeps working unmodified
+            d.define(f"{ref}.parameters.class", T.CLASS, None, I.LOW,
+                     f"reference spelling of {ep}.parameters.class", group=g)
+            d.define(f"{ref}.request.class", T.CLASS, None, I.LOW,
+                     f"reference spelling of {ep}.request.class", group=g)
     return d
 
 

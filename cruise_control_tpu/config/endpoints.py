@@ -45,3 +45,12 @@ ENDPOINT_TYPES = {
     "topic_configuration": "KAFKA_ADMIN",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
+
+
+def reference_key_name(endpoint: str) -> str:
+    """The reference's dotted endpoint spelling for {endpoint}.parameters.class
+    / .request.class keys (CruiseControlParametersConfig.java uses e.g.
+    add.broker.parameters.class, stop.proposal.request.class)."""
+    if endpoint == "stop_proposal_execution":
+        return "stop.proposal"
+    return endpoint.replace("_", ".")

@@ -66,6 +66,13 @@ class ExecutionOptions:
     #: a leadership move the topology has not confirmed within this window
     #: is declared DEAD (reference ExecutorConfig leader.movement.timeout.ms)
     leader_movement_timeout_s: float = 180.0
+    #: MB/s floor for the slow-task alert: an inter-broker replica move
+    #: alerts when its execution time exceeds task_execution_alerting_s AND
+    #: its data rate is below this (reference ExecutorConfig
+    #: inter.broker.replica.movement.rate.alerting.threshold).  There is no
+    #: intra-broker analog: intra moves are submitted and confirmed within
+    #: one tick here, so no long-running intra task exists to rate-alert.
+    inter_broker_rate_alerting_mb_s: float = 0.1
     replication_throttle_bytes_per_s: float | None = None
     progress_check_interval_s: float = 0.5
     #: tasks in progress longer than this raise an alert flag
@@ -309,8 +316,23 @@ class Executor:
                     task.alert_time_ms < 0
                     and now_ms() - task.start_time_ms
                     > options.task_execution_alerting_s * 1000
+                    # reference alerts only when the task is ALSO moving
+                    # slower than the rate floor (ExecutorConfig:142-158);
+                    # data_to_move is BYTES, the threshold is MB/s
+                    and task.proposal.inter_broker_data_to_move
+                    / 1e6
+                    / max((now_ms() - task.start_time_ms) / 1000.0, 1e-9)
+                    < options.inter_broker_rate_alerting_mb_s
                 ):
                     task.alert_time_ms = now_ms()
+                    self.sensors.counter("executor.slow-task-alert").inc()
+                    if self.notifier is not None and hasattr(
+                        self.notifier, "on_task_alert"
+                    ):
+                        try:
+                            self.notifier.on_task_alert(task)
+                        except Exception:  # noqa: BLE001
+                            pass
             # mark tasks dead when a destination broker died mid-move
             alive = topo.alive_broker_ids()
             for key, task in list(in_flight.items()):

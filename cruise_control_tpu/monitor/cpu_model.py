@@ -22,27 +22,45 @@ LEADER_BYTES_OUT_CPU_WEIGHT = 0.15
 FOLLOWER_BYTES_IN_CPU_WEIGHT = 0.15
 
 
-def follower_cpu_util(leader_bytes_in: float, leader_bytes_out: float, leader_cpu: float) -> float:
+#: (leader bytes-in, leader bytes-out, follower bytes-in) weight triple —
+#: reference MonitorConfig {leader.network.inbound, leader.network.outbound,
+#: follower.network.inbound}.weight.for.cpu.util
+DEFAULT_CPU_WEIGHTS = (
+    LEADER_BYTES_IN_CPU_WEIGHT,
+    LEADER_BYTES_OUT_CPU_WEIGHT,
+    FOLLOWER_BYTES_IN_CPU_WEIGHT,
+)
+
+
+def follower_cpu_util(
+    leader_bytes_in: float,
+    leader_bytes_out: float,
+    leader_cpu: float,
+    weights: tuple[float, float, float] = DEFAULT_CPU_WEIGHTS,
+) -> float:
     """CPU a follower of this partition would use, from leader-side rates
     (reference ModelUtils.getFollowerCpuUtilFromLeaderLoad:53-67)."""
-    total = (
-        LEADER_BYTES_IN_CPU_WEIGHT * leader_bytes_in
-        + LEADER_BYTES_OUT_CPU_WEIGHT * leader_bytes_out
-    )
+    w_in, w_out, w_follow = weights
+    total = w_in * leader_bytes_in + w_out * leader_bytes_out
     if total <= 0:
         return 0.0
-    return leader_cpu * (FOLLOWER_BYTES_IN_CPU_WEIGHT * leader_bytes_in) / total
+    return leader_cpu * (w_follow * leader_bytes_in) / total
 
 
-def follower_cpu_util_array(leader_loads: np.ndarray, leader_cpu: np.ndarray) -> np.ndarray:
+def follower_cpu_util_array(
+    leader_loads: np.ndarray,
+    leader_cpu: np.ndarray,
+    weights: tuple[float, float, float] = DEFAULT_CPU_WEIGHTS,
+) -> np.ndarray:
     """Vectorized follower CPU for [N, 4] leader loads."""
     from cruise_control_tpu.common.resources import Resource
 
+    w_in, w_out, w_follow = weights
     bin_ = leader_loads[:, Resource.NW_IN]
     bout = leader_loads[:, Resource.NW_OUT]
-    total = LEADER_BYTES_IN_CPU_WEIGHT * bin_ + LEADER_BYTES_OUT_CPU_WEIGHT * bout
+    total = w_in * bin_ + w_out * bout
     out = np.where(
-        total > 0, leader_cpu * FOLLOWER_BYTES_IN_CPU_WEIGHT * bin_ / np.maximum(total, 1e-12), 0.0
+        total > 0, leader_cpu * w_follow * bin_ / np.maximum(total, 1e-12), 0.0
     )
     return out.astype(np.float32)
 

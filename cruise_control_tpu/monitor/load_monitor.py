@@ -86,10 +86,17 @@ class LoadMonitor:
         regression=None,
         topic_filter=None,
         max_allowed_extrapolations: int = 5,
+        cpu_weights: tuple[float, float, float] | None = None,
     ):
+        from cruise_control_tpu.monitor.cpu_model import DEFAULT_CPU_WEIGHTS
+
         #: reference MonitorConfig max.allowed.extrapolations.per.partition —
         #: partitions whose windows extrapolate more than this are invalid
         self.max_allowed_extrapolations = max_allowed_extrapolations
+        #: static follower-CPU coefficients (reference MonitorConfig
+        #: {leader.network.inbound,leader.network.outbound,
+        #: follower.network.inbound}.weight.for.cpu.util)
+        self.cpu_weights = cpu_weights or DEFAULT_CPU_WEIGHTS
         self.metadata = metadata
         self.capacity_resolver = capacity_resolver
         self.partition_aggregator = partition_aggregator
@@ -319,7 +326,9 @@ class LoadMonitor:
         if self.regression is not None and self.regression.trained:
             follower_cpu = self.regression.follower_cpu_array(loads)
         else:
-            follower_cpu = follower_cpu_util_array(loads, leader_cpu)
+            follower_cpu = follower_cpu_util_array(
+                loads, leader_cpu, weights=self.cpu_weights
+            )
         alive = topology.alive_broker_ids()
         for p in topology.partitions:
             tid = topic_ids[p.topic]

@@ -489,6 +489,9 @@ class CruiseControl:
             max_num_cluster_movements=self.config.get("max.num.cluster.movements"),
             leader_movement_timeout_s=self.config.get("leader.movement.timeout.ms")
             / 1000.0,
+            inter_broker_rate_alerting_mb_s=self.config.get(
+                "inter.broker.replica.movement.rate.alerting.threshold"
+            ),
             replication_throttle_bytes_per_s=_ov(
                 "replication_throttle", "default.replication.throttle"
             ),

@@ -118,6 +118,11 @@ def build_service(
         max_allowed_extrapolations=config.get(
             "max.allowed.extrapolations.per.partition"
         ),
+        cpu_weights=(
+            config.get("leader.network.inbound.weight.for.cpu.util"),
+            config.get("leader.network.outbound.weight.for.cpu.util"),
+            config.get("follower.network.inbound.weight.for.cpu.util"),
+        ),
     )
 
     if partitions_fn is None:

@@ -197,13 +197,24 @@ def build_override_maps(config) -> tuple[dict, dict]:
     (app, endpoint, parsed_params) -> (status, payload).  Unset keys keep
     the builtins.
     """
+    from cruise_control_tpu.config.endpoints import reference_key_name
+
     parsers: dict[str, object] = dict(ENDPOINT_PARAMETERS)
     handlers: dict[str, object] = {}
     for ep in ENDPOINT_PARAMETERS:
-        p_cls = config.get(f"{ep}.parameters.class")
+        ref = reference_key_name(ep)
+
+        def _get(kind: str):
+            # our spelling wins; the reference's dotted spelling is accepted
+            v = config.get(f"{ep}.{kind}.class")
+            if v is None and ref != ep:
+                v = config.get(f"{ref}.{kind}.class")
+            return v
+
+        p_cls = _get("parameters")
         if p_cls:
             parsers[ep] = p_cls(ep, ENDPOINT_PARAMETERS[ep])
-        r_cls = config.get(f"{ep}.request.class")
+        r_cls = _get("request")
         if r_cls:
             handlers[ep] = r_cls
     return parsers, handlers

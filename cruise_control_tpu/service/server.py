@@ -214,8 +214,13 @@ class CruiseControlApp:
             expected_audiences=cc.config.get("jwt.expected.audiences") or None,
         )
         self.auth_provider_url = cc.config.get("jwt.authentication.provider.url")
+        custom_security = cc.config.get("webserver.security.provider")
         if not cc.config.get("webserver.security.enable"):
             self.security = AllowAllSecurityProvider()
+        elif custom_security is not None:
+            # pluggable provider outranks the builtin selection
+            # (reference webserver.security.provider)
+            self.security = custom_security(cc.config)
         elif jwt_cert:
             # certificate-based RS256 outranks shared-secret HS256
             self.security = JwtRs256SecurityProvider(jwt_cert, **jwt_kwargs)
@@ -249,6 +254,18 @@ class CruiseControlApp:
             if cc.config.get("webserver.accesslog.enabled")
             else None
         )
+        # static UI (reference webserver.ui.{diskpath,urlprefix})
+        self.ui_diskpath = cc.config.get("webserver.ui.diskpath")
+        self.ui_prefix = (cc.config.get("webserver.ui.urlprefix") or "/ui").rstrip("/")
+        if self.ui_diskpath and (
+            not self.ui_prefix or self.ui_prefix == self.cc.config.get(
+                "webserver.api.urlprefix").rstrip("/")
+        ):
+            # "/" (empty after rstrip) would shadow every GET API route
+            raise ValueError(
+                "webserver.ui.urlprefix must be a non-root prefix distinct "
+                f"from the API prefix, got {cc.config.get('webserver.ui.urlprefix')!r}"
+            )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
         self.param_parsers, self.request_handlers = build_override_maps(cc.config)
@@ -707,6 +724,22 @@ class CruiseControlApp:
 
                         self._new_session_id = _uuid.uuid4().hex
                         self.headers["X-Client"] = "cookie:" + self._new_session_id
+                if (
+                    method == "GET"
+                    and app.ui_diskpath
+                    and (
+                        parsed.path == app.ui_prefix
+                        or parsed.path.startswith(app.ui_prefix + "/")
+                    )
+                ):
+                    # the UI sits behind the same authentication as the API
+                    # (reference: the security handler wraps the whole
+                    # server), and gets the same login challenge/redirect
+                    if app.security.authenticate(self.headers) is None:
+                        self._auth_challenge(method)
+                        return
+                    self._serve_ui(parsed.path)
+                    return
                 if not parsed.path.startswith(app.prefix + "/"):
                     self._send(404, {"errorMessage": "unknown path"})
                     return
@@ -722,35 +755,7 @@ class CruiseControlApp:
                     OPERATION_LOGGER.info(
                         "%s %s by <unauthenticated> -> 401", method, endpoint
                     )
-                    if app.auth_provider_url:
-                        # reference jwt.authentication.provider.url: browsers
-                        # are bounced to the token issuer with the original
-                        # URL so they come back authenticated
-                        loc = app.auth_provider_url.replace(
-                            "{redirect}", urllib.parse.quote(self.path, safe="")
-                        )
-                        self.send_response(302)
-                        self.send_header("Location", loc)
-                        self.send_header("Content-Length", "0")
-                        self.end_headers()
-                        if app.access_log:
-                            app.access_log.log(
-                                self.client_address[0], "", method, self.path,
-                                302, 0,
-                            )
-                        return
-                    body = json.dumps({"errorMessage": "authentication required"}).encode()
-                    self.send_response(401)
-                    self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    if app.access_log:
-                        app.access_log.log(
-                            self.client_address[0], "", method, self.path,
-                            401, len(body),
-                        )
+                    self._auth_challenge(method)
                     return
                 principal, role = auth
                 if not app.security.authorize(role, method, endpoint):
@@ -802,6 +807,66 @@ class CruiseControlApp:
                         self.path,
                         status,
                         len(body),
+                    )
+
+            def _auth_challenge(self, method: str):
+                """401 with a WWW-Authenticate challenge, or a 302 to the
+                configured auth provider (jwt.authentication.provider.url) —
+                shared by the API and UI paths so a browser can always log
+                in."""
+                if app.auth_provider_url:
+                    # reference jwt.authentication.provider.url: browsers
+                    # are bounced to the token issuer with the original
+                    # URL so they come back authenticated
+                    loc = app.auth_provider_url.replace(
+                        "{redirect}", urllib.parse.quote(self.path, safe="")
+                    )
+                    self.send_response(302)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    if app.access_log:
+                        app.access_log.log(
+                            self.client_address[0], "", method, self.path, 302, 0
+                        )
+                    return
+                body = json.dumps({"errorMessage": "authentication required"}).encode()
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", 'Basic realm="cruise-control"')
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                if app.access_log:
+                    app.access_log.log(
+                        self.client_address[0], "", method, self.path,
+                        401, len(body),
+                    )
+
+            def _serve_ui(self, path: str):
+                """Static UI files (reference serves cruise-control-ui from
+                webserver.ui.diskpath under webserver.ui.urlprefix)."""
+                import mimetypes
+                import os
+
+                rel = path[len(app.ui_prefix):].lstrip("/") or "index.html"
+                root = os.path.realpath(app.ui_diskpath)
+                full = os.path.realpath(os.path.join(root, rel))
+                # realpath containment defeats ../ traversal
+                if not (full == root or full.startswith(root + os.sep)) or not os.path.isfile(full):
+                    self._send(404, {"errorMessage": "not found"})
+                    return
+                with open(full, "rb") as f:
+                    body = f.read()
+                ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                if app.access_log:
+                    app.access_log.log(
+                        self.client_address[0], "", "GET", path, 200, len(body)
                     )
 
             def do_GET(self):  # noqa: N802

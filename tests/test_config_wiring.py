@@ -321,6 +321,118 @@ def test_sampler_cpu_estimation_flag():
     assert c.get("max.allowed.extrapolations.per.broker") == 5
 
 
+def test_cpu_weight_keys_wired():
+    from cruise_control_tpu.monitor.cpu_model import follower_cpu_util
+
+    # default weights (0.7, 0.15, 0.15)
+    base = follower_cpu_util(100.0, 100.0, 0.5)
+    alt = follower_cpu_util(100.0, 100.0, 0.5, weights=(0.5, 0.25, 0.25))
+    assert base != alt
+    assert base == pytest.approx(0.5 * 0.15 * 100.0 / (0.7 * 100.0 + 0.15 * 100.0))
+
+
+def test_reference_spelled_override_keys_accepted():
+    from cruise_control_tpu.service.parameters import (
+        EndpointParameters,
+        build_override_maps,
+    )
+
+    class MyParams(EndpointParameters):
+        def __init__(self, endpoint, builtin):
+            super().__init__(endpoint, builtin.params)
+
+    # reference dotted spelling of add_broker.parameters.class (CLASS-typed
+    # keys accept a class object directly)
+    c = CruiseControlConfig({"add.broker.parameters.class": MyParams})
+    parsers, handlers = build_override_maps(c)
+    assert isinstance(parsers["add_broker"], MyParams)
+
+
+def test_slow_task_rate_alerting():
+    """A long-running task alerts only when ALSO slower than the MB/s floor
+    (reference ExecutorConfig:142-158)."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.executor.executor import ExecutionOptions, Executor
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(synthetic_topology(num_brokers=4, topics={"T0": 4})),
+        link_rate_bytes_per_s=1.0,  # glacial: tasks run long
+    )
+    p0 = admin.metadata.topology().partitions[0]
+    dest = next(
+        b.broker_id
+        for b in admin.metadata.topology().brokers
+        if b.broker_id not in p0.replicas
+    )
+    # 50 KB over the many simulated seconds the 1 B/s link needs puts the
+    # rate far under the default 0.1 MB/s floor — the DEFAULT threshold
+    # must fire (units: data_to_move is bytes, the threshold is MB/s)
+    prop = ExecutionProposal(
+        topic=p0.topic, partition=p0.partition, old_leader=p0.leader,
+        new_leader=p0.leader, old_replicas=tuple(p0.replicas),
+        new_replicas=tuple(list(p0.replicas[1:]) + [dest]),
+        inter_broker_data_to_move=50_000.0,
+    )
+    alerts = []
+
+    class Notifier:
+        def on_execution_finished(self, result, uuid):
+            pass
+
+        def on_task_alert(self, task):
+            alerts.append(task)
+
+    ex = Executor(admin, topic_names={0: "T0"}, notifier=Notifier())
+    ex.execute_proposals(
+        [prop],
+        ExecutionOptions(
+            progress_check_interval_s=1.0,
+            task_execution_alerting_s=2.0,
+            max_ticks=30,
+        ),
+    )
+    assert alerts, "slow task should have alerted at the default floor"
+    # a fast mover (same elapsed, vastly more data) must NOT alert
+    admin2 = SimulatedClusterAdmin(
+        StaticMetadataProvider(synthetic_topology(num_brokers=4, topics={"T0": 4})),
+        link_rate_bytes_per_s=1e9,
+    )
+    q0 = admin2.metadata.topology().partitions[0]
+    dest2 = next(
+        b.broker_id
+        for b in admin2.metadata.topology().brokers
+        if b.broker_id not in q0.replicas
+    )
+    fast = ExecutionProposal(
+        topic=q0.topic, partition=q0.partition, old_leader=q0.leader,
+        new_leader=q0.leader, old_replicas=tuple(q0.replicas),
+        new_replicas=tuple(list(q0.replicas[1:]) + [dest2]),
+        inter_broker_data_to_move=5e9,
+    )
+    alerts2 = []
+
+    class Notifier2:
+        def on_execution_finished(self, result, uuid):
+            pass
+
+        def on_task_alert(self, task):
+            alerts2.append(task)
+
+    ex2 = Executor(admin2, topic_names={0: "T0"}, notifier=Notifier2())
+    ex2.execute_proposals(
+        [fast],
+        ExecutionOptions(
+            progress_check_interval_s=1.0,
+            task_execution_alerting_s=2.0,
+            max_ticks=30,
+        ),
+    )
+    assert not alerts2, "fast mover must not rate-alert"
+
+
 # ------------------------------------------------------------- webserver
 
 
