@@ -1599,19 +1599,38 @@ class Engine:
         # dist) still have work, re-checking every round would cost more
         # than it saves
         full_checks_left = 2
-        for rnd in range(cfg.num_rounds):
+
+        def _temp(rnd: int) -> float:
             if rnd == cfg.num_rounds - 1:
-                t_round = 0.0
-            else:
-                t_round = t0_obj * (cfg.temperature_decay**rnd)
-            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            carry, stats = self._scan(sx, carry, temps, plan)
+                return 0.0
+            return t0_obj * (cfg.temperature_decay**rnd)
+
+        # pipelined round loop: round rnd+1's scan is DISPATCHED before
+        # round rnd's cheap signal is fetched, so the device keeps
+        # annealing through the host's per-round network round trip
+        # (tunneled TPU).  When the early stop fires, one speculative
+        # round's device work is abandoned — early stops are rare at the
+        # scales where a round is expensive, and the stop still returns
+        # the pre-speculation state.
+        temps0 = jnp.full((cfg.steps_per_round,), _temp(0), jnp.float32)
+        next_carry, next_stats = self._scan(sx, carry, temps0, plan)
+        for rnd in range(cfg.num_rounds):
+            stats = next_stats
             # fused between-rounds program: wash float drift out of the
             # aggregates, plan the next round's sampling, read the cheap
             # early-stop signal — one dispatch instead of three
-            carry, plan, cheap = self._jit_round_prep(sx, carry)
-            accepted = int(jax.device_get(stats["accepted"]).sum())
-            history.append(dict(round=rnd, temperature=t_round, accepted=accepted))
+            carry, plan, cheap = self._jit_round_prep(sx, next_carry)
+            if rnd + 1 < cfg.num_rounds:
+                temps = jnp.full(
+                    (cfg.steps_per_round,), _temp(rnd + 1), jnp.float32
+                )
+                next_carry, next_stats = self._scan(sx, carry, temps, plan)
+            # ONE device round-trip per round: cheap (control flow) and the
+            # per-step accept counts ride the same fetch — each extra
+            # device_get is a full network round trip
+            cheap, step_accepts = jax.device_get((cheap, stats["accepted"]))
+            accepted = int(step_accepts.sum())
+            history.append(dict(round=rnd, temperature=_temp(rnd), accepted=accepted))
             if verbose:
                 history[-1]["objective"] = float(self._jit_objective(sx, carry))
             # early stop: all goals already satisfied.  The O(B) lower bound

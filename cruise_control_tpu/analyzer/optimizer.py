@@ -206,24 +206,53 @@ class GoalOptimizer:
         verbose: bool = False,
         config: OptimizerConfig | None = None,
     ) -> OptimizerResult:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from cruise_control_tpu.analyzer.proposals import fetch_before_host
+        from cruise_control_tpu.models.state import DEVICE_CHECKS, validate_on_device
+
         t0 = time.monotonic()
-        validate(state)
+        # input sanity: the ON-DEVICE check transfers a [5] count vector
+        # instead of the model's bulk arrays (the tunneled-TPU transfer
+        # costs more than the checks); the host validator re-runs for the
+        # detailed message only on failure
+        input_checks = np.asarray(validate_on_device(state))
+        if input_checks.any():
+            validate(state)  # raises with per-invariant detail
+            bad = [n for n, c in zip(DEVICE_CHECKS, input_checks) if c]
+            raise ValueError(f"input state failed sanity checks: {bad}")
         cfg = config or self.config
         (obj_b, viol_b), stats_b = self._report(state)
-        if self.parallel_mode == "single":
-            engine = self._engine_for(state, options, cfg)
-            final, history = engine.run(verbose=verbose)
-        else:
-            final, history = self._parallel_engine(state, options, cfg).run(
-                verbose=verbose
-            )
+        # the proposal diff needs bulk BEFORE-state arrays on host; pull
+        # them on a side thread while the device anneals — input buffers
+        # are immutable, and the copy rides the link during compute the
+        # host would otherwise spend blocked on the engine
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            before_host_f = pool.submit(fetch_before_host, state)
+            if self.parallel_mode == "single":
+                engine = self._engine_for(state, options, cfg)
+                final, history = engine.run(verbose=verbose)
+            else:
+                final, history = self._parallel_engine(state, options, cfg).run(
+                    verbose=verbose
+                )
+            before_host = before_host_f.result()
+        # dispatch the result report + the on-device sanity check, then do
+        # the host-side proposal diff while the device drains them
         (obj_a, viol_a), stats_a = self._report(final)
-        validate(final)
+        final_checks = validate_on_device(final)
+        proposals = extract_proposals(state, final, before_host=before_host)
+        final_checks = np.asarray(final_checks)
+        if final_checks.any():
+            bad = [n for n, c in zip(DEVICE_CHECKS, final_checks) if c]
+            # re-run the host validator for the detailed message
+            validate(final)
+            raise ValueError(f"optimized state failed sanity checks: {bad}")
         viol_b = np.asarray(viol_b)
         viol_a = np.asarray(viol_a)
         wall = time.monotonic() - t0
         return OptimizerResult(
-            proposals=extract_proposals(state, final),
+            proposals=proposals,
             state_before=state,
             state_after=final,
             stats_before=stats_b,

@@ -52,29 +52,61 @@ class ExecutionProposal:
         }
 
 
-def extract_proposals(before: ClusterState, after: ClusterState) -> list[ExecutionProposal]:
+BEFORE_HOST_KEYS = (
+    "replica_valid", "replica_topic", "replica_broker", "replica_is_leader",
+    "replica_disk", "replica_partition", "replica_pos",
+)
+
+
+def fetch_before_host(state: ClusterState) -> dict:
+    """One batched device->host transfer of everything extract_proposals
+    needs from the BEFORE state — on a tunneled TPU the transfer dominates,
+    so callers fetch once and share.  Only the DISK column of the [R, 4]
+    leader loads crosses (the full matrix would quadruple the payload)."""
+    import jax
+
+    vals = jax.device_get(
+        tuple(getattr(state, k) for k in BEFORE_HOST_KEYS)
+        + (state.replica_load_leader[:, int(Resource.DISK)],)
+    )
+    out = dict(zip(BEFORE_HOST_KEYS, vals[:-1]))
+    out["replica_disk_bytes"] = vals[-1]
+    return out
+
+
+def extract_proposals(
+    before: ClusterState,
+    after: ClusterState,
+    before_host: dict | None = None,
+) -> list[ExecutionProposal]:
     """Diff two placements into per-partition proposals
     (reference analyzer/AnalyzerUtils.getDiff:50-117).
 
     Vectorized over a padded [P, max_rf] partition-replica table: at
     LinkedIn scale a rebalance touches >100k partitions and per-partition
     numpy slicing would dominate the optimizer wall-clock.
+
+    before_host: pre-fetched numpy copies of the before-state arrays
+    (fetch_before_host) — skips re-transferring them.
     """
     import jax
 
     from cruise_control_tpu.analyzer.engine import partition_replica_table
 
-    # one batched device->host transfer (per-array np.asarray syncs 10x)
-    (
-        valid, topic, b_old, b_new, l_old, l_new, d_old, d_new, load_l,
-        part_arr, pos_arr,
-    ) = jax.device_get((
-        before.replica_valid, before.replica_topic, before.replica_broker,
-        after.replica_broker, before.replica_is_leader, after.replica_is_leader,
-        before.replica_disk, after.replica_disk, before.replica_load_leader,
-        before.replica_partition, before.replica_pos,
+    if before_host is None:
+        before_host = fetch_before_host(before)
+    valid = before_host["replica_valid"]
+    topic = before_host["replica_topic"]
+    b_old = before_host["replica_broker"]
+    l_old = before_host["replica_is_leader"]
+    d_old = before_host["replica_disk"]
+    disk_bytes = before_host["replica_disk_bytes"]
+    part_arr = before_host["replica_partition"]
+    pos_arr = before_host["replica_pos"]
+    # only the AFTER placement still lives on device
+    b_new, l_new, d_new = jax.device_get((
+        after.replica_broker, after.replica_is_leader, after.replica_disk,
     ))
-    disk_bytes = load_l[:, int(Resource.DISK)]
     host = {
         "replica_valid": valid, "replica_partition": part_arr, "replica_pos": pos_arr,
     }

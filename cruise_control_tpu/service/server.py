@@ -257,14 +257,13 @@ class CruiseControlApp:
         # static UI (reference webserver.ui.{diskpath,urlprefix})
         self.ui_diskpath = cc.config.get("webserver.ui.diskpath")
         self.ui_prefix = (cc.config.get("webserver.ui.urlprefix") or "/ui").rstrip("/")
-        if self.ui_diskpath and (
-            not self.ui_prefix or self.ui_prefix == self.cc.config.get(
-                "webserver.api.urlprefix").rstrip("/")
-        ):
-            # "/" (empty after rstrip) would shadow every GET API route
+        # API routes are dispatched before the UI, so a UI prefix can never
+        # shadow them; only a root prefix (no path component at all) is
+        # rejected as almost certainly a misconfiguration
+        if self.ui_diskpath and not self.ui_prefix:
             raise ValueError(
-                "webserver.ui.urlprefix must be a non-root prefix distinct "
-                f"from the API prefix, got {cc.config.get('webserver.ui.urlprefix')!r}"
+                "webserver.ui.urlprefix must be a non-root prefix, got "
+                f"{cc.config.get('webserver.ui.urlprefix')!r}"
             )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
@@ -724,23 +723,26 @@ class CruiseControlApp:
 
                         self._new_session_id = _uuid.uuid4().hex
                         self.headers["X-Client"] = "cookie:" + self._new_session_id
-                if (
-                    method == "GET"
-                    and app.ui_diskpath
-                    and (
-                        parsed.path == app.ui_prefix
-                        or parsed.path.startswith(app.ui_prefix + "/")
-                    )
-                ):
-                    # the UI sits behind the same authentication as the API
-                    # (reference: the security handler wraps the whole
-                    # server), and gets the same login challenge/redirect
-                    if app.security.authenticate(self.headers) is None:
-                        self._auth_challenge(method)
-                        return
-                    self._serve_ui(parsed.path)
-                    return
+                # API paths are checked FIRST: no webserver.ui.urlprefix
+                # value (e.g. an ancestor of the API prefix) may shadow an
+                # API route
                 if not parsed.path.startswith(app.prefix + "/"):
+                    if (
+                        method == "GET"
+                        and app.ui_diskpath
+                        and (
+                            parsed.path == app.ui_prefix
+                            or parsed.path.startswith(app.ui_prefix + "/")
+                        )
+                    ):
+                        # the UI sits behind the same authentication as the
+                        # API (reference: the security handler wraps the
+                        # whole server), with the same login challenge
+                        if app.security.authenticate(self.headers) is None:
+                            self._auth_challenge(method)
+                            return
+                        self._serve_ui(parsed.path)
+                        return
                     self._send(404, {"errorMessage": "unknown path"})
                     return
                 endpoint = parsed.path[len(app.prefix) + 1:].strip("/").lower()
@@ -862,6 +864,16 @@ class CruiseControlApp:
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # same cross-cutting headers as _send: the session cookie
+                # (sticky session->task rebind starts at the UI) and CORS
+                for k, v in app.cors_headers.items():
+                    self.send_header(k, v)
+                if getattr(self, "_new_session_id", None):
+                    self.send_header(
+                        "Set-Cookie",
+                        f"CCSESSION={self._new_session_id}; "
+                        f"Path={app.session_path}; HttpOnly",
+                    )
                 self.end_headers()
                 self.wfile.write(body)
                 if app.access_log:
