@@ -20,6 +20,14 @@ budgeted objective is what it achieves in comparable time.
 
 Env: BENCH_CONFIGS="1,2,3,4,5" to select (default all);
 BENCH_SCALE=north_star|mid|small retained for the headline fixture size.
+
+warmup_s on the headline is the FIRST optimize() call in a fresh process
+with a warm persistent XLA cache: engine statics build + program
+trace/lower + cache-hit compile + one full proposal computation.  It is
+the operator's honest time-to-first-proposal — and that first pass
+already yields a complete usable proposal set (the service's precompute
+loop caches it), not discarded warm-up work.  Cold cache (first process
+ever) adds ~60s of XLA compilation on top.
 """
 
 import json

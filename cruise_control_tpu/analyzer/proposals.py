@@ -34,6 +34,8 @@ class ExecutionProposal:
     disk_moves: tuple[tuple[int, int, int], ...] = ()
     #: bytes of replica data crossing broker boundaries
     inter_broker_data_to_move: float = 0.0
+    #: bytes of replica data moving between a broker's own logdirs
+    intra_broker_data_to_move: float = 0.0
 
     @property
     def has_replica_action(self) -> bool:
@@ -160,6 +162,8 @@ def extract_proposals(
         for k in np.nonzero(has_disk)[0]
     }
 
+    intra_data = np.where(disk_changed, disk_bytes[rows], 0.0).sum(1)
+
     # the values tuple below is hand-ordered to match — this assert makes a
     # field reorder/insert in ExecutionProposal fail loudly here instead of
     # silently scrambling every proposal
@@ -167,22 +171,23 @@ def extract_proposals(
     assert fields == (
         "partition", "topic", "old_leader", "new_leader",
         "old_replicas", "new_replicas", "disk_moves", "inter_broker_data_to_move",
+        "intra_broker_data_to_move",
     ), fields
     new = ExecutionProposal.__new__
     cls = ExecutionProposal
     proposals: list[ExecutionProposal] = []
     append = proposals.append
     empty: tuple = ()
-    for k, (p, t, olr, nlr, obk, nbk, nv, dt) in enumerate(zip(
+    for k, (p, t, olr, nlr, obk, nbk, nv, dt, idt) in enumerate(zip(
         touched.tolist(), t_topic.tolist(), old_leader.tolist(),
-        new_leader.tolist(), ob, nb, n_valid, data.tolist(),
+        new_leader.tolist(), ob, nb, n_valid, data.tolist(), intra_data.tolist(),
     )):
         o = new(cls)
         # frozen dataclass: populate __dict__ directly — object.__setattr__
         # per field costs ~4x as much across ~100k proposals
         o.__dict__.update(zip(fields, (
             p, t, olr, nlr, tuple(obk[:nv]), tuple(nbk[:nv]),
-            disk_rows.get(k, empty), dt,
+            disk_rows.get(k, empty), dt, idt,
         )))
         append(o)
     return proposals

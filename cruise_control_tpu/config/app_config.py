@@ -248,6 +248,9 @@ def _executor_defs() -> ConfigDef:
     d.define("inter.broker.replica.movement.rate.alerting.threshold", T.DOUBLE,
              0.1, I.LOW, "MB/s floor; slower long-running inter-broker moves "
              "alert (reference ExecutorConfig:142)", in_range(lo=0.0), group=g)
+    d.define("intra.broker.replica.movement.rate.alerting.threshold", T.DOUBLE,
+             0.2, I.LOW, "MB/s floor for intra-broker (logdir) copies "
+             "(reference ExecutorConfig:153)", in_range(lo=0.0), group=g)
     d.define("executor.notifier.class", T.CLASS, None, I.LOW,
              "object notified after every execution finishes; called with "
              "no args, must expose on_execution_finished(result, uuid) "
@@ -344,6 +347,18 @@ def _anomaly_defs() -> ConfigDef:
              "custom topic-anomaly finder; called with (topology_provider, "
              "config), must expose detect() -> Anomaly | None; unset uses "
              "the built-in TopicReplicationFactorAnomalyFinder", group=g)
+    d.define("partition.size.detection.enabled", T.BOOLEAN, False, I.LOW,
+             "also run the PartitionSizeAnomalyFinder each topic-anomaly "
+             "round (reference detector/PartitionSizeAnomalyFinder.java)",
+             group=g)
+    d.define("self.healing.partition.size.threshold.byte", T.LONG,
+             500 * 1024 * 1024, I.LOW,
+             "partitions larger than this are anomalous "
+             "(reference PartitionSizeAnomalyFinder:49-50)",
+             in_range(lo=1), group=g)
+    d.define("topic.excluded.from.partition.size.check", T.STRING, "", I.LOW,
+             "regex of topics the size check ignores "
+             "(reference PartitionSizeAnomalyFinder:51)", group=g)
     # Slack alerting (reference detector/notifier/SlackSelfHealingNotifier.java)
     d.define("slack.self.healing.notifier.webhook", T.STRING, None, I.LOW,
              "Slack incoming-webhook URL; enables the Slack notifier", group=g)

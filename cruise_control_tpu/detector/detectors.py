@@ -312,10 +312,20 @@ class PartitionSizeAnomalyFinder:
         model_provider: Callable[[], ClusterState],
         catalog_provider: Callable[[], object],
         max_partition_size: float = 1e6,
+        excluded_topics_pattern: str = "",
     ):
+        """max_partition_size (reference
+        self.healing.partition.size.threshold.byte, default 500MiB);
+        excluded_topics_pattern (reference
+        topic.excluded.from.partition.size.check)."""
+        import re
+
         self.model_provider = model_provider
         self.catalog_provider = catalog_provider
         self.max_partition_size = max_partition_size
+        self._excluded = (
+            re.compile(excluded_topics_pattern) if excluded_topics_pattern else None
+        )
 
     def detect(self) -> TopicPartitionSizeAnomaly | None:
         state = self.model_provider()
@@ -326,6 +336,8 @@ class PartitionSizeAnomalyFinder:
         oversized: dict[tuple[str, int], float] = {}
         for r in np.nonzero(lead & (sizes > self.max_partition_size))[0]:
             key = catalog.partition_key(int(parts[r])) if catalog else ("?", int(parts[r]))
+            if self._excluded is not None and self._excluded.fullmatch(key[0]):
+                continue
             oversized[key] = float(sizes[r])
         if not oversized:
             return None

@@ -57,6 +57,11 @@ class ClusterAdmin(Protocol):
         """(topic, partition, broker, target_disk) intra-broker moves."""
         ...
 
+    def in_progress_logdir_moves(self) -> set[tuple[str, int, int]]:
+        """(topic, partition, broker) intra-broker copies still in flight
+        (reference ExecutorAdminUtils DescribeLogDirs future replicas)."""
+        ...
+
     def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
         ...
 
@@ -84,9 +89,14 @@ class SimulatedClusterAdmin:
         link_rate_bytes_per_s: float = 50_000.0,
         fail_partitions: set[tuple[str, int]] | None = None,
         drop_partitions: set[tuple[str, int]] | None = None,
+        intra_move_bytes: float = 0.0,
     ):
         self.metadata = metadata
         self.link_rate = link_rate_bytes_per_s
+        #: bytes each simulated intra-broker (logdir) copy takes; 0 means
+        #: moves land instantly
+        self.intra_move_bytes = intra_move_bytes
+        self._intra_inflight: dict[tuple[str, int, int], float] = {}
         self.throttle_rate: float | None = None
         self.throttled_topics: set[str] = set()
         self._inflight: dict[tuple[str, int], _Inflight] = {}
@@ -131,7 +141,15 @@ class SimulatedClusterAdmin:
         self.metadata.set_topology(dataclasses.replace(topo, partitions=tuple(parts)))
 
     def alter_replica_logdirs(self, moves) -> None:
-        pass  # logdir placement is not modeled in the simulated topology
+        # logdir placement is not modeled in the simulated topology, but
+        # move DURATION is: each (t, p, broker) copy drains intra_move_bytes
+        # at the link rate via tick() (0 bytes -> instant, the default)
+        for topic, part, broker, _disk in moves:
+            if self.intra_move_bytes > 0:
+                self._intra_inflight[(topic, part, broker)] = self.intra_move_bytes
+
+    def in_progress_logdir_moves(self) -> set[tuple[str, int, int]]:
+        return set(self._intra_inflight)
 
     def set_replication_throttle(self, rate: float, topics: set[str]) -> None:
         self.throttle_rate = rate
@@ -165,6 +183,10 @@ class SimulatedClusterAdmin:
                 self._apply(fl.spec)
                 del self._inflight[key]
                 done.append(key)
+        for key3 in list(self._intra_inflight):
+            self._intra_inflight[key3] -= rate * seconds
+            if self._intra_inflight[key3] <= 0:
+                del self._intra_inflight[key3]
         return done
 
     def _apply(self, spec: ReassignmentSpec):

@@ -66,13 +66,12 @@ class ExecutionOptions:
     #: a leadership move the topology has not confirmed within this window
     #: is declared DEAD (reference ExecutorConfig leader.movement.timeout.ms)
     leader_movement_timeout_s: float = 180.0
-    #: MB/s floor for the slow-task alert: an inter-broker replica move
-    #: alerts when its execution time exceeds task_execution_alerting_s AND
-    #: its data rate is below this (reference ExecutorConfig
-    #: inter.broker.replica.movement.rate.alerting.threshold).  There is no
-    #: intra-broker analog: intra moves are submitted and confirmed within
-    #: one tick here, so no long-running intra task exists to rate-alert.
+    #: MB/s floors for the slow-task alert: a replica move alerts when its
+    #: execution time exceeds task_execution_alerting_s AND its data rate is
+    #: below this (reference ExecutorConfig
+    #: {inter,intra}.broker.replica.movement.rate.alerting.threshold)
     inter_broker_rate_alerting_mb_s: float = 0.1
+    intra_broker_rate_alerting_mb_s: float = 0.2
     replication_throttle_bytes_per_s: float | None = None
     progress_check_interval_s: float = 0.5
     #: tasks in progress longer than this raise an alert flag
@@ -256,14 +255,41 @@ class Executor:
 
     # ------------------------------------------------------------------
 
+    def _maybe_alert_slow_task(self, task, data_bytes, floor_mb_s, options, now):
+        """Reference slow-task alerting (ExecutorConfig:142-158): alert once
+        when a move runs past task.execution.alerting.threshold.ms AND its
+        data rate (bytes -> MB/s) is under the configured floor."""
+        if task.alert_time_ms >= 0:
+            return
+        elapsed_ms = now - task.start_time_ms
+        if elapsed_ms <= options.task_execution_alerting_s * 1000:
+            return
+        if data_bytes / 1e6 / max(elapsed_ms / 1000.0, 1e-9) >= floor_mb_s:
+            return
+        task.alert_time_ms = now
+        self.sensors.counter("executor.slow-task-alert").inc()
+        if self.notifier is not None and hasattr(self.notifier, "on_task_alert"):
+            try:
+                self.notifier.on_task_alert(task)
+            except Exception:  # noqa: BLE001 — a broken notifier must not fail the execution
+                pass
+
     def _run(self, options: ExecutionOptions) -> ExecutionResult:
         """The proposal execution loop (reference ProposalExecutionRunnable.run:749):
         phase 1 — inter/intra-broker replica moves; phase 2 — leadership."""
         planner = self._planner
         assert planner is not None
         in_flight: dict[tuple[str, int], ExecutionTask] = {}
+        #: intra-broker tasks still copying between logdirs:
+        #: execution id -> (task, {(topic, partition, broker): target disk})
+        intra_in_flight: dict[
+            int, tuple[ExecutionTask, dict[tuple[str, int, int], int]]
+        ] = {}
         ticks = 0
         simulated = hasattr(self.admin, "tick")
+        # admins that cannot report logdir-copy progress complete intra
+        # moves on submit (the pre-KIP-113 behavior)
+        track_intra = hasattr(self.admin, "in_progress_logdir_moves")
 
         def now_ms() -> int:
             return int(time.time() * 1000) if not simulated else ticks * 1000
@@ -273,6 +299,14 @@ class Executor:
         while ticks < options.max_ticks:
             if self._stop_requested:
                 self._handle_stop(in_flight, now_ms())
+                if self._force_stop:
+                    # logdir copies cannot be cancelled over the wire; the
+                    # tasks are recorded aborted (reference behavior: an
+                    # intra move is 'cancelled' by moving back later)
+                    for t, _keys in intra_in_flight.values():
+                        t.aborting(now_ms())
+                        t.aborted(now_ms())
+                    intra_in_flight.clear()
                 break
             # collect completions.  A key leaving the in-progress set does
             # NOT prove the move landed: the controller may have dropped the
@@ -312,27 +346,14 @@ class Executor:
                             data_to_move=task.proposal.inter_broker_data_to_move,
                         )
                     ])
-                elif (
-                    task.alert_time_ms < 0
-                    and now_ms() - task.start_time_ms
-                    > options.task_execution_alerting_s * 1000
-                    # reference alerts only when the task is ALSO moving
-                    # slower than the rate floor (ExecutorConfig:142-158);
-                    # data_to_move is BYTES, the threshold is MB/s
-                    and task.proposal.inter_broker_data_to_move
-                    / 1e6
-                    / max((now_ms() - task.start_time_ms) / 1000.0, 1e-9)
-                    < options.inter_broker_rate_alerting_mb_s
-                ):
-                    task.alert_time_ms = now_ms()
-                    self.sensors.counter("executor.slow-task-alert").inc()
-                    if self.notifier is not None and hasattr(
-                        self.notifier, "on_task_alert"
-                    ):
-                        try:
-                            self.notifier.on_task_alert(task)
-                        except Exception:  # noqa: BLE001
-                            pass
+                else:
+                    self._maybe_alert_slow_task(
+                        task,
+                        task.proposal.inter_broker_data_to_move,
+                        options.inter_broker_rate_alerting_mb_s,
+                        options,
+                        now_ms(),
+                    )
             # mark tasks dead when a destination broker died mid-move
             alive = topo.alive_broker_ids()
             for key, task in list(in_flight.items()):
@@ -343,7 +364,12 @@ class Executor:
             # drain new tasks within caps (per-broker AND the global
             # max.num.cluster.movements budget)
             ready = self._ready_brokers(options, in_flight, topo)
-            budget = max(0, options.max_num_cluster_movements - len(in_flight))
+            budget = max(
+                0,
+                options.max_num_cluster_movements
+                - len(in_flight)
+                - len(intra_in_flight),
+            )
             new_tasks = planner.get_inter_broker_replica_movement_tasks(
                 ready, set(in_flight), max_total=budget
             )
@@ -380,10 +406,66 @@ class Executor:
                         for (b, _d_old, d_new) in t.proposal.disk_moves
                     ]
                 )
-                t.completed(now_ms())
+                if track_intra:
+                    intra_in_flight[t.execution_id] = (t, {
+                        (tname, pnum, b): d_new
+                        for (b, _d_old, d_new) in t.proposal.disk_moves
+                    })
+                else:
+                    t.completed(now_ms())
+            # intra-broker copy progress (reference ExecutorAdminUtils
+            # DescribeLogDirs future replicas): a task completes when none
+            # of its (t, p, broker) copies are still in flight; long slow
+            # copies alert like inter-broker moves
+            if intra_in_flight:
+                still = self.admin.in_progress_logdir_moves()
+                verify = getattr(self.admin, "logdir_of", None)
+                for eid, (t, keys) in list(intra_in_flight.items()):
+                    pending = {}
+                    for key3, disk in keys.items():
+                        if key3 in still:
+                            pending[key3] = disk
+                            continue
+                        if verify is None:
+                            continue  # cannot verify: disappearance = done
+                        # disappearance does NOT prove the copy landed (a
+                        # broker restart aborts the future log) — check the
+                        # replica's actual dir, like the inter-broker path
+                        # re-verifies against the topology
+                        actual = verify(*key3)
+                        if actual == disk:
+                            continue
+                        if actual is None:
+                            pending[key3] = disk  # unreachable: keep polling
+                            continue
+                        n = self._reexecutions.get(key3, 0)
+                        if n >= options.max_reexecution_attempts:
+                            t.kill(now_ms())
+                            del intra_in_flight[eid]
+                            pending = None
+                            break
+                        self._reexecutions[key3] = n + 1
+                        self.sensors.counter("executor.task-reexecuted").inc()
+                        self.admin.alter_replica_logdirs([(*key3, disk)])
+                        pending[key3] = disk
+                    if pending is None:
+                        continue
+                    if not pending:
+                        t.completed(now_ms())
+                        del intra_in_flight[eid]
+                        continue
+                    intra_in_flight[eid] = (t, pending)
+                    self._maybe_alert_slow_task(
+                        t,
+                        t.proposal.intra_broker_data_to_move,
+                        options.intra_broker_rate_alerting_mb_s,
+                        options,
+                        now_ms(),
+                    )
 
             if (
                 not in_flight
+                and not intra_in_flight
                 and not planner.remaining_inter_broker_moves
                 and not planner.remaining_intra_broker_moves
             ):

@@ -54,6 +54,13 @@ class KafkaClusterAdmin:
         self.client = client
         self._throttled_brokers: set[int] = set()
         self._throttled_topics: set[str] = set()
+        #: brokers with possibly in-flight AlterReplicaLogDirs copies —
+        #: bounds the DescribeLogDirs polling set
+        self._logdir_move_brokers: set[int] = set()
+        #: last successfully observed future-replica set per broker — a
+        #: transient DescribeLogDirs failure must NOT look like "no copies
+        #: pending" (the executor treats absence as completion)
+        self._last_futures: dict[int, set[tuple[str, int, int]]] = {}
 
     # --- ClusterAdmin SPI ---
 
@@ -149,6 +156,47 @@ class KafkaClusterAdmin:
                     "AlterReplicaLogDirs", errors[0][2],
                     f"{len(errors)} moves rejected on broker {broker}",
                 )
+            self._logdir_move_brokers.add(broker)
+
+    def in_progress_logdir_moves(self) -> set[tuple[str, int, int]]:
+        """(topic, partition, broker) triples whose intra-broker copy is
+        still in flight — DescribeLogDirs reports the copying replica under
+        the target dir with is_future_key=true (reference ExecutorAdminUtils
+        polls log dirs to track AlterReplicaLogDirs completion)."""
+        out: set[tuple[str, int, int]] = set()
+        for broker in sorted(self._logdir_move_brokers):
+            try:
+                dirs = self.client.describe_logdirs(broker)
+            except (OSError, ConnectionError):
+                # unreachable broker: report its LAST KNOWN pending copies
+                # as still pending — absence here means completion to the
+                # executor, and a socket timeout is not completion
+                out |= self._last_futures.get(broker, set())
+                continue
+            futures = {
+                (t, p, broker)
+                for info in dirs.values()
+                for t, p in info.get("future_replicas", ())
+            }
+            self._last_futures[broker] = futures
+            out |= futures
+            if not futures:
+                self._logdir_move_brokers.discard(broker)
+                self._last_futures.pop(broker, None)
+        return out
+
+    def logdir_of(self, topic: str, partition: int, broker: int) -> int | None:
+        """Dense disk index currently hosting (topic, partition) on broker,
+        or None if unknown — the executor verifies a finished
+        AlterReplicaLogDirs actually LANDED on the target dir."""
+        try:
+            dirs = self.client.describe_logdirs(broker)
+        except (OSError, ConnectionError):
+            return None
+        for i, path in enumerate(sorted(dirs)):
+            if (topic, partition) in dirs[path]["replicas"]:
+                return i
+        return None
 
     def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
         """Reference ReplicationThrottleHelper.java:32-47: per-broker rates +

@@ -438,14 +438,28 @@ class KafkaAdminClient:
         ]
 
     def describe_logdirs(self, node_id: int) -> dict[str, dict]:
-        """node's logdirs: path -> {"error_code", "replicas": {(t, p): size}}."""
+        """node's logdirs: path -> {"error_code", "replicas": {(t, p): size},
+        "future_replicas": {(t, p)}}.
+
+        future_replicas are in-flight AlterReplicaLogDirs targets
+        (is_future_key=true): the partition is still copying into this dir
+        (reference ExecutorAdminUtils polls these to track intra-broker
+        move completion)."""
         resp = self.broker_request(node_id, proto.DESCRIBE_LOG_DIRS, {"topics": None})
         out: dict[str, dict] = {}
         for r in resp["results"] or []:
-            replicas = {
-                (t["name"], p["partition_index"]): p["partition_size"]
-                for t in r["topics"] or []
-                for p in t["partitions"] or []
+            replicas = {}
+            future = set()
+            for t in r["topics"] or []:
+                for p in t["partitions"] or []:
+                    key = (t["name"], p["partition_index"])
+                    if p.get("is_future_key"):
+                        future.add(key)
+                    else:
+                        replicas[key] = p["partition_size"]
+            out[r["log_dir"]] = {
+                "error_code": r["error_code"],
+                "replicas": replicas,
+                "future_replicas": future,
             }
-            out[r["log_dir"]] = {"error_code": r["error_code"], "replicas": replicas}
         return out

@@ -304,6 +304,24 @@ class CruiseControl:
         )
         reg(dfd.detect, interval_s=_interval("disk.failure.detection.interval.ms"))
         reg(rfd.detect, interval_s=_interval("topic.anomaly.detection.interval.ms"))
+        if self.config.get("partition.size.detection.enabled"):
+            from cruise_control_tpu.detector.detectors import (
+                PartitionSizeAnomalyFinder,
+            )
+
+            psf = PartitionSizeAnomalyFinder(
+                lambda: self.monitor.cluster_model(
+                    req, allow_capacity_estimation=allow_est
+                ),
+                lambda: self.monitor.last_catalog,
+                max_partition_size=self.config.get(
+                    "self.healing.partition.size.threshold.byte"
+                ),
+                excluded_topics_pattern=self.config.get(
+                    "topic.excluded.from.partition.size.check"
+                ),
+            )
+            reg(psf.detect, interval_s=_interval("topic.anomaly.detection.interval.ms"))
         reg(slow_detect, interval_s=_interval("metric.anomaly.detection.interval.ms"))
 
     # ------------------------------------------------------------------
@@ -491,6 +509,9 @@ class CruiseControl:
             / 1000.0,
             inter_broker_rate_alerting_mb_s=self.config.get(
                 "inter.broker.replica.movement.rate.alerting.threshold"
+            ),
+            intra_broker_rate_alerting_mb_s=self.config.get(
+                "intra.broker.replica.movement.rate.alerting.threshold"
             ),
             replication_throttle_bytes_per_s=_ov(
                 "replication_throttle", "default.replication.throttle"

@@ -258,13 +258,18 @@ class CruiseControlApp:
         self.ui_diskpath = cc.config.get("webserver.ui.diskpath")
         self.ui_prefix = (cc.config.get("webserver.ui.urlprefix") or "/ui").rstrip("/")
         # API routes are dispatched before the UI, so a UI prefix can never
-        # shadow them; only a root prefix (no path component at all) is
-        # rejected as almost certainly a misconfiguration
-        if self.ui_diskpath and not self.ui_prefix:
-            raise ValueError(
-                "webserver.ui.urlprefix must be a non-root prefix, got "
-                f"{cc.config.get('webserver.ui.urlprefix')!r}"
-            )
+        # shadow them — which also means a UI prefix NESTED UNDER the API
+        # prefix would be silently unreachable; both misconfigurations fail
+        # loudly at startup instead
+        if self.ui_diskpath:
+            api = self.cc.config.get("webserver.api.urlprefix").rstrip("/")
+            nested = self.ui_prefix == api or self.ui_prefix.startswith(api + "/")
+            if not self.ui_prefix or nested:
+                raise ValueError(
+                    "webserver.ui.urlprefix must be a non-root prefix outside "
+                    f"the API prefix {api!r}, got "
+                    f"{cc.config.get('webserver.ui.urlprefix')!r}"
+                )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
         self.param_parsers, self.request_handlers = build_override_maps(cc.config)

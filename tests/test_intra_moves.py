@@ -1,0 +1,224 @@
+"""Intra-broker (logdir) move completion tracking + partition-size finder.
+
+Reference: Executor.java:1036 intraBrokerMoveReplicas waits for
+AlterReplicaLogDirs copies via DescribeLogDirs future replicas
+(ExecutorAdminUtils); detector/PartitionSizeAnomalyFinder.java.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+from cruise_control_tpu.executor.executor import ExecutionOptions, Executor
+from cruise_control_tpu.executor.tasks import TaskState, TaskType
+from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+
+def _intra_proposal(topo, data=1000.0):
+    p0 = topo.partitions[0]
+    return ExecutionProposal(
+        topic=p0.topic, partition=p0.partition, old_leader=p0.leader,
+        new_leader=p0.leader, old_replicas=tuple(p0.replicas),
+        new_replicas=tuple(p0.replicas),
+        disk_moves=((p0.replicas[0], 0, 1),),
+        intra_broker_data_to_move=data,
+    )
+
+
+def test_intra_move_completes_only_when_copy_lands():
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=250.0,  # needs ~2.5 simulated seconds
+    )
+    ex = Executor(admin, topic_names={0: "T0"})
+    res = ex.execute_proposals(
+        [_intra_proposal(topo)], ExecutionOptions(progress_check_interval_s=1.0)
+    )
+    assert res.completed == 1
+    # the copy took multiple ticks — it was NOT completed on submit
+    assert res.ticks >= 2
+    task = ex.tracker.tasks(state=TaskState.COMPLETED)[0]
+    assert task.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION
+
+
+def test_intra_move_instant_when_admin_cannot_track():
+    """Admins without logdir-progress reporting keep the submit-completes
+    behavior (pre-KIP-113)."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(StaticMetadataProvider(topo))
+
+    class NoTrackAdmin:
+        def __getattr__(self, name):
+            if name == "in_progress_logdir_moves":
+                raise AttributeError(name)
+            return getattr(admin, name)
+
+    ex = Executor(NoTrackAdmin(), topic_names={0: "T0"})
+    res = ex.execute_proposals(
+        [_intra_proposal(topo)], ExecutionOptions(progress_check_interval_s=1.0)
+    )
+    assert res.completed == 1
+
+
+def test_slow_intra_copy_alerts():
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=1.0,
+        intra_move_bytes=50.0,  # 50 simulated seconds at 1 B/s
+    )
+    alerts = []
+
+    class Notifier:
+        def on_execution_finished(self, result, uuid):
+            pass
+
+        def on_task_alert(self, task):
+            alerts.append(task.task_type)
+
+    ex = Executor(admin, topic_names={0: "T0"}, notifier=Notifier())
+    res = ex.execute_proposals(
+        [_intra_proposal(topo, data=1000.0)],
+        ExecutionOptions(progress_check_interval_s=1.0, task_execution_alerting_s=2.0),
+    )
+    assert res.completed == 1
+    assert TaskType.INTRA_BROKER_REPLICA_ACTION in alerts
+
+
+def test_transient_describe_failure_keeps_copies_pending():
+    """A DescribeLogDirs timeout must not read as 'no copies pending' —
+    the executor treats absence as completion (kafka/admin.py
+    in_progress_logdir_moves last-known fallback)."""
+    from cruise_control_tpu.kafka.admin import KafkaClusterAdmin
+
+    class FlakyClient:
+        def __init__(self):
+            self.fail_next = False
+            self.dirs = {
+                "/d0": {"error_code": 0, "replicas": {}, "future_replicas": {("T0", 0)}},
+                "/d1": {"error_code": 0, "replicas": {("T0", 0): 10}, "future_replicas": set()},
+            }
+
+        def describe_logdirs(self, node_id):
+            if self.fail_next:
+                self.fail_next = False
+                raise OSError("socket timeout")
+            return self.dirs
+
+    admin = KafkaClusterAdmin.__new__(KafkaClusterAdmin)
+    admin.client = FlakyClient()
+    admin._throttled_brokers = set()
+    admin._throttled_topics = set()
+    admin._logdir_move_brokers = {3}
+    admin._last_futures = {}
+
+    assert admin.in_progress_logdir_moves() == {("T0", 0, 3)}
+    # transient failure: last-known pending set still reported
+    admin.client.fail_next = True
+    assert admin.in_progress_logdir_moves() == {("T0", 0, 3)}
+    # copy finishes: broker drops out of the polling set
+    admin.client.dirs["/d0"]["future_replicas"] = set()
+    assert admin.in_progress_logdir_moves() == set()
+    assert admin._logdir_move_brokers == set()
+    # landed-verification: the replica reports under dense dir index 1
+    assert admin.logdir_of("T0", 0, 3) == 1
+
+
+def test_vanished_copy_without_landing_is_reexecuted():
+    """A copy that disappears from the future set WITHOUT landing on the
+    target dir is re-submitted (broker restart aborts the future log),
+    mirroring the inter-broker landed-check."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=150.0,
+    )
+    drops = {"n": 0}
+    resubmits = []
+    orig_alter = admin.alter_replica_logdirs
+
+    def dropping_alter(moves):
+        resubmits.append(list(moves))
+        orig_alter(moves)
+
+    admin.alter_replica_logdirs = dropping_alter
+    # logdir_of: first query reports the OLD dir (copy aborted), later the
+    # target — simulates a broker restart aborting the first attempt
+    def logdir_of(topic, partition, broker):
+        drops["n"] += 1
+        return 0 if drops["n"] == 1 else 1
+
+    admin.logdir_of = logdir_of
+    orig_tick = admin.tick
+
+    def tick_dropping_first(seconds):
+        # abort the first copy attempt mid-flight once
+        if drops["n"] == 0 and admin._intra_inflight:
+            admin._intra_inflight.clear()
+        return orig_tick(seconds)
+
+    admin.tick = tick_dropping_first
+    ex = Executor(admin, topic_names={0: "T0"})
+    res = ex.execute_proposals(
+        [_intra_proposal(topo)], ExecutionOptions(progress_check_interval_s=1.0)
+    )
+    assert res.completed == 1
+    assert len(resubmits) >= 2, "aborted copy must be re-submitted"
+    assert ex.executor_state()["numReexecutedTasks"] >= 1
+
+
+def test_partition_size_finder_wired_and_excludable():
+    from cruise_control_tpu.detector.detectors import PartitionSizeAnomalyFinder
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster
+
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=5, num_partitions=50, num_topics=2), seed=2
+    )
+
+    class Catalog:
+        def partition_key(self, pid):
+            return ("T0" if pid % 2 == 0 else "T1", pid)
+
+    sizes = np.asarray(state.replica_load_leader)[:, 3]
+    lead = np.asarray(state.replica_is_leader) & np.asarray(state.replica_valid)
+    threshold = float(np.percentile(sizes[lead], 50))
+    finder = PartitionSizeAnomalyFinder(
+        lambda: state, Catalog, max_partition_size=threshold
+    )
+    finder.catalog_provider = lambda: Catalog()
+    anomaly = finder.detect()
+    assert anomaly is not None and anomaly.oversized
+    # excluding every topic silences it
+    silent = PartitionSizeAnomalyFinder(
+        lambda: state, lambda: Catalog(), max_partition_size=threshold,
+        excluded_topics_pattern="T.*",
+    )
+    assert silent.detect() is None
+
+
+def test_partition_size_detection_enabled_via_config():
+    from cruise_control_tpu.config import CruiseControlConfig
+    from cruise_control_tpu.service.main import build_simulated_service
+
+    config = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "min.samples.per.partition.metrics.window": 1,
+        "webserver.http.port": 0,
+        "tpu.num.candidates": 128,
+        "tpu.leadership.candidates": 32,
+        "tpu.steps.per.round": 8,
+        "tpu.num.rounds": 2,
+        "partition.size.detection.enabled": "true",
+        "self.healing.partition.size.threshold.byte": "1",  # everything flags
+    })
+    app, *_ = build_simulated_service(config, seed=5)
+    records = app.cc.anomaly_detector.run_once()
+    kinds = {type(r.anomaly).__name__ for r in records}
+    assert "TopicPartitionSizeAnomaly" in kinds
