@@ -86,6 +86,9 @@ class ExecutionOptions:
     #: unplaced for a few ticks must not be DEAD-marked — 64 ticks at the
     #: 0.5s default interval tolerates ~30s of metadata staleness)
     max_reexecution_attempts: int = 64
+    #: consecutive ticks a finished-looking logdir copy may stay
+    #: UNVERIFIABLE (unreachable broker) before its task is declared DEAD
+    max_intra_verify_failures: int = 8
     max_ticks: int = 10_000  # simulation safety bound
 
 
@@ -147,6 +150,8 @@ class Executor:
         self._uuid: str | None = None
         #: re-submission count per dropped reassignment key
         self._reexecutions: dict[tuple[str, int], int] = {}
+        #: consecutive unverifiable-completion count per logdir-copy key
+        self._intra_unknown: dict[tuple[str, int, int], int] = {}
 
     # ------------------------------------------------------------------
 
@@ -230,6 +235,7 @@ class Executor:
                 self._demoted_history[b] = now
             self.tracker = ExecutionTaskTracker()
             self._reexecutions = {}
+            self._intra_unknown = {}
             self._planner = ExecutionTaskPlanner(strategy or self.strategy)
             tasks = self._planner.add_execution_proposals(proposals, strategy_context)
             for t in tasks:
@@ -360,6 +366,13 @@ class Executor:
                 if not set(task.proposal.new_replicas) <= alive:
                     task.kill(now_ms())
                     del in_flight[key]
+            # same sweep for logdir copies: a copy on a dead broker can
+            # never confirm — without this the phase-1 loop would spin on
+            # it until max_ticks
+            for eid, (t, keys) in list(intra_in_flight.items()):
+                if any(b not in alive for (_tn, _pn, b) in keys):
+                    t.kill(now_ms())
+                    del intra_in_flight[eid]
 
             # drain new tasks within caps (per-broker AND the global
             # max.num.cluster.movements budget)
@@ -434,9 +447,20 @@ class Executor:
                         # re-verifies against the topology
                         actual = verify(*key3)
                         if actual == disk:
+                            self._intra_unknown.pop(key3, None)
                             continue
                         if actual is None:
-                            pending[key3] = disk  # unreachable: keep polling
+                            # unverifiable (e.g. broker unreachable): keep
+                            # polling, but bounded — a partitioned broker
+                            # must not hold the loop open until max_ticks
+                            u = self._intra_unknown.get(key3, 0) + 1
+                            self._intra_unknown[key3] = u
+                            if u > options.max_intra_verify_failures:
+                                t.kill(now_ms())
+                                del intra_in_flight[eid]
+                                pending = None
+                                break
+                            pending[key3] = disk
                             continue
                         n = self._reexecutions.get(key3, 0)
                         if n >= options.max_reexecution_attempts:
@@ -446,7 +470,12 @@ class Executor:
                             break
                         self._reexecutions[key3] = n + 1
                         self.sensors.counter("executor.task-reexecuted").inc()
-                        self.admin.alter_replica_logdirs([(*key3, disk)])
+                        try:
+                            self.admin.alter_replica_logdirs([(*key3, disk)])
+                        except Exception:  # noqa: BLE001 — a failed resubmit
+                            # must not abort the whole execution; the copy
+                            # stays pending and the bounds above decide
+                            pass
                         pending[key3] = disk
                     if pending is None:
                         continue

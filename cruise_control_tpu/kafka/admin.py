@@ -61,6 +61,16 @@ class KafkaClusterAdmin:
         #: transient DescribeLogDirs failure must NOT look like "no copies
         #: pending" (the executor treats absence as completion)
         self._last_futures: dict[int, set[tuple[str, int, int]]] = {}
+        #: consecutive DescribeLogDirs failures per broker; past the cap the
+        #: broker is evicted from polling (a dead broker must not cost a
+        #: full socket timeout on every progress tick forever — the
+        #: executor's dead-broker sweep owns its tasks' fate)
+        self._describe_failures: dict[int, int] = {}
+        self._max_describe_failures = 5
+        #: replica -> dense dir index placement from the poll's describes,
+        #: so landed-verification is cache-served instead of one RPC per
+        #: verified partition
+        self._last_placement: dict[tuple[str, int, int], int] = {}
 
     # --- ClusterAdmin SPI ---
 
@@ -157,6 +167,18 @@ class KafkaClusterAdmin:
                     f"{len(errors)} moves rejected on broker {broker}",
                 )
             self._logdir_move_brokers.add(broker)
+            # the submitted copies ARE pending until a describe says
+            # otherwise: seed the last-known set so a transient describe
+            # failure right after submit cannot read as "nothing pending",
+            # drop any stale placement for the moved replicas, and give the
+            # broker a fresh failure budget
+            keys = {
+                (t, p, broker) for tps in dir_moves.values() for (t, p) in tps
+            }
+            self._last_futures.setdefault(broker, set()).update(keys)
+            for key in keys:
+                self._last_placement.pop(key, None)
+            self._describe_failures.pop(broker, None)
 
     def in_progress_logdir_moves(self) -> set[tuple[str, int, int]]:
         """(topic, partition, broker) triples whose intra-broker copy is
@@ -164,20 +186,36 @@ class KafkaClusterAdmin:
         the target dir with is_future_key=true (reference ExecutorAdminUtils
         polls log dirs to track AlterReplicaLogDirs completion)."""
         out: set[tuple[str, int, int]] = set()
+        # placement cache is scoped to ONE poll round: verification reads
+        # what this round's describes observed, never an older execution's
+        # stale placements (and the dict stays bounded)
+        self._last_placement.clear()
         for broker in sorted(self._logdir_move_brokers):
             try:
                 dirs = self.client.describe_logdirs(broker)
             except (OSError, ConnectionError):
-                # unreachable broker: report its LAST KNOWN pending copies
-                # as still pending — absence here means completion to the
-                # executor, and a socket timeout is not completion
+                n = self._describe_failures.get(broker, 0) + 1
+                self._describe_failures[broker] = n
+                if n > self._max_describe_failures:
+                    # persistently unreachable (likely dead/decommissioned):
+                    # stop paying a socket timeout every progress tick; the
+                    # executor's dead-broker sweep decides its tasks' fate
+                    self._logdir_move_brokers.discard(broker)
+                    self._last_futures.pop(broker, None)
+                    continue
+                # transient: report the LAST KNOWN pending copies as still
+                # pending — absence here means completion to the executor,
+                # and a socket timeout is not completion
                 out |= self._last_futures.get(broker, set())
                 continue
-            futures = {
-                (t, p, broker)
-                for info in dirs.values()
-                for t, p in info.get("future_replicas", ())
-            }
+            self._describe_failures.pop(broker, None)
+            futures = set()
+            for i, path in enumerate(sorted(dirs)):
+                info = dirs[path]
+                for t, p in info.get("future_replicas", ()):
+                    futures.add((t, p, broker))
+                for (t, p) in info.get("replicas", {}):
+                    self._last_placement[(t, p, broker)] = i
             self._last_futures[broker] = futures
             out |= futures
             if not futures:
@@ -188,15 +226,32 @@ class KafkaClusterAdmin:
     def logdir_of(self, topic: str, partition: int, broker: int) -> int | None:
         """Dense disk index currently hosting (topic, partition) on broker,
         or None if unknown — the executor verifies a finished
-        AlterReplicaLogDirs actually LANDED on the target dir."""
+        AlterReplicaLogDirs actually LANDED on the target dir.
+
+        Served from the placement observed by the poll's own describes when
+        possible (a batch of completions would otherwise cost one full
+        DescribeLogDirs round trip per verified partition)."""
+        cached = self._last_placement.get((topic, partition, broker))
+        if cached is not None:
+            return cached
+        if self._describe_failures.get(broker, 0) > self._max_describe_failures:
+            # quarantined (persistently unreachable): answering "unknown"
+            # immediately avoids one socket timeout per verification
+            return None
         try:
             dirs = self.client.describe_logdirs(broker)
         except (OSError, ConnectionError):
+            self._describe_failures[broker] = (
+                self._describe_failures.get(broker, 0) + 1
+            )
             return None
+        out = None
         for i, path in enumerate(sorted(dirs)):
-            if (topic, partition) in dirs[path]["replicas"]:
-                return i
-        return None
+            for (t, p) in dirs[path]["replicas"]:
+                self._last_placement[(t, p, broker)] = i
+                if (t, p) == (topic, partition):
+                    out = i
+        return out
 
     def set_replication_throttle(self, rate_bytes_per_s: float, topics: set[str]) -> None:
         """Reference ReplicationThrottleHelper.java:32-47: per-broker rates +

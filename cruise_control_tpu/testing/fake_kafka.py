@@ -52,6 +52,13 @@ class FakeKafkaCluster:
         self.configs: dict[tuple[int, str], dict[str, str]] = {}
         #: logdir placement: broker -> path -> set[(topic, partition)]
         self.placement: dict[int, dict[str, set]] = {}
+        #: >0 makes AlterReplicaLogDirs copies GRADUAL: the replica shows as
+        #: a future replica under the target dir for this many
+        #: DescribeLogDirs polls before the move applies (models
+        #: KIP-113 async logdir copies)
+        self.intra_copy_polls = 0
+        #: broker -> {(topic, partition): [target path, polls left]}
+        self.future_replicas: dict[int, dict[tuple[str, int], list]] = {}
         self._auto_complete_after: int | None = None
         self._list_polls = 0
         #: data plane: (topic, partition) -> [batch bytes]; offsets assigned
@@ -385,6 +392,11 @@ class FakeKafkaCluster:
                     code = 0
                     if path not in dirs:
                         code = 57  # LOG_DIR_NOT_FOUND
+                    elif self.intra_copy_polls > 0:
+                        # async copy: future replica until polled down
+                        self.future_replicas.setdefault(node, {})[
+                            (t["name"], pidx)
+                        ] = [path, self.intra_copy_polls]
                     else:
                         for members in dirs.values():
                             members.discard((t["name"], pidx))
@@ -400,25 +412,37 @@ class FakeKafkaCluster:
         }
 
     def _h_DescribeLogDirs(self, node, body):  # noqa: N802
-        return {
-            "throttle_time_ms": 0,
-            "results": [
-                {
-                    "error_code": 0, "log_dir": path,
-                    "topics": [
-                        {
-                            "name": t,
-                            "partitions": [
-                                {"partition_index": pidx, "partition_size": 1024,
-                                 "offset_lag": 0, "is_future_key": False}
-                            ],
-                        }
-                        for (t, pidx) in sorted(members)
-                    ],
-                }
-                for path, members in sorted(self.placement[node].items())
-            ],
-        }
+        futures = self.future_replicas.get(node, {})
+        results = []
+        for path, members in sorted(self.placement[node].items()):
+            topics: dict[str, list[dict]] = {}
+            for t, pidx in sorted(members):
+                topics.setdefault(t, []).append(
+                    {"partition_index": pidx, "partition_size": 1024,
+                     "offset_lag": 0, "is_future_key": False}
+                )
+            for (t, pidx), (target, _polls) in sorted(futures.items()):
+                if target == path:
+                    topics.setdefault(t, []).append(
+                        {"partition_index": pidx, "partition_size": 512,
+                         "offset_lag": 512, "is_future_key": True}
+                    )
+            results.append({
+                "error_code": 0, "log_dir": path,
+                "topics": [
+                    {"name": t, "partitions": ps} for t, ps in sorted(topics.items())
+                ],
+            })
+        # advance the copies AFTER reporting: each poll is progress; a copy
+        # that reaches 0 lands on its target dir
+        for key, entry in list(futures.items()):
+            entry[1] -= 1
+            if entry[1] <= 0:
+                for members in self.placement[node].values():
+                    members.discard(key)
+                self.placement[node][entry[0]].add(key)
+                del futures[key]
+        return {"throttle_time_ms": 0, "results": results}
 
 
 class _BrokerListener(threading.Thread):

@@ -111,12 +111,8 @@ def test_transient_describe_failure_keeps_copies_pending():
                 raise OSError("socket timeout")
             return self.dirs
 
-    admin = KafkaClusterAdmin.__new__(KafkaClusterAdmin)
-    admin.client = FlakyClient()
-    admin._throttled_brokers = set()
-    admin._throttled_topics = set()
+    admin = KafkaClusterAdmin(FlakyClient())
     admin._logdir_move_brokers = {3}
-    admin._last_futures = {}
 
     assert admin.in_progress_logdir_moves() == {("T0", 0, 3)}
     # transient failure: last-known pending set still reported
@@ -128,6 +124,92 @@ def test_transient_describe_failure_keeps_copies_pending():
     assert admin._logdir_move_brokers == set()
     # landed-verification: the replica reports under dense dir index 1
     assert admin.logdir_of("T0", 0, 3) == 1
+
+
+def test_persistently_unreachable_broker_evicted_from_polling():
+    """Past the consecutive-failure cap the broker stops being dialed —
+    a dead broker must not cost a socket timeout per progress tick."""
+    from cruise_control_tpu.kafka.admin import KafkaClusterAdmin
+
+    class DeadClient:
+        calls = 0
+
+        def describe_logdirs(self, node_id):
+            DeadClient.calls += 1
+            raise OSError("unreachable")
+
+    admin = KafkaClusterAdmin(DeadClient())
+    admin._logdir_move_brokers = {7}
+    admin._last_futures = {7: {("T0", 0, 7)}}
+    for _ in range(admin._max_describe_failures):
+        assert admin.in_progress_logdir_moves() == {("T0", 0, 7)}
+    # cap exceeded: evicted, no more dials
+    assert admin.in_progress_logdir_moves() == set()
+    before = DeadClient.calls
+    admin.in_progress_logdir_moves()
+    assert DeadClient.calls == before
+
+
+def test_intra_copy_on_dead_broker_goes_dead():
+    """A logdir copy whose broker dies mid-copy is killed, not spun on
+    until max_ticks."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=1.0,
+        intra_move_bytes=1e9,  # would take forever
+    )
+    prop = _intra_proposal(topo)
+    dead_broker = prop.disk_moves[0][0]
+    orig_tick = admin.tick
+    ticks = {"n": 0}
+
+    def kill_broker(seconds):
+        ticks["n"] += 1
+        if ticks["n"] == 2:
+            t = admin.metadata.topology()
+            brokers = tuple(
+                dataclasses.replace(b, alive=(b.broker_id != dead_broker))
+                for b in t.brokers
+            )
+            admin.metadata.set_topology(dataclasses.replace(t, brokers=brokers))
+        return orig_tick(seconds)
+
+    admin.tick = kill_broker
+    ex = Executor(admin, topic_names={0: "T0"})
+    res = ex.execute_proposals(
+        [prop], ExecutionOptions(progress_check_interval_s=1.0)
+    )
+    assert res.dead == 1
+    assert res.ticks < 20
+
+
+def test_unverifiable_copy_bounded_then_dead():
+    """A copy that vanishes but can never be VERIFIED (logdir_of None —
+    e.g. network-partitioned broker still alive in metadata) goes DEAD
+    after max_intra_verify_failures ticks instead of spinning to
+    max_ticks."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 2}, seed=0)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=150.0,
+    )
+    orig_tick = admin.tick
+
+    def vanish_first(seconds):
+        admin._intra_inflight.clear()  # copy aborts, never lands
+        return orig_tick(seconds)
+
+    admin.tick = vanish_first
+    admin.logdir_of = lambda *a: None  # and the broker cannot be asked
+    ex = Executor(admin, topic_names={0: "T0"})
+    res = ex.execute_proposals(
+        [_intra_proposal(topo)],
+        ExecutionOptions(progress_check_interval_s=1.0, max_intra_verify_failures=3),
+    )
+    assert res.dead == 1
+    assert res.ticks < 10
 
 
 def test_vanished_copy_without_landing_is_reexecuted():

@@ -526,3 +526,42 @@ def test_scram_sha256_rfc7677_test_vector():
         b"p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
     )
     c.verify(b"v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4=")  # no raise
+
+
+def test_intra_broker_copy_tracked_over_wire():
+    """Executor + KafkaClusterAdmin against fake brokers with GRADUAL
+    logdir copies: the task stays in flight while DescribeLogDirs reports
+    a future replica, completes once the copy lands on the target dir,
+    and the landed dir is verifiable (reference ExecutorAdminUtils)."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.executor import ExecutionOptions, Executor
+
+    cluster = FakeKafkaCluster(
+        brokers={i: {"rack": f"r{i % 2}", "logdirs": [f"/d{i}/a", f"/d{i}/b"]}
+                 for i in range(3)},
+        topics={
+            "T0": [{"partition": 0, "leader": 0, "replicas": [0, 1]}],
+        },
+    ).start()
+    try:
+        cluster.intra_copy_polls = 2
+        client = KafkaAdminClient(cluster.bootstrap(), timeout_s=5.0)
+        admin = KafkaClusterAdmin(client)
+        prop = ExecutionProposal(
+            topic=0, partition=0, old_leader=0, new_leader=0,
+            old_replicas=(0, 1), new_replicas=(0, 1),
+            disk_moves=((0, 0, 1),),  # broker 0: /d0/a -> /d0/b
+            intra_broker_data_to_move=512.0,
+        )
+        ex = Executor(admin, topic_names={0: "T0"})
+        res = ex.execute_proposals(
+            [prop], ExecutionOptions(progress_check_interval_s=0.05)
+        )
+        assert res.completed == 1 and res.dead == 0
+        # the replica physically lives on the target dir now
+        assert ("T0", 0) in cluster.placement[0]["/d0/b"]
+        assert ("T0", 0) not in cluster.placement[0]["/d0/a"]
+        assert admin.logdir_of("T0", 0, 0) == 1
+        assert admin.in_progress_logdir_moves() == set()
+    finally:
+        cluster.stop()
