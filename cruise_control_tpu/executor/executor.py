@@ -438,6 +438,11 @@ class Executor:
                     for key3, disk in keys.items():
                         if key3 in still:
                             pending[key3] = disk
+                            # observed pending again: the unverifiable
+                            # bound is CONSECUTIVE ticks, so re-observation
+                            # resets it (transient blips hours apart must
+                            # not accumulate into a kill)
+                            self._intra_unknown.pop(key3, None)
                             continue
                         if verify is None:
                             continue  # cannot verify: disappearance = done
@@ -476,6 +481,9 @@ class Executor:
                             # must not abort the whole execution; the copy
                             # stays pending and the bounds above decide
                             pass
+                        # a resubmitted copy starts a fresh consecutive
+                        # unverifiable window
+                        self._intra_unknown.pop(key3, None)
                         pending[key3] = disk
                     if pending is None:
                         continue

@@ -62,11 +62,17 @@ class KafkaClusterAdmin:
         #: pending" (the executor treats absence as completion)
         self._last_futures: dict[int, set[tuple[str, int, int]]] = {}
         #: consecutive DescribeLogDirs failures per broker; past the cap the
-        #: broker is evicted from polling (a dead broker must not cost a
-        #: full socket timeout on every progress tick forever — the
-        #: executor's dead-broker sweep owns its tasks' fate)
+        #: broker is only PROBED every _probe_every polls (bounded timeout
+        #: cost, but a recovered broker is re-observed — its landed copies
+        #: must not be reported dead)
         self._describe_failures: dict[int, int] = {}
         self._max_describe_failures = 5
+        self._probe_every = 5
+        self._probe_countdown: dict[int, int] = {}
+        #: brokers described successfully in the CURRENT poll round — a
+        #: cache miss for these means "replica not present anywhere", no
+        #: redial needed
+        self._described_ok: set[int] = set()
         #: replica -> dense dir index placement from the poll's describes,
         #: so landed-verification is cache-served instead of one RPC per
         #: verified partition
@@ -186,29 +192,41 @@ class KafkaClusterAdmin:
         the target dir with is_future_key=true (reference ExecutorAdminUtils
         polls log dirs to track AlterReplicaLogDirs completion)."""
         out: set[tuple[str, int, int]] = set()
-        # placement cache is scoped to ONE poll round: verification reads
-        # what this round's describes observed, never an older execution's
-        # stale placements (and the dict stays bounded)
+        # placement cache + described-ok set are scoped to ONE poll round:
+        # verification reads what this round's describes observed, never an
+        # older execution's stale placements (and both stay bounded)
         self._last_placement.clear()
+        self._described_ok.clear()
         for broker in sorted(self._logdir_move_brokers):
+            failures = self._describe_failures.get(broker, 0)
+            if failures > self._max_describe_failures:
+                # past the cap, back off to probing every Nth poll — a
+                # permanently-skipped broker could never recover, and a
+                # recovered broker's landed copies must not be killed as
+                # unverifiable (rolling restarts bounce brokers routinely)
+                self._probe_countdown[broker] = (
+                    self._probe_countdown.get(broker, 0) - 1
+                )
+                if self._probe_countdown[broker] > 0:
+                    out |= self._last_futures.get(broker, set())
+                    continue
+                self._probe_countdown[broker] = self._probe_every
             try:
                 dirs = self.client.describe_logdirs(broker)
             except (OSError, ConnectionError):
-                n = self._describe_failures.get(broker, 0) + 1
-                self._describe_failures[broker] = n
-                if n > self._max_describe_failures:
-                    # persistently unreachable (likely dead/decommissioned):
-                    # stop paying a socket timeout every progress tick; the
-                    # executor's dead-broker sweep decides its tasks' fate
-                    self._logdir_move_brokers.discard(broker)
-                    self._last_futures.pop(broker, None)
-                    continue
-                # transient: report the LAST KNOWN pending copies as still
-                # pending — absence here means completion to the executor,
-                # and a socket timeout is not completion
+                self._describe_failures[broker] = failures + 1
+                if failures + 1 > self._max_describe_failures:
+                    # arm the probe backoff the moment the cap is crossed
+                    self._probe_countdown[broker] = self._probe_every
+                # transient (or probed-and-still-down): report the LAST
+                # KNOWN pending copies as still pending — absence here
+                # means completion to the executor, and a socket timeout
+                # is not completion
                 out |= self._last_futures.get(broker, set())
                 continue
             self._describe_failures.pop(broker, None)
+            self._probe_countdown.pop(broker, None)
+            self._described_ok.add(broker)
             futures = set()
             for i, path in enumerate(sorted(dirs)):
                 info = dirs[path]
@@ -234,9 +252,15 @@ class KafkaClusterAdmin:
         cached = self._last_placement.get((topic, partition, broker))
         if cached is not None:
             return cached
+        if broker in self._described_ok:
+            # this poll round ALREADY described the broker successfully and
+            # the replica was in no dir (e.g. mid log recovery) — redialing
+            # would return the same answer for another round trip
+            return None
         if self._describe_failures.get(broker, 0) > self._max_describe_failures:
-            # quarantined (persistently unreachable): answering "unknown"
-            # immediately avoids one socket timeout per verification
+            # backed off (persistently unreachable): answering "unknown"
+            # immediately avoids one socket timeout per verification; the
+            # poll loop's periodic probe discovers recovery
             return None
         try:
             dirs = self.client.describe_logdirs(broker)
@@ -245,6 +269,7 @@ class KafkaClusterAdmin:
                 self._describe_failures.get(broker, 0) + 1
             )
             return None
+        self._describe_failures.pop(broker, None)
         out = None
         for i, path in enumerate(sorted(dirs)):
             for (t, p) in dirs[path]["replicas"]:

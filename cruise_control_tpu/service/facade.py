@@ -489,11 +489,39 @@ class CruiseControl:
         progress.add_step(ExecutingProposals())
         ov = execution_overrides or {}
         proposals = list(result.proposals) + list(extra_proposals or [])
+        strategy = None
+        if ov.get("replica_movement_strategies"):
+            from cruise_control_tpu.executor.strategy import resolve_strategy_chain
+
+            strategy = resolve_strategy_chain(
+                ov["replica_movement_strategies"], allowed=self.allowed_strategies
+            )
+        self.executor.catalog = self.monitor.last_catalog
+        out = self.executor.execute_proposals(
+            proposals, self._exec_options(ov),
+            removed_brokers=removed, demoted_brokers=demoted,
+            strategy=strategy,
+        )
+        self.invalidate_proposal_cache()
+        return {
+            "completed": out.completed,
+            "aborted": out.aborted,
+            "dead": out.dead,
+            "stopped": out.stopped,
+        }
+
+    def _exec_options(self, ov: dict | None = None) -> ExecutionOptions:
+        """ExecutionOptions from config + per-request overrides — ONE
+        builder for every execution path (rebalance/add/remove/demote/
+        RF-change), so each honors the configured caps, timeouts and
+        alerting floors."""
+        ov = ov or {}
+
         def _ov(name, default_key):
             v = ov.get(name)
             return v if v is not None else self.config.get(default_key)
 
-        exec_options = ExecutionOptions(
+        return ExecutionOptions(
             concurrent_partition_movements_per_broker=_ov(
                 "concurrent_partition_movements_per_broker",
                 "num.concurrent.partition.movements.per.broker",
@@ -525,25 +553,6 @@ class CruiseControl:
             )
             / 1000.0,
         )
-        strategy = None
-        if ov.get("replica_movement_strategies"):
-            from cruise_control_tpu.executor.strategy import resolve_strategy_chain
-
-            strategy = resolve_strategy_chain(
-                ov["replica_movement_strategies"], allowed=self.allowed_strategies
-            )
-        self.executor.catalog = self.monitor.last_catalog
-        out = self.executor.execute_proposals(
-            proposals, exec_options, removed_brokers=removed, demoted_brokers=demoted,
-            strategy=strategy,
-        )
-        self.invalidate_proposal_cache()
-        return {
-            "completed": out.completed,
-            "aborted": out.aborted,
-            "dead": out.dead,
-            "stopped": out.stopped,
-        }
 
     def _build_options(
         self,
@@ -709,14 +718,10 @@ class CruiseControl:
             "proposals": [p.to_json() for p in proposals[:100]],
         }
         if not dryrun and proposals:
-            exec_options = ExecutionOptions(
-                concurrent_leader_movements=self.config.get("num.concurrent.leader.movements"),
-                progress_check_interval_s=0.1,
-            )
             self.executor.catalog = self.monitor.last_catalog
             progress.add_step(ExecutingProposals())
             r = self.executor.execute_proposals(
-                proposals, exec_options, demoted_brokers=set(broker_ids)
+                proposals, self._exec_options(), demoted_brokers=set(broker_ids)
             )
             out["execution"] = {"completed": r.completed, "dead": r.dead}
         return out
@@ -744,10 +749,7 @@ class CruiseControl:
         if not dryrun and proposals:
             self.executor.catalog = self.monitor.last_catalog
             progress.add_step(ExecutingProposals())
-            r = self.executor.execute_proposals(
-                proposals,
-                ExecutionOptions(progress_check_interval_s=0.1),
-            )
+            r = self.executor.execute_proposals(proposals, self._exec_options())
             out["execution"] = {"completed": r.completed, "dead": r.dead}
         return out
 

@@ -126,28 +126,42 @@ def test_transient_describe_failure_keeps_copies_pending():
     assert admin.logdir_of("T0", 0, 3) == 1
 
 
-def test_persistently_unreachable_broker_evicted_from_polling():
-    """Past the consecutive-failure cap the broker stops being dialed —
-    a dead broker must not cost a socket timeout per progress tick."""
+def test_unreachable_broker_backs_off_but_can_recover():
+    """Past the consecutive-failure cap the broker is only PROBED every
+    few polls (no per-tick socket timeout), its copies stay pending, and a
+    recovered broker is re-observed — landed copies are not reported dead."""
     from cruise_control_tpu.kafka.admin import KafkaClusterAdmin
 
-    class DeadClient:
+    class FlakyDeadClient:
         calls = 0
+        recovered = False
 
         def describe_logdirs(self, node_id):
-            DeadClient.calls += 1
-            raise OSError("unreachable")
+            FlakyDeadClient.calls += 1
+            if not FlakyDeadClient.recovered:
+                raise OSError("unreachable")
+            return {
+                "/d0": {"error_code": 0, "replicas": {("T0", 0): 10},
+                        "future_replicas": set()},
+            }
 
-    admin = KafkaClusterAdmin(DeadClient())
+    admin = KafkaClusterAdmin(FlakyDeadClient())
     admin._logdir_move_brokers = {7}
     admin._last_futures = {7: {("T0", 0, 7)}}
-    for _ in range(admin._max_describe_failures):
+    # while failing: copies stay pending (a timeout is not completion)
+    for _ in range(admin._max_describe_failures + 1):
         assert admin.in_progress_logdir_moves() == {("T0", 0, 7)}
-    # cap exceeded: evicted, no more dials
-    assert admin.in_progress_logdir_moves() == set()
-    before = DeadClient.calls
-    admin.in_progress_logdir_moves()
-    assert DeadClient.calls == before
+    # backed off: most polls do NOT dial, pending still reported
+    before = FlakyDeadClient.calls
+    for _ in range(admin._probe_every - 1):
+        assert admin.in_progress_logdir_moves() == {("T0", 0, 7)}
+    assert FlakyDeadClient.calls == before
+    # broker recovers; the next probe observes the landed copy
+    FlakyDeadClient.recovered = True
+    for _ in range(admin._probe_every + 1):
+        pending = admin.in_progress_logdir_moves()
+    assert pending == set()
+    assert admin.logdir_of("T0", 0, 7) == 0
 
 
 def test_intra_copy_on_dead_broker_goes_dead():
