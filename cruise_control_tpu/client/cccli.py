@@ -67,6 +67,11 @@ ENDPOINTS: dict[str, dict] = {
                              "--destination-broker-ids": ("destination_broker_ids", csv_int_param),
                              "--excluded-topics": ("excluded_topics", str),
                              "--rebalance-disk": ("rebalance_disk", boolean_param),
+                             "--allow-capacity-estimation": ("allow_capacity_estimation", boolean_param),
+                             "--exclude-recently-removed-brokers": ("exclude_recently_removed_brokers", boolean_param),
+                             "--exclude-recently-demoted-brokers": ("exclude_recently_demoted_brokers", boolean_param),
+                             "--replica-movement-strategies": ("replica_movement_strategies", str),
+                             "--reason": ("reason", str),
                              "--review-id": ("review_id", positive_int_param)}},
     "add_broker": {"method": "POST", "endpoint": "add_broker",
                    "params": {"--brokers": ("brokerid", csv_int_param),
