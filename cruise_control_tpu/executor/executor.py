@@ -152,6 +152,80 @@ class Executor:
         self._reexecutions: dict[tuple[str, int], int] = {}
         #: consecutive unverifiable-completion count per logdir-copy key
         self._intra_unknown: dict[tuple[str, int, int], int] = {}
+        #: mid-execution concurrency overrides (reference
+        #: Executor.java:485-510 setRequested*MovementConcurrency): the
+        #: operator's knob to decelerate or unstick a LIVE execution via
+        #: POST /admin.  Consulted every tick; cleared when a new
+        #: execution starts so submitted options apply fresh.
+        self._requested: dict[str, float | int] = {}
+
+    # ------------------------------------------------------------------
+    # mid-execution concurrency control (reference Executor.java:485-510,
+    # driven by ADMIN ChangeExecutionConcurrencyParameters)
+
+    def set_requested_concurrency(
+        self,
+        *,
+        inter_broker: int | None = None,
+        intra_broker: int | None = None,
+        leadership: int | None = None,
+        progress_check_interval_s: float | None = None,
+    ) -> dict:
+        """Adjust the concurrency caps of the ongoing execution.
+
+        Each tick of the execution loop reads these instead of the frozen
+        ExecutionOptions, so the change takes effect on the next progress
+        check — matching the reference's
+        setRequestedInterBrokerPartitionMovementConcurrency family.
+        Returns the now-effective override map.
+        """
+        # validate everything BEFORE applying anything: a rejected call
+        # must not leave a partial override active on the live execution
+        staged: dict[str, float | int] = {}
+        for name, v in (
+            ("inter_broker", inter_broker),
+            ("intra_broker", intra_broker),
+            ("leadership", leadership),
+        ):
+            if v is not None:
+                if v < 1:
+                    raise ValueError(f"{name} concurrency must be >= 1, got {v}")
+                staged[name] = int(v)
+        if progress_check_interval_s is not None:
+            if progress_check_interval_s <= 0:
+                raise ValueError(
+                    "progress_check_interval_s must be > 0, got "
+                    f"{progress_check_interval_s}"
+                )
+            staged["interval_s"] = float(progress_check_interval_s)
+        with self._lock:
+            self._requested.update(staged)
+        return self.requested_concurrency()
+
+    def requested_concurrency(self) -> dict:
+        """The active mid-execution overrides (empty when none set)."""
+        with self._lock:
+            return dict(self._requested)
+
+    def _inter_cap(self, options: ExecutionOptions) -> int:
+        with self._lock:
+            v = self._requested.get("inter_broker")
+        return int(v) if v is not None else options.concurrent_partition_movements_per_broker
+
+    def _intra_cap(self, options: ExecutionOptions) -> int:
+        with self._lock:
+            v = self._requested.get("intra_broker")
+        return int(v) if v is not None else options.concurrent_intra_broker_partition_movements
+
+    def _leader_cap(self, options: ExecutionOptions) -> int:
+        with self._lock:
+            v = self._requested.get("leadership")
+        return int(v) if v is not None else options.concurrent_leader_movements
+
+    def _interval(self, options: ExecutionOptions) -> float:
+        with self._lock:
+            v = self._requested.get("interval_s")
+        return float(v) if v is not None else options.progress_check_interval_s
 
     # ------------------------------------------------------------------
 
@@ -236,6 +310,7 @@ class Executor:
             self.tracker = ExecutionTaskTracker()
             self._reexecutions = {}
             self._intra_unknown = {}
+            self._requested = {}  # overrides die with the previous execution
             self._planner = ExecutionTaskPlanner(strategy or self.strategy)
             tasks = self._planner.add_execution_proposals(proposals, strategy_context)
             for t in tasks:
@@ -313,7 +388,14 @@ class Executor:
                         t.aborting(now_ms())
                         t.aborted(now_ms())
                     intra_in_flight.clear()
-                break
+                    break
+                # graceful stop: submit nothing new, but keep collecting
+                # completions until everything in flight drains — an
+                # untracked reassignment or logdir copy would otherwise sit
+                # IN_PROGRESS in the tracker forever and the result counts
+                # would not add up to the task total
+                if not in_flight and not intra_in_flight:
+                    break
             # collect completions.  A key leaving the in-progress set does
             # NOT prove the move landed: the controller may have dropped the
             # reassignment (reference Executor.maybeReexecuteTasks:1430) —
@@ -375,26 +457,35 @@ class Executor:
                     del intra_in_flight[eid]
 
             # drain new tasks within caps (per-broker AND the global
-            # max.num.cluster.movements budget)
-            ready = self._ready_brokers(options, in_flight, topo)
-            budget = max(
-                0,
-                options.max_num_cluster_movements
-                - len(in_flight)
-                - len(intra_in_flight),
-            )
-            new_tasks = planner.get_inter_broker_replica_movement_tasks(
-                ready, set(in_flight), max_total=budget
-            )
-            # intra-broker moves share the global movement budget: whatever
-            # the inter-broker drain left of it this tick
-            intra = planner.get_intra_broker_replica_movement_tasks(
-                {
-                    b: options.concurrent_intra_broker_partition_movements
-                    for b in alive
-                },
-                max_total=max(0, budget - len(new_tasks)),
-            )
+            # max.num.cluster.movements budget) — unless a graceful stop is
+            # draining the in-flight set
+            if self._stop_requested:
+                new_tasks, intra = [], []
+            else:
+                ready = self._ready_brokers(options, in_flight, topo)
+                budget = max(
+                    0,
+                    options.max_num_cluster_movements
+                    - len(in_flight)
+                    - len(intra_in_flight),
+                )
+                new_tasks = planner.get_inter_broker_replica_movement_tasks(
+                    ready, set(in_flight), max_total=budget
+                )
+                # intra-broker moves share the global movement budget:
+                # whatever the inter-broker drain left of it this tick.
+                # Copies still in flight consume their broker's slots
+                # (num.concurrent.intra.broker.partition.movements caps
+                # CONCURRENT copies per broker, not submissions per tick)
+                intra_used: dict[int, int] = {}
+                for _t, keys in intra_in_flight.values():
+                    for (_tn, _pn, b) in keys:
+                        intra_used[b] = intra_used.get(b, 0) + 1
+                intra_cap = self._intra_cap(options)
+                intra = planner.get_intra_broker_replica_movement_tasks(
+                    {b: max(0, intra_cap - intra_used.get(b, 0)) for b in alive},
+                    max_total=max(0, budget - len(new_tasks)),
+                )
             if new_tasks:
                 specs = []
                 for t in new_tasks:
@@ -509,9 +600,9 @@ class Executor:
                 break
             ticks += 1
             if simulated:
-                self.admin.tick(options.progress_check_interval_s)
+                self.admin.tick(self._interval(options))
             else:
-                time.sleep(options.progress_check_interval_s)
+                time.sleep(self._interval(options))
 
         # --- phase 2: leadership movements ---
         if not self._stop_requested:
@@ -519,7 +610,7 @@ class Executor:
             while not self._stop_requested:
                 batch = planner.get_leadership_movement_tasks(
                     min(
-                        options.concurrent_leader_movements,
+                        self._leader_cap(options),
                         options.max_num_cluster_movements,
                     )
                 )
@@ -584,10 +675,10 @@ class Executor:
                             ).inc()
                         break
                     if simulated:
-                        self.admin.tick(options.progress_check_interval_s)
+                        self.admin.tick(self._interval(options))
                         ticks += 1
                     else:
-                        time.sleep(options.progress_check_interval_s)
+                        time.sleep(self._interval(options))
 
         # abort anything still pending after a stop
         for t in self.tracker.tasks(state=TaskState.PENDING):
@@ -617,7 +708,7 @@ class Executor:
     def _ready_brokers(
         self, options: ExecutionOptions, in_flight, topo=None
     ) -> dict[int, int]:
-        cap = options.concurrent_partition_movements_per_broker
+        cap = self._inter_cap(options)
         if topo is None:
             topo = self.admin.topology()
         alive = topo.alive_broker_ids()
@@ -665,4 +756,7 @@ class Executor:
             "numExecutionsStarted": self.num_executions_started,
             "numExecutionsStopped": self.num_executions_stopped,
             "triggeredUserTaskId": self._uuid,
+            # operator-requested mid-execution overrides, if any (reference
+            # ExecutorState requested*MovementConcurrency fields)
+            "requestedConcurrency": self.requested_concurrency(),
         }

@@ -78,12 +78,18 @@ class ExecutionTaskPlanner:
         out = []
         rest = []
         for t in self._intra:
-            b = t.proposal.new_replicas[0] if t.proposal.new_replicas else -1
+            # slots are charged on the brokers actually COPYING between
+            # logdirs (one per disk move), not the replica list
+            brokers = {b for (b, _old, _new) in t.proposal.disk_moves}
+            if not brokers and t.proposal.new_replicas:
+                brokers = {t.proposal.new_replicas[0]}
             if (
-                ready_brokers.get(b, 0) > 0
+                brokers
+                and all(ready_brokers.get(b, 0) > 0 for b in brokers)
                 and (max_total is None or len(out) < max_total)
             ):
-                ready_brokers[b] -= 1
+                for b in brokers:
+                    ready_brokers[b] -= 1
                 out.append(t)
             else:
                 rest.append(t)

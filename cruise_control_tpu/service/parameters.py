@@ -134,6 +134,9 @@ _RAW_PARAMETERS: dict[str, tuple] = {
         "state": (Param("substates", _str_list),),
         "kafka_cluster_state": (),
         "user_tasks": (Param("user_task_ids", _str_list),
+                       Param("client_ids", _str_list),
+                       Param("endpoints", _str_list),
+                       Param("types", _str_list),
                        Param("fetch_completed_task", _bool)),
         "review_board": (Param("review_ids", _int_list),),
         "add_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID,
@@ -155,7 +158,15 @@ _RAW_PARAMETERS: dict[str, tuple] = {
         "demote_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID),
         "admin": (Param("enable_self_healing_for", _str_list),
                   Param("disable_self_healing_for", _str_list),
-                  Param("drop_recently_removed_brokers", _int_list), _REVIEW_ID),
+                  Param("drop_recently_removed_brokers", _int_list),
+                  Param("drop_recently_demoted_brokers", _int_list),
+                  # mid-execution concurrency change (reference
+                  # AdminParameters.java:31-38)
+                  Param("concurrent_partition_movements_per_broker", _min1_int),
+                  Param("concurrent_intra_broker_partition_movements", _min1_int),
+                  Param("concurrent_leader_movements", _min1_int),
+                  Param("execution_progress_check_interval_ms", _min1_int),
+                  _REVIEW_ID),
         "review": (Param("approve", _int_list), Param("discard", _int_list),
                    _REASON),
         "topic_configuration": (Param("topic", str),

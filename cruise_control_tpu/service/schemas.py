@@ -118,7 +118,15 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("execution", DICT, required=False),
         Field("_userTaskId", STR, required=False),
     )),
-    "user_tasks": Schema((Field("userTasks", LIST),)),
+    "user_tasks": Schema((
+        Field("userTasks", LIST, item_schema=Schema((
+            Field("UserTaskId", STR),
+            Field("RequestURL", STR),
+            Field("ClientIdentity", STR),
+            Field("Status", STR),
+            Field("StartMs", NUM),
+        ))),
+    )),
     "review_board": Schema((Field("requestInfo", LIST),)),
     "review": Schema((Field("requestInfo", LIST),)),
     "bootstrap": Schema((
@@ -143,6 +151,10 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
     "admin": Schema((
         Field("selfHealingEnabled", LIST, required=False),
         Field("recentlyRemovedBrokers", LIST, required=False),
+        Field("recentlyDemotedBrokers", LIST, required=False),
+        # mid-execution concurrency change acknowledgment
+        Field("requestedConcurrency", DICT, required=False),
+        Field("ongoingExecution", BOOL, required=False),
     )),
 }
 

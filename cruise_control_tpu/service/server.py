@@ -52,6 +52,7 @@ class AccessLog:
 
     def __init__(self, path: str, *, retention_days: int = 7):
         import os
+        import time as _time
 
         self.path = path
         self.retention_days = retention_days
@@ -59,6 +60,15 @@ class AccessLog:
         self._day: str | None = None
         self._file = None  # persistent handle; reopened only on the daily roll
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # a leftover log from a previous day (service restart) must roll
+        # before today's entries append to it — seed _day from the file's
+        # mtime so the first log() call observes the day change
+        try:
+            self._day = _time.strftime(
+                "%Y-%m-%d", _time.localtime(os.path.getmtime(path))
+            )
+        except OSError:
+            pass
 
     def log(self, client: str, user: str, method: str, path: str, status: int,
             size: int):
@@ -296,8 +306,10 @@ class CruiseControlApp:
             and not params.get("reason", [""])[0]
             # an approved two-step resubmit carries only review_id — its
             # reason rides the PARKED params (which passed this check when
-            # the request first parked)
-            and "review_id" not in params
+            # the request first parked).  The exemption only applies while
+            # two-step verification is ON: otherwise review_id is ignored
+            # downstream and a bare review_id would bypass the reason check
+            and not (self.two_step and "review_id" in params)
         ):
             # reference WebServerConfig request.reason.required: mutating
             # requests must say why (feeds the operation audit log)
@@ -322,6 +334,7 @@ class CruiseControlApp:
         # anonymous requests must NOT share one namespace, or client B's
         # identical POST would silently resume client A's operation.
         client = headers.get("X-Client")
+        self._local.client = client or ""
         self._local.session_key = (
             self.sessions.session_key(
                 client, method, endpoint,
@@ -390,20 +403,22 @@ class CruiseControlApp:
 
     def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
         key = getattr(self._local, "session_key", None)
+        client = getattr(self._local, "client", "") or ""
         if key is None:
-            task = self.user_tasks.submit(endpoint, fn)
+            task = self.user_tasks.submit(endpoint, fn, client_id=client)
             return self._task_response(task)
         # bind the session to the submitted task so a client that lost the
         # User-Task-ID header resumes the same operation instead of
         # re-executing it (reference servlet/SessionManager.java)
         tid = self.sessions.get_or_bind(
-            key, lambda: self.user_tasks.submit(endpoint, fn).task_id
+            key, lambda: self.user_tasks.submit(endpoint, fn, client_id=client).task_id
         )
         task = self.user_tasks.get(tid)
         if task is None:  # bound task evicted; start fresh
             self.sessions.release(key)
             tid = self.sessions.get_or_bind(
-                key, lambda: self.user_tasks.submit(endpoint, fn).task_id
+                key,
+                lambda: self.user_tasks.submit(endpoint, fn, client_id=client).task_id,
             )
             task = self.user_tasks.get(tid)
         status, payload = self._task_response(task)
@@ -522,7 +537,30 @@ class CruiseControlApp:
         return self._async_op("proposals", op)
 
     def _ep_user_tasks(self, params) -> tuple[int, dict]:
-        return 200, {"userTasks": [t.to_json() for t in self.user_tasks.all_tasks()]}
+        """Reference UserTasksParameters filters
+        (servlet/parameters/UserTasksParameters.java:1): user_task_ids,
+        client_ids, endpoints, and types (task status names) are each a
+        comma-separated allowlist; unset filters match everything."""
+        tasks = self.user_tasks.all_tasks()
+        # (param, task attribute, case-sensitive) — client identities are
+        # opaque strings and compare exactly; ids/endpoints/statuses fold
+        for pname, attr, exact in (
+            ("user_task_ids", "task_id", False),
+            ("client_ids", "client_id", True),
+            ("endpoints", "endpoint", False),
+            ("types", "status", False),
+        ):
+            raw = params.get(pname, [None])[0]
+            if not raw:
+                continue
+            wanted = {x.strip() if exact else x.strip().lower()
+                      for x in raw.split(",") if x.strip()}
+            tasks = [
+                t for t in tasks
+                if (getattr(t, attr) if exact else getattr(t, attr).lower())
+                in wanted
+            ]
+        return 200, {"userTasks": [t.to_json() for t in tasks]}
 
     def _ep_review_board(self, params) -> tuple[int, dict]:
         return 200, {"requestInfo": self.purgatory.board()}
@@ -667,7 +705,11 @@ class CruiseControlApp:
         )
 
     def _ep_admin(self, params) -> tuple[int, dict]:
-        """Reference AdminRequest: toggle self-healing, drop broker history."""
+        """Reference AdminRequest: toggle self-healing, drop broker history,
+        and change the concurrency of a RUNNING execution
+        (servlet/parameters/AdminParameters.java:31-38 ->
+        ChangeExecutionConcurrencyParameters, applied via
+        executor/Executor.java:485-510)."""
         out: dict = {}
         from cruise_control_tpu.detector import AnomalyType
 
@@ -685,6 +727,41 @@ class CruiseControlApp:
         if drop:
             self.cc.executor.drop_removed_brokers(int(b) for b in drop.split(","))
             out["recentlyRemovedBrokers"] = sorted(self.cc.executor.removed_brokers)
+        drop_dem = params.get("drop_recently_demoted_brokers", [None])[0]
+        if drop_dem:
+            self.cc.executor.drop_demoted_brokers(int(b) for b in drop_dem.split(","))
+            out["recentlyDemotedBrokers"] = sorted(self.cc.executor.demoted_brokers)
+        # mid-execution concurrency change: applied on the executor's next
+        # progress tick, so a live rebalance can be throttled or unstuck
+        conc = {}
+        for pname, kwarg, cast in (
+            ("concurrent_partition_movements_per_broker", "inter_broker", int),
+            ("concurrent_intra_broker_partition_movements", "intra_broker", int),
+            ("concurrent_leader_movements", "leadership", int),
+            ("execution_progress_check_interval_ms", "progress_check_interval_s",
+             lambda v: int(v) / 1000.0),
+        ):
+            raw = params.get(pname, [None])[0]
+            if raw is not None:
+                try:
+                    conc[kwarg] = cast(raw)
+                except (TypeError, ValueError) as e:
+                    raise BadRequest(f"bad {pname}: {raw!r}") from e
+        if conc:
+            # the reference rejects ChangeExecutionConcurrency when nothing
+            # is executing — overrides die with the execution, so accepting
+            # one here would 200 a silent no-op
+            if not self.cc.executor.has_ongoing_execution:
+                raise BadRequest(
+                    "cannot change execution concurrency: no ongoing execution"
+                )
+            try:
+                out["requestedConcurrency"] = (
+                    self.cc.executor.set_requested_concurrency(**conc)
+                )
+            except ValueError as e:
+                raise BadRequest(str(e)) from e
+            out["ongoingExecution"] = True
         return 200, out
 
     def _ep_review(self, params) -> tuple[int, dict]:

@@ -26,7 +26,9 @@ class UserTask:
     progress: OperationProgress
     created_ms: int
     request_url: str = ""
-    #: JSON-serializable result once done
+    #: requesting client identity (reference UserTaskInfo clientIdentity,
+    #: filterable via USER_TASKS client_ids)
+    client_id: str = ""
 
     @property
     def status(self) -> str:
@@ -40,6 +42,7 @@ class UserTask:
         return {
             "UserTaskId": self.task_id,
             "RequestURL": self.request_url or self.endpoint,
+            "ClientIdentity": self.client_id,
             "Status": self.status,
             "StartMs": self.created_ms,
         }
@@ -73,7 +76,8 @@ class UserTaskManager:
         self.category_max_cached = category_max_cached or {}
         self.category_retention_ms = category_retention_ms or {}
 
-    def submit(self, endpoint: str, fn, *, request_url: str = "", task_id: str | None = None) -> UserTask:
+    def submit(self, endpoint: str, fn, *, request_url: str = "",
+               task_id: str | None = None, client_id: str = "") -> UserTask:
         """Run fn(progress) on the session pool; returns the UserTask."""
         with self._lock:
             active = sum(1 for t in self._tasks.values() if t.status == "Active")
@@ -90,6 +94,7 @@ class UserTaskManager:
                 progress=progress,
                 created_ms=int(time.time() * 1000),
                 request_url=request_url,
+                client_id=client_id,
             )
             self._tasks[tid] = task
             self._maybe_evict()

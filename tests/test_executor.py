@@ -295,3 +295,128 @@ def test_reexecution_bound_marks_task_dead(sim):
     # the topology still shows the OLD placement (the move never landed)
     by_key = {(p.topic, p.partition): set(p.replicas) for p in sim.topology().partitions}
     assert by_key[("T0", 0)] == {0, 1}
+
+
+def test_mid_execution_concurrency_change(sim):
+    """Operator raises the per-broker cap on a LIVE execution via
+    set_requested_concurrency (reference Executor.java:485-510,
+    driven by POST /admin) — the change applies on the next tick."""
+    parts = [PartitionInfo("T0", i, leader=0, replicas=(0, 1)) for i in range(4)]
+    meta = StaticMetadataProvider(topo_4brokers(parts))
+    admin = SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1000.0)
+    concurrent = []
+    orig = admin.tick
+
+    def spy(seconds):
+        concurrent.append(len(admin.in_progress_reassignments()))
+        if len(concurrent) == 6:
+            ex.set_requested_concurrency(inter_broker=4)
+        return orig(seconds)
+
+    admin.tick = spy
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = [proposal(0, i, [0, 1], [2, 1], data=3000.0) for i in range(4)]
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_partition_movements_per_broker=1, progress_check_interval_s=1.0
+        ),
+    )
+    assert res.completed == len(ex.tracker.tasks()) and res.dead == 0
+    # before the change: strictly serial; after: parallel drains appear
+    assert max(concurrent[:6]) == 1
+    assert max(concurrent[6:]) > 1
+    # the override is reported in STATE and dies with the next execution
+    assert ex.executor_state()["requestedConcurrency"] == {"inter_broker": 4}
+    ex.execute_proposals([], ExecutionOptions())
+    assert ex.requested_concurrency() == {}
+
+
+def test_mid_execution_concurrency_decrease(sim):
+    """Lowering the cap mid-flight throttles NEW submissions immediately
+    (in-flight moves finish, but the steady state honors the new cap)."""
+    parts = [PartitionInfo("T0", i, leader=0, replicas=(0, 1)) for i in range(8)]
+    meta = StaticMetadataProvider(topo_4brokers(parts))
+    admin = SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1000.0)
+    concurrent = []
+    orig = admin.tick
+
+    def spy(seconds):
+        concurrent.append(len(admin.in_progress_reassignments()))
+        if len(concurrent) == 2:
+            ex.set_requested_concurrency(inter_broker=1)
+        return orig(seconds)
+
+    admin.tick = spy
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = [proposal(0, i, [0, 1], [2, 1], data=3000.0) for i in range(8)]
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_partition_movements_per_broker=4, progress_check_interval_s=1.0
+        ),
+    )
+    assert res.completed == len(ex.tracker.tasks()) and res.dead == 0
+    assert max(concurrent[:2]) == 4
+    # once the initial burst drains, the loop never again exceeds 1
+    drained = next(i for i, c in enumerate(concurrent) if i >= 2 and c <= 1)
+    assert max(concurrent[drained:]) <= 1
+
+
+def test_progress_check_interval_change_mid_execution(sim):
+    """execution_progress_check_interval_ms applies to the running loop."""
+    intervals = []
+    orig = sim.tick
+
+    def spy(seconds):
+        intervals.append(seconds)
+        if len(intervals) == 2:
+            ex.set_requested_concurrency(progress_check_interval_s=0.25)
+        return orig(seconds)
+
+    sim.tick = spy
+    ex = Executor(sim, topic_names={0: "T0"})
+    props = [proposal(0, 0, [0, 1], [2, 1], data=5000.0)]
+    ex.execute_proposals(props, ExecutionOptions(progress_check_interval_s=1.0))
+    assert intervals[:2] == [1.0, 1.0]
+    assert set(intervals[3:]) == {0.25}
+
+
+def test_graceful_stop_drains_in_flight(sim):
+    """A non-forced stop submits nothing new but WAITS for in-flight moves
+    to land, so no task is left IN_PROGRESS and the result counts add up
+    (completed + aborted + dead == total)."""
+    parts = [PartitionInfo("T0", i, leader=0, replicas=(0, 1)) for i in range(4)]
+    meta = StaticMetadataProvider(topo_4brokers(parts))
+    admin = SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1000.0)
+    orig = admin.tick
+    calls = []
+
+    def stop_after_1(seconds):
+        calls.append(1)
+        if len(calls) == 1:
+            ex.stop_execution(force=False)
+        return orig(seconds)
+
+    admin.tick = stop_after_1
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = [proposal(0, i, [0, 1], [2, 1], data=3000.0) for i in range(4)]
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_partition_movements_per_broker=2, progress_check_interval_s=1.0
+        ),
+    )
+    assert res.stopped
+    total = len(ex.tracker.tasks())
+    assert res.completed + res.aborted + res.dead == total
+    assert not ex.tracker.tasks(state=TaskState.IN_PROGRESS)
+    # the 2 in-flight moves were allowed to finish (graceful semantics)
+    assert res.completed >= 2
+    # and the topology reflects exactly the completed moves
+    by_key = {(p.topic, p.partition): set(p.replicas) for p in admin.topology().partitions}
+    moved = sum(1 for i in range(4) if by_key[("T0", i)] == {2, 1})
+    assert moved == sum(
+        1 for t in ex.tracker.tasks(state=TaskState.COMPLETED)
+        if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION
+    )

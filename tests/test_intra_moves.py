@@ -318,3 +318,123 @@ def test_partition_size_detection_enabled_via_config():
     records = app.cc.anomaly_detector.run_once()
     kinds = {type(r.anomaly).__name__ for r in records}
     assert "TopicPartitionSizeAnomaly" in kinds
+
+
+def _multi_intra_proposals(topo, n, broker, data=1000.0):
+    """n intra-broker disk moves all on the same broker."""
+    out = []
+    for i, p in enumerate(topo.partitions[:n]):
+        out.append(ExecutionProposal(
+            topic=p.topic, partition=p.partition, old_leader=p.leader,
+            new_leader=p.leader, old_replicas=tuple(p.replicas),
+            new_replicas=tuple(p.replicas),
+            disk_moves=((broker, 0, 1),),
+            intra_broker_data_to_move=data,
+        ))
+    return out
+
+
+def test_intra_concurrency_cap_holds_while_copies_drain():
+    """num.concurrent.intra.broker.partition.movements caps CONCURRENT
+    copies per broker: copies still in flight consume their broker's
+    slots, so the executor must not submit a fresh full-cap batch every
+    tick (reference Executor per-broker intra concurrency)."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 8}, seed=0)
+    broker = topo.partitions[0].replicas[0]
+    # pin every proposal's broker to the same one so the cap is the binding
+    # constraint; each copy takes ~3 ticks (250 bytes at 100 B/s)
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=250.0,
+    )
+    concurrent = []
+    orig = admin.tick
+
+    def spy(seconds):
+        concurrent.append(len(admin._intra_inflight))
+        return orig(seconds)
+
+    admin.tick = spy
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = _multi_intra_proposals(topo, 6, broker)
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_intra_broker_partition_movements=2,
+            progress_check_interval_s=1.0,
+        ),
+    )
+    assert res.completed == 6
+    assert max(concurrent) <= 2, (
+        f"intra cap violated: up to {max(concurrent)} concurrent copies"
+    )
+
+
+def test_intra_cap_change_mid_execution():
+    """Raising the intra cap on a live execution speeds the drain."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 8}, seed=0)
+    broker = topo.partitions[0].replicas[0]
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=250.0,
+    )
+    concurrent = []
+    orig = admin.tick
+
+    def spy(seconds):
+        concurrent.append(len(admin._intra_inflight))
+        if len(concurrent) == 4:
+            ex.set_requested_concurrency(intra_broker=4)
+        return orig(seconds)
+
+    admin.tick = spy
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = _multi_intra_proposals(topo, 8, broker)
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_intra_broker_partition_movements=1,
+            progress_check_interval_s=1.0,
+        ),
+    )
+    assert res.completed == 8
+    assert max(concurrent[:4]) <= 1
+    assert max(concurrent[4:]) > 1
+    assert max(concurrent) <= 4
+
+
+def test_graceful_stop_drains_tracked_copies():
+    """Graceful stop waits for in-flight logdir copies instead of leaving
+    them IN_PROGRESS in the tracker forever."""
+    topo = synthetic_topology(num_brokers=3, topics={"T0": 4}, seed=0)
+    broker = topo.partitions[0].replicas[0]
+    admin = SimulatedClusterAdmin(
+        StaticMetadataProvider(topo),
+        link_rate_bytes_per_s=100.0,
+        intra_move_bytes=350.0,
+    )
+    orig = admin.tick
+    calls = []
+
+    def stop_after_1(seconds):
+        calls.append(1)
+        if len(calls) == 1:
+            ex.stop_execution(force=False)
+        return orig(seconds)
+
+    admin.tick = stop_after_1
+    ex = Executor(admin, topic_names={0: "T0"})
+    props = _multi_intra_proposals(topo, 3, broker)
+    res = ex.execute_proposals(
+        props,
+        ExecutionOptions(
+            concurrent_intra_broker_partition_movements=1,
+            progress_check_interval_s=1.0,
+        ),
+    )
+    assert res.stopped
+    assert not ex.tracker.tasks(state=TaskState.IN_PROGRESS)
+    assert res.completed + res.aborted + res.dead == len(ex.tracker.tasks())
+    assert res.completed >= 1  # the tracked copy was drained, not dropped

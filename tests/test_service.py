@@ -697,3 +697,136 @@ def test_two_step_resubmit_passes_merged_parsed_to_custom_handler():
         assert seen.get("excluded_topics") == "T0"
     finally:
         app.stop()
+
+
+def test_admin_concurrency_change_mid_execution():
+    """Reference AdminParameters.java:31-38 ChangeExecutionConcurrency:
+    an operator halts/accelerates a LIVE rebalance via POST /admin; the
+    executor consults the change on its next progress tick."""
+    import threading
+
+    app, fetcher, admin, sampler = build_simulated_service(seed=31)
+    app.start()
+    try:
+        gate = threading.Event()
+        orig_tick = admin.tick
+
+        def gated_tick(seconds):
+            time.sleep(0.02)
+            # no progress until the test releases the gate — keeps the
+            # execution alive regardless of proposal sizes
+            return orig_tick(seconds if gate.is_set() else 0.0)
+
+        admin.tick = gated_tick
+
+        status, first, headers = _request(app, "POST", "rebalance", dryrun="false")
+        tid = headers.get("User-Task-ID")
+        deadline = time.time() + 30
+        while not app.cc.executor.has_ongoing_execution and time.time() < deadline:
+            time.sleep(0.05)
+        assert app.cc.executor.has_ongoing_execution, "execution never started"
+
+        status2, payload2, _ = _request(
+            app, "POST", "admin",
+            concurrent_partition_movements_per_broker="8",
+            concurrent_leader_movements="500",
+            execution_progress_check_interval_ms="50",
+        )
+        assert status2 == 200
+        assert payload2["ongoingExecution"] is True
+        assert payload2["requestedConcurrency"] == {
+            "inter_broker": 8, "leadership": 500, "interval_s": 0.05,
+        }
+        # the LIVE executor sees it (next tick reads these, not the frozen
+        # ExecutionOptions)
+        assert app.cc.executor.requested_concurrency()["inter_broker"] == 8
+        # and STATE surfaces it
+        st, state_payload, _ = _request(app, "GET", "state", substates="executor")
+        assert state_payload["ExecutorState"]["requestedConcurrency"][
+            "inter_broker"] == 8
+
+        gate.set()  # let the execution drain
+        status3, payload3, _ = _request(
+            app, "POST", "rebalance", dryrun="false",
+            headers={"User-Task-ID": tid},
+        )
+        deadline = time.time() + 60
+        while status3 == 202 and time.time() < deadline:
+            time.sleep(0.2)
+            status3, payload3, _ = _request(
+                app, "POST", "rebalance", dryrun="false",
+                headers={"User-Task-ID": tid},
+            )
+        assert status3 == 200
+        if "execution" in payload3:
+            assert payload3["execution"]["dead"] == 0
+    finally:
+        app.stop()
+
+
+def test_admin_concurrency_rejects_bad_values(service):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "POST", "admin",
+                 concurrent_partition_movements_per_broker="0")
+    assert e.value.code == 400
+
+
+def test_admin_concurrency_requires_ongoing_execution(service):
+    """Overrides die with the execution, so accepting one while idle would
+    200 a silent no-op — the reference rejects it (AdminParameters)."""
+    import urllib.error
+
+    assert not service.cc.executor.has_ongoing_execution
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "POST", "admin", concurrent_leader_movements="5")
+    assert e.value.code == 400
+    assert service.cc.executor.requested_concurrency() == {}
+
+
+def test_admin_drop_recently_demoted_brokers(service):
+    ex = service.cc.executor
+    ex._demoted_history[4] = int(time.time() * 1000)
+    status, payload, _ = _request(
+        service, "POST", "admin", drop_recently_demoted_brokers="4"
+    )
+    assert status == 200
+    assert 4 not in ex.demoted_brokers
+    assert payload["recentlyDemotedBrokers"] == sorted(ex.demoted_brokers)
+
+
+def test_user_tasks_filters(service):
+    # seed at least one completed task
+    _poll(service, "GET", "load")
+    status, payload, _ = _request(service, "GET", "user_tasks")
+    all_tasks = payload["userTasks"]
+    assert all_tasks
+    # endpoints filter
+    status, by_ep, _ = _request(service, "GET", "user_tasks", endpoints="load")
+    assert by_ep["userTasks"]
+    assert all("load" in t["RequestURL"].lower() for t in by_ep["userTasks"])
+    # types filter (task status names)
+    status, by_type, _ = _request(service, "GET", "user_tasks", types="Completed")
+    assert all(t["Status"] == "Completed" for t in by_type["userTasks"])
+    # user_task_ids filter
+    tid = all_tasks[0]["UserTaskId"]
+    status, by_id, _ = _request(service, "GET", "user_tasks", user_task_ids=tid)
+    assert [t["UserTaskId"] for t in by_id["userTasks"]] == [tid]
+    # client_ids filter with a known client identity
+    status, _p, _ = _request(
+        service, "GET", "proposals", headers={"X-Client": "filter-me"}
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, by_client, _ = _request(
+            service, "GET", "user_tasks", client_ids="filter-me"
+        )
+        if by_client["userTasks"]:
+            break
+        time.sleep(0.1)
+    assert by_client["userTasks"]
+    assert all(t["ClientIdentity"] == "filter-me" for t in by_client["userTasks"])
+    # non-matching filter returns empty, not everything
+    status, none, _ = _request(service, "GET", "user_tasks", client_ids="nobody")
+    assert none["userTasks"] == []
