@@ -79,6 +79,204 @@ def default_follower_load(leader_load: np.ndarray, follower_cpu_fraction: float 
     return f
 
 
+@dataclasses.dataclass
+class _BrokerArrays:
+    """Shared broker-level arrays for both build paths."""
+
+    racks: list[str]
+    hosts: list[str]
+    D: int
+    capacity: np.ndarray  # [B, 4]
+    rack: np.ndarray  # int32 [B]
+    host: np.ndarray  # int32 [B]
+    alive: np.ndarray  # bool [B]
+    new: np.ndarray  # bool [B]
+    disk_capacity: np.ndarray  # [B, D]
+    disk_alive: np.ndarray  # bool [B, D]
+
+
+def _broker_arrays(brokers: list[BrokerSpec]) -> _BrokerArrays:
+    """Dense-id check + per-broker capacity/rack/host/disk population —
+    the single source both ClusterModelBuilder.build and
+    build_state_columnar assemble brokers from."""
+    brokers = sorted(brokers, key=lambda b: b.broker_id)
+    ids = [b.broker_id for b in brokers]
+    if ids != list(range(len(ids))):
+        raise ValueError(f"broker ids must be dense 0..B-1, got {ids}")
+    B = len(brokers)
+    racks = sorted({b.rack for b in brokers})
+    rack_idx = {r: i for i, r in enumerate(racks)}
+    hosts = sorted({b.host if b.host is not None else f"__host_{b.broker_id}" for b in brokers})
+    host_idx = {h: i for i, h in enumerate(hosts)}
+
+    D = max((len(b.disk_capacities) for b in brokers if b.disk_capacities), default=1)
+    out = _BrokerArrays(
+        racks=racks,
+        hosts=hosts,
+        D=D,
+        capacity=np.zeros((B, NUM_RESOURCES), np.float32),
+        rack=np.zeros(B, np.int32),
+        host=np.zeros(B, np.int32),
+        alive=np.zeros(B, bool),
+        new=np.zeros(B, bool),
+        disk_capacity=np.zeros((B, D), np.float32),
+        disk_alive=np.zeros((B, D), bool),
+    )
+    for i, b in enumerate(brokers):
+        cap = np.asarray(
+            b.capacity if b.capacity is not None else [100.0, 1e5, 1e5, 1e6], np.float32
+        )
+        if b.disk_capacities:
+            dc = np.asarray(b.disk_capacities, np.float32)
+            out.disk_capacity[i, : len(dc)] = dc
+            out.disk_alive[i, : len(dc)] = True
+            cap = cap.copy()
+            cap[Resource.DISK] = dc.sum()
+        else:
+            out.disk_capacity[i, 0] = cap[Resource.DISK]
+            out.disk_alive[i, 0] = True
+        for bad in b.bad_disks or []:
+            out.disk_alive[i, bad] = False
+        out.capacity[i] = cap
+        out.rack[i] = rack_idx[b.rack]
+        out.host[i] = host_idx[b.host if b.host is not None else f"__host_{b.broker_id}"]
+        out.alive[i] = b.alive
+        out.new[i] = b.new_broker
+    return out
+
+
+def _assemble_state(
+    ba: _BrokerArrays,
+    shape: ClusterShape,
+    r_broker, r_part, r_topic, r_pos, r_leader, r_valid, r_offline, r_disk,
+    r_ll, r_fl,
+) -> ClusterState:
+    import jax.numpy as jnp
+
+    B = ba.capacity.shape[0]
+    return ClusterState(
+        replica_broker=jnp.asarray(r_broker),
+        replica_partition=jnp.asarray(r_part),
+        replica_topic=jnp.asarray(r_topic),
+        replica_pos=jnp.asarray(r_pos),
+        replica_is_leader=jnp.asarray(r_leader),
+        replica_valid=jnp.asarray(r_valid),
+        replica_orig_broker=jnp.asarray(r_broker.copy()),
+        replica_offline=jnp.asarray(r_offline),
+        replica_disk=jnp.asarray(r_disk),
+        replica_load_leader=jnp.asarray(r_ll),
+        replica_load_follower=jnp.asarray(r_fl),
+        broker_capacity=jnp.asarray(ba.capacity),
+        broker_rack=jnp.asarray(ba.rack),
+        broker_host=jnp.asarray(ba.host),
+        broker_alive=jnp.asarray(ba.alive),
+        broker_new=jnp.asarray(ba.new),
+        broker_valid=jnp.ones(B, bool),
+        disk_capacity=jnp.asarray(ba.disk_capacity),
+        disk_alive=jnp.asarray(ba.disk_alive),
+        shape=shape,
+    )
+
+
+def build_state_columnar(
+    brokers: list[BrokerSpec],
+    cols,
+    leader_load: np.ndarray,
+    follower_load: np.ndarray,
+    *,
+    replica_capacity: int | None = None,
+) -> tuple[ClusterState, ClusterCatalog]:
+    """Vectorized twin of ClusterModelBuilder.build for monitor-shaped input.
+
+    cols: a monitor.topology.TopologyColumns (array-encoded partition list);
+    leader_load / follower_load: float32 [P, 4] in cols' partition order.
+    Replica-level population is pure numpy (no per-replica Python), which is
+    what keeps model generation sub-second at reference scale — the role of
+    the reference's bulk setReplicaLoad path (model/ClusterModel.java:684)
+    under its cluster-model-creation timer.  Output is identical (same
+    ordering, catalog, and arrays) to feeding the same data through
+    ClusterModelBuilder one PartitionSpec at a time.
+    """
+    ba = _broker_arrays(brokers)
+    B = ba.capacity.shape[0]
+    broker_alive = ba.alive
+    disk_alive = ba.disk_alive
+
+    # partitions sorted by (topic name, partition number) — the builder's
+    # canonical order.  topic ids in cols are first-seen; rank them by name.
+    T = len(cols.topic_names)
+    by_name = sorted(range(T), key=lambda i: cols.topic_names[i])
+    topics_sorted = [cols.topic_names[i] for i in by_name]
+    rank_of_tid = np.empty(T, np.int32)
+    rank_of_tid[by_name] = np.arange(T, dtype=np.int32)
+    part_rank = rank_of_tid[cols.part_topic]
+    order = np.lexsort((cols.part_num, part_rank))
+    P = order.size
+
+    counts_o = cols.replica_counts[order].astype(np.int64)
+    total = int(counts_o.sum())
+    R = replica_capacity or total
+    if R < total:
+        raise ValueError(f"replica_capacity {R} < actual replicas {total}")
+
+    # gather each sorted partition's replica segment from the flat array
+    seg_start = np.repeat(cols.replica_offsets[order], counts_o)
+    new_off = np.concatenate(([0], np.cumsum(counts_o)))
+    within = np.arange(total, dtype=np.int64) - np.repeat(new_off[:-1], counts_o)
+    src = seg_start + within
+
+    r_broker = np.zeros(R, np.int32)
+    r_part = np.zeros(R, np.int32)
+    r_topic = np.zeros(R, np.int32)
+    r_pos = np.zeros(R, np.int32)
+    r_leader = np.zeros(R, bool)
+    r_valid = np.zeros(R, bool)
+    r_offline = np.zeros(R, bool)
+    r_disk = np.zeros(R, np.int32)
+    r_ll = np.zeros((R, NUM_RESOURCES), np.float32)
+    r_fl = np.zeros((R, NUM_RESOURCES), np.float32)
+
+    r_broker[:total] = cols.replica_broker[src]
+    r_part[:total] = np.repeat(np.arange(P, dtype=np.int32), counts_o)
+    r_topic[:total] = np.repeat(part_rank[order], counts_o)
+    r_pos[:total] = within
+    r_leader[:total] = within == np.repeat(
+        cols.part_leader_pos[order].astype(np.int64), counts_o
+    )
+    r_valid[:total] = True
+    r_offline[:total] = (
+        ~broker_alive[r_broker[:total]]
+        | ~disk_alive[r_broker[:total], 0]  # monitor places replicas on disk 0
+    )
+    ll_sorted = np.asarray(leader_load, np.float32)[order]
+    fl_sorted = np.asarray(follower_load, np.float32)[order]
+    r_ll[:total] = np.repeat(ll_sorted, counts_o, axis=0)
+    r_fl[:total] = np.repeat(fl_sorted, counts_o, axis=0)
+
+    names_by_part = [cols.topic_names[t] for t in cols.part_topic[order]]
+    catalog = ClusterCatalog(
+        topics=tuple(topics_sorted),
+        partitions=tuple(zip(names_by_part, cols.part_num[order].tolist())),
+        racks=tuple(ba.racks),
+        hosts=tuple(ba.hosts),
+    )
+    shape = ClusterShape(
+        num_replicas=R,
+        num_brokers=B,
+        num_partitions=P,
+        num_topics=max(len(topics_sorted), 1),
+        num_racks=max(len(ba.racks), 1),
+        num_hosts=max(len(ba.hosts), 1),
+        max_disks_per_broker=ba.D,
+    )
+    state = _assemble_state(
+        ba, shape,
+        r_broker, r_part, r_topic, r_pos, r_leader, r_valid, r_offline, r_disk,
+        r_ll, r_fl,
+    )
+    return state, catalog
+
+
 class ClusterModelBuilder:
     def __init__(self, *, replica_capacity: int | None = None, follower_cpu_fraction: float = 0.3):
         self._brokers: list[BrokerSpec] = []
@@ -95,47 +293,14 @@ class ClusterModelBuilder:
         return self
 
     def build(self) -> ClusterState:
+        ba = _broker_arrays(self._brokers)
         brokers = sorted(self._brokers, key=lambda b: b.broker_id)
-        ids = [b.broker_id for b in brokers]
-        if ids != list(range(len(ids))):
-            raise ValueError(f"broker ids must be dense 0..B-1, got {ids}")
         B = len(brokers)
-        racks = sorted({b.rack for b in brokers})
-        rack_idx = {r: i for i, r in enumerate(racks)}
-        hosts = sorted({b.host if b.host is not None else f"__host_{b.broker_id}" for b in brokers})
-        host_idx = {h: i for i, h in enumerate(hosts)}
+        racks, hosts, D = ba.racks, ba.hosts, ba.D
+        broker_alive = ba.alive
+        disk_alive = ba.disk_alive
         topics = sorted({p.topic for p in self._partitions})
         topic_idx = {t: i for i, t in enumerate(topics)}
-
-        D = max((len(b.disk_capacities) for b in brokers if b.disk_capacities), default=1)
-
-        broker_capacity = np.zeros((B, NUM_RESOURCES), np.float32)
-        broker_rack = np.zeros(B, np.int32)
-        broker_host = np.zeros(B, np.int32)
-        broker_alive = np.zeros(B, bool)
-        broker_new = np.zeros(B, bool)
-        disk_capacity = np.zeros((B, D), np.float32)
-        disk_alive = np.zeros((B, D), bool)
-        for i, b in enumerate(brokers):
-            cap = np.asarray(
-                b.capacity if b.capacity is not None else [100.0, 1e5, 1e5, 1e6], np.float32
-            )
-            if b.disk_capacities:
-                dc = np.asarray(b.disk_capacities, np.float32)
-                disk_capacity[i, : len(dc)] = dc
-                disk_alive[i, : len(dc)] = True
-                cap = cap.copy()
-                cap[Resource.DISK] = dc.sum()
-            else:
-                disk_capacity[i, 0] = cap[Resource.DISK]
-                disk_alive[i, 0] = True
-            for bad in b.bad_disks or []:
-                disk_alive[i, bad] = False
-            broker_capacity[i] = cap
-            broker_rack[i] = rack_idx[b.rack]
-            broker_host[i] = host_idx[b.host if b.host is not None else f"__host_{b.broker_id}"]
-            broker_alive[i] = b.alive
-            broker_new[i] = b.new_broker
 
         parts = sorted(self._partitions, key=lambda p: (p.topic, p.partition))
         P = len(parts)
@@ -192,27 +357,8 @@ class ClusterModelBuilder:
             num_hosts=max(len(hosts), 1),
             max_disks_per_broker=D,
         )
-        import jax.numpy as jnp
-
-        return ClusterState(
-            replica_broker=jnp.asarray(r_broker),
-            replica_partition=jnp.asarray(r_part),
-            replica_topic=jnp.asarray(r_topic),
-            replica_pos=jnp.asarray(r_pos),
-            replica_is_leader=jnp.asarray(r_leader),
-            replica_valid=jnp.asarray(r_valid),
-            replica_orig_broker=jnp.asarray(r_broker.copy()),
-            replica_offline=jnp.asarray(r_offline),
-            replica_disk=jnp.asarray(r_disk),
-            replica_load_leader=jnp.asarray(r_ll),
-            replica_load_follower=jnp.asarray(r_fl),
-            broker_capacity=jnp.asarray(broker_capacity),
-            broker_rack=jnp.asarray(broker_rack),
-            broker_host=jnp.asarray(broker_host),
-            broker_alive=jnp.asarray(broker_alive),
-            broker_new=jnp.asarray(broker_new),
-            broker_valid=jnp.ones(B, bool),
-            disk_capacity=jnp.asarray(disk_capacity),
-            disk_alive=jnp.asarray(disk_alive),
-            shape=shape,
+        return _assemble_state(
+            ba, shape,
+            r_broker, r_part, r_topic, r_pos, r_leader, r_valid, r_offline,
+            r_disk, r_ll, r_fl,
         )

@@ -245,6 +245,57 @@ class WindowedMetricSampleAggregator:
         for i, e in enumerate(entities):
             self.add_sample(e, int(times_ms[i]), values[i], None if groups is None else groups[i])
 
+    def add_samples_columnar(
+        self, entities: list, time_ms: int, values: np.ndarray, groups=None
+    ) -> bool:
+        """Vectorized add of one sample per entity, all stamped time_ms.
+
+        The scale path for a sampler that drains a whole fetch window at
+        once: per-strategy accumulation runs as array ops (np.add.at /
+        np.maximum.at honor duplicate entities exactly like repeated
+        add_sample calls).  values: f32[N, M].  Returns False when the
+        window already rolled out.
+        """
+        with self._lock:
+            values = np.asarray(values, np.float32)
+            w = time_ms // self.window_ms
+            if self._current_window is None or w > self._current_window:
+                self._roll_to(w)
+            if w < (self._oldest_window or 0):
+                return False
+            rows = np.fromiter(
+                (self._row(e) for e in entities), np.int64, count=len(entities)
+            )
+            if groups is not None:
+                for e, g in zip(entities, groups):
+                    self._entity_group[e] = g
+            slot = self._slot(w)
+            acc = self._acc[:, slot]  # [cap, M] view
+            counts = self._counts[:, slot]
+            avg_ids = np.nonzero(self._strategies == 0)[0]
+            mx_ids = np.nonzero(self._strategies == 1)[0]
+            lat_ids = np.nonzero(self._strategies == 2)[0]
+            # MAX: rows at count 0 take the incoming value, so seed them
+            # with -inf before the running maximum
+            fresh = rows[counts[rows] == 0]
+            if mx_ids.size:
+                acc[np.ix_(fresh, mx_ids)] = -np.inf
+                np.maximum.at(acc, (rows[:, None], mx_ids[None, :]), values[:, mx_ids])
+            if avg_ids.size:
+                np.add.at(acc, (rows[:, None], avg_ids[None, :]), values[:, avg_ids])
+            if lat_ids.size:
+                ts = self._latest_ts[:, slot]
+                newer = time_ms >= ts[np.ix_(rows, lat_ids)]
+                # plain fancy assignment: later duplicates win, like the
+                # per-sample path's >= check at equal timestamps
+                upd = np.where(newer, values[:, lat_ids], acc[np.ix_(rows, lat_ids)])
+                acc[np.ix_(rows, lat_ids)] = upd
+                ts[np.ix_(rows, lat_ids)] = np.where(
+                    newer, time_ms, ts[np.ix_(rows, lat_ids)]
+                )
+            np.add.at(counts, rows, 1)
+            return True
+
     # ------------------------------------------------------------------
 
     def aggregate(self, options: AggregationOptions | None = None) -> AggregationResult:
@@ -265,15 +316,21 @@ class WindowedMetricSampleAggregator:
                 raise ValueError("no completed windows yet")
             widx = np.arange(newest, oldest - 1, -1, np.int64)  # newest -> oldest
             slots = widx % self._W
-            acc = self._acc[:E][:, slots]  # [E, Wv, M]
+            # fancy indexing yields a fresh array — safe to mutate in place
+            # (no second copy; at reference scale these are ~100MB tensors)
+            values = self._acc[:E][:, slots]  # [E, Wv, M]
             counts = self._counts[:E][:, slots]  # [E, Wv]
-            ts = self._latest_ts[:E][:, slots]
 
-            # window values by strategy
+            # window values by strategy.  AVG dominates the metric def
+            # (35/36 Kafka metrics), so divide the WHOLE tensor in place and
+            # restore the few non-AVG columns — a full-array op beats a
+            # fancy gather+scatter over nearly all columns at 200k entities
             avg = self._strategies == 0
-            values = acc.copy()
+            nonavg = np.nonzero(~avg)[0]
+            saved = values[:, :, nonavg].copy()
             with np.errstate(invalid="ignore", divide="ignore"):
-                values[:, :, avg] = acc[:, :, avg] / np.maximum(counts[..., None], 1)
+                values /= np.maximum(counts[..., None], 1)
+            values[:, :, nonavg] = saved
 
             ext = np.full((E, widx.size), Extrapolation.NO_VALID_EXTRAPOLATION, np.int8)
             ext[counts >= 1] = Extrapolation.FORCED_INSUFFICIENT
@@ -310,17 +367,20 @@ class WindowedMetricSampleAggregator:
             too_many_ext = extrapolated.sum(1) > options.max_allowed_extrapolations_per_entity
             entity_valid = window_valid.all(axis=1) & ~too_many_ext
 
-            # group validity: all entities of the group must be valid
-            keys = list(self._entity_rows)
-            group_of = np.array(
-                [hash(self._entity_group.get(k, k)) for k in keys], np.int64
-            )
-            entity_group_valid = entity_valid.copy()
+            # group validity: all entities of the group must be valid.
+            # The hash pass over E entities only runs when group
+            # granularity is requested — the default ENTITY path skips it
+            entity_group_valid = entity_valid
             if options.granularity == "ENTITY_GROUP":
-                for grp in np.unique(group_of):
-                    m = group_of == grp
-                    if not entity_valid[m].all():
-                        entity_group_valid[m] = False
+                keys = list(self._entity_rows)
+                group_of = np.fromiter(
+                    (hash(self._entity_group.get(k, k)) for k in keys),
+                    np.int64,
+                    count=len(keys),
+                )
+                _, inv = np.unique(group_of, return_inverse=True)
+                bad_groups = np.bincount(inv, weights=~entity_valid) > 0
+                entity_group_valid = entity_valid & ~bad_groups[inv]
                 entity_valid = entity_group_valid
 
             ratio_by_window = window_valid.mean(axis=0) if E else np.zeros(widx.size)
@@ -350,3 +410,30 @@ class WindowedMetricSampleAggregator:
 
     def entity_index(self) -> dict:
         return dict(self._entity_rows)
+
+    def entity_key_rows(self) -> tuple:
+        """(sorted int64 keys, matching rows) for vectorized entity lookup.
+
+        Keys encode (entity.group << 32) | entity.partition-or-id — the
+        partition-entity layout the monitor's columnar model-generation
+        path joins against with np.searchsorted instead of E dict probes.
+        Cached until the entity set grows.
+        """
+        with self._lock:  # sample ingestion grows the dict concurrently
+            cached = getattr(self, "_key_rows_cache", None)
+            if cached is not None and cached[0] == len(self._entity_rows):
+                return cached[1]
+            keys = np.fromiter(
+                (
+                    (int(getattr(e, "topic", getattr(e, "group", 0))) << 32)
+                    | int(getattr(e, "partition", getattr(e, "broker_id", 0)))
+                    for e in self._entity_rows
+                ),
+                np.int64,
+                count=len(self._entity_rows),
+            )
+            rows = np.fromiter(self._entity_rows.values(), np.int64, count=keys.size)
+            order = np.argsort(keys)
+            out = (keys[order], rows[order])
+            self._key_rows_cache = (len(self._entity_rows), out)
+            return out

@@ -23,7 +23,7 @@ import time
 import numpy as np
 
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
-from cruise_control_tpu.models.builder import BrokerSpec, ClusterModelBuilder, PartitionSpec
+from cruise_control_tpu.models.builder import BrokerSpec
 from cruise_control_tpu.models.state import ClusterState
 from cruise_control_tpu.monitor.aggregator import (
     AggregationOptions,
@@ -36,7 +36,6 @@ from cruise_control_tpu.monitor.completeness import (
 )
 from cruise_control_tpu.monitor.cpu_model import follower_cpu_util_array
 from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF, MetricDef
-from cruise_control_tpu.monitor.sampling import PartitionEntity
 from cruise_control_tpu.monitor.topology import ClusterTopology, MetadataProvider
 
 
@@ -259,21 +258,23 @@ class LoadMonitor:
         takes the newest valid window (reference model/Load.expectedUtilizationFor,
         model/Load.java:84-118 — AVG vs LATEST per KafkaMetricDef strategy).
         """
-        values = agg.values  # [E, W, M]
+        # slice the 4 consumed metric columns FIRST: the reduction then
+        # runs on [E, W, 4] instead of the full [E, W, M] tensor
+        cols = [self._cpu_id, self._nwin_id, self._nwout_id, self._disk_id]
+        values = agg.values[:, :, cols]  # [E, W, 4]
         valid = agg.window_valid  # [E, W]
         n_valid = np.maximum(valid.sum(1), 1)  # [E]
-        vm = valid[..., None]
 
-        mean = (values * vm).sum(1) / n_valid[:, None]  # [E, M]
+        mean = (values * valid[..., None]).sum(1) / n_valid[:, None]  # [E, 4]
         # newest valid window per entity (window axis is newest -> oldest)
         first_valid = np.argmax(valid, axis=1)  # [E]
-        latest = values[np.arange(values.shape[0]), first_valid]  # [E, M]
+        latest = values[np.arange(values.shape[0]), first_valid]  # [E, 4]
 
-        load = np.zeros((values.shape[0], NUM_RESOURCES), np.float32)
-        load[:, Resource.CPU] = mean[:, self._cpu_id]
-        load[:, Resource.NW_IN] = mean[:, self._nwin_id]
-        load[:, Resource.NW_OUT] = mean[:, self._nwout_id]
-        load[:, Resource.DISK] = latest[:, self._disk_id]
+        load = np.empty((values.shape[0], NUM_RESOURCES), np.float32)
+        load[:, Resource.CPU] = mean[:, 0]
+        load[:, Resource.NW_IN] = mean[:, 1]
+        load[:, Resource.NW_OUT] = mean[:, 2]
+        load[:, Resource.DISK] = latest[:, 3]
         return load
 
     def _build_state(
@@ -283,14 +284,8 @@ class LoadMonitor:
         *,
         allow_capacity_estimation: bool = True,
     ) -> ClusterState:
-        entity_rows = self.partition_aggregator.entity_index()
         loads = self._window_reduced_loads(agg)
-
-        topic_ids: dict[str, int] = {}
-        for p in topology.partitions:
-            topic_ids.setdefault(p.topic, len(topic_ids))
-
-        builder = ClusterModelBuilder(replica_capacity=self._replica_capacity)
+        broker_specs = []
         for b in topology.brokers:
             info = self.capacity_resolver.capacity_for_broker(b.rack, b.host, b.broker_id)
             if not allow_capacity_estimation and info.estimation_info:
@@ -309,7 +304,7 @@ class LoadMonitor:
                 disk_caps = [info.disk_capacities.get(d, 0.0) for d in logdirs]
                 bad = set(b.offline_logdirs)
                 bad_disks = [i for i, d in enumerate(logdirs) if d in bad] or None
-            builder.add_broker(
+            broker_specs.append(
                 BrokerSpec(
                     b.broker_id,
                     rack=b.rack,
@@ -329,37 +324,42 @@ class LoadMonitor:
             follower_cpu = follower_cpu_util_array(
                 loads, leader_cpu, weights=self.cpu_weights
             )
-        alive = topology.alive_broker_ids()
-        for p in topology.partitions:
-            tid = topic_ids[p.topic]
-            entity = PartitionEntity(tid, p.partition)
-            row = entity_rows.get(entity)
-            if row is None:
-                # unmonitored partition: zero load (reference populates only
-                # monitored partitions; include_all_topics keeps it in the model)
-                leader_load = np.zeros(NUM_RESOURCES, np.float32)
-                follower = np.zeros(NUM_RESOURCES, np.float32)
-            else:
-                leader_load = loads[row]
-                follower = leader_load.copy()
-                follower[Resource.NW_OUT] = 0.0
-                follower[Resource.CPU] = follower_cpu[row]
-            # leader position within the replica list
-            leader_pos = 0
-            if p.leader in p.replicas:
-                leader_pos = list(p.replicas).index(p.leader)
-            builder.add_partition(
-                PartitionSpec(
-                    p.topic,
-                    p.partition,
-                    list(p.replicas),
-                    leader_load,
-                    follower_load=follower,
-                    leader_pos=leader_pos,
-                )
-            )
-        state = builder.build()
-        self.last_catalog = builder.catalog
+
+        # columnar join: topology partitions -> aggregator entity rows.
+        # Unmonitored partitions get zero load (reference populates only
+        # monitored partitions; include_all_topics keeps them in the model).
+        cols = topology.columns()
+        part_keys = (cols.part_topic.astype(np.int64) << 32) | cols.part_num
+        ekeys, erows = self.partition_aggregator.entity_key_rows()
+        P = part_keys.size
+        if ekeys.size:
+            pos = np.minimum(np.searchsorted(ekeys, part_keys), ekeys.size - 1)
+            monitored = ekeys[pos] == part_keys
+            row_of_part = np.where(monitored, erows[pos], 0)
+        else:
+            monitored = np.zeros(P, bool)
+            row_of_part = np.zeros(P, np.int64)
+        leader_load = np.zeros((P, NUM_RESOURCES), np.float32)
+        follower_load = np.zeros((P, NUM_RESOURCES), np.float32)
+        if np.any(monitored):
+            m_rows = row_of_part[monitored]
+            ll = loads[m_rows]
+            fl = ll.copy()
+            fl[:, Resource.NW_OUT] = 0.0
+            fl[:, Resource.CPU] = follower_cpu[m_rows]
+            leader_load[monitored] = ll
+            follower_load[monitored] = fl
+
+        from cruise_control_tpu.models.builder import build_state_columnar
+
+        state, catalog = build_state_columnar(
+            broker_specs,
+            cols,
+            leader_load,
+            follower_load,
+            replica_capacity=self._replica_capacity,
+        )
+        self.last_catalog = catalog
         return state
 
     # ------------------------------------------------------------------

@@ -713,6 +713,36 @@ class CruiseControlApp:
         out: dict = {}
         from cruise_control_tpu.detector import AnomalyType
 
+        # validate the WHOLE request before applying any of it: a 400 must
+        # not leave earlier side effects (e.g. a self-healing toggle)
+        # silently committed
+        conc = {}
+        for pname, kwarg, cast in (
+            ("concurrent_partition_movements_per_broker", "inter_broker", int),
+            ("concurrent_intra_broker_partition_movements", "intra_broker", int),
+            ("concurrent_leader_movements", "leadership", int),
+            ("execution_progress_check_interval_ms", "progress_check_interval_s",
+             lambda v: int(v) / 1000.0),
+        ):
+            raw = params.get(pname, [None])[0]
+            if raw is not None:
+                try:
+                    conc[kwarg] = cast(raw)
+                except (TypeError, ValueError) as e:
+                    raise BadRequest(f"bad {pname}: {raw!r}") from e
+        for kwarg, v in conc.items():
+            if (kwarg == "progress_check_interval_s" and v <= 0) or (
+                kwarg != "progress_check_interval_s" and v < 1
+            ):
+                raise BadRequest(f"bad {kwarg}: {v}")
+        if conc and not self.cc.executor.has_ongoing_execution:
+            # the reference rejects ChangeExecutionConcurrency when nothing
+            # is executing — overrides die with the execution, so accepting
+            # one here would 200 a silent no-op
+            raise BadRequest(
+                "cannot change execution concurrency: no ongoing execution"
+            )
+
         enable = params.get("enable_self_healing_for", [None])[0]
         disable = params.get("disable_self_healing_for", [None])[0]
         for arg, value in ((enable, True), (disable, False)):
@@ -733,28 +763,7 @@ class CruiseControlApp:
             out["recentlyDemotedBrokers"] = sorted(self.cc.executor.demoted_brokers)
         # mid-execution concurrency change: applied on the executor's next
         # progress tick, so a live rebalance can be throttled or unstuck
-        conc = {}
-        for pname, kwarg, cast in (
-            ("concurrent_partition_movements_per_broker", "inter_broker", int),
-            ("concurrent_intra_broker_partition_movements", "intra_broker", int),
-            ("concurrent_leader_movements", "leadership", int),
-            ("execution_progress_check_interval_ms", "progress_check_interval_s",
-             lambda v: int(v) / 1000.0),
-        ):
-            raw = params.get(pname, [None])[0]
-            if raw is not None:
-                try:
-                    conc[kwarg] = cast(raw)
-                except (TypeError, ValueError) as e:
-                    raise BadRequest(f"bad {pname}: {raw!r}") from e
         if conc:
-            # the reference rejects ChangeExecutionConcurrency when nothing
-            # is executing — overrides die with the execution, so accepting
-            # one here would 200 a silent no-op
-            if not self.cc.executor.has_ongoing_execution:
-                raise BadRequest(
-                    "cannot change execution concurrency: no ongoing execution"
-                )
             try:
                 out["requestedConcurrency"] = (
                     self.cc.executor.set_requested_concurrency(**conc)

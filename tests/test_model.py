@@ -146,3 +146,79 @@ def test_jbod_disk_modeling():
     agg = compute_aggregates(s)
     dl = np.asarray(agg.disk_load)
     assert np.isclose(dl[0, 1], 300.0) and dl[0, 0] == 0.0
+
+
+def test_columnar_build_matches_builder():
+    """build_state_columnar output is array-identical to feeding the same
+    topology through ClusterModelBuilder one PartitionSpec at a time."""
+    import numpy as np
+
+    from cruise_control_tpu.models.builder import (
+        BrokerSpec,
+        ClusterModelBuilder,
+        PartitionSpec,
+        build_state_columnar,
+    )
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    topo = synthetic_topology(
+        num_brokers=7, topics={"zeta": 5, "alpha": 9, "mid": 3}, seed=11
+    )
+    rng = np.random.default_rng(0)
+    cols = topo.columns()
+    P = len(topo.partitions)
+    ll = rng.uniform(0, 50, (P, 4)).astype(np.float32)
+    fl = rng.uniform(0, 20, (P, 4)).astype(np.float32)
+
+    def spec(b):
+        return BrokerSpec(
+            b.broker_id, rack=b.rack, host=b.host, alive=(b.broker_id != 3),
+            capacity=np.asarray([10.0, 2e5, 3e5, 4e6], np.float32),
+        )
+
+    builder = ClusterModelBuilder()
+    for b in topo.brokers:
+        builder.add_broker(spec(b))
+    for i, p in enumerate(topo.partitions):
+        lp = p.replicas.index(p.leader) if p.leader in p.replicas else 0
+        builder.add_partition(PartitionSpec(
+            p.topic, p.partition, list(p.replicas), ll[i],
+            follower_load=fl[i], leader_pos=lp,
+        ))
+    want = builder.build()
+
+    got, catalog = build_state_columnar(
+        [spec(b) for b in topo.brokers], cols, ll, fl
+    )
+    assert catalog == builder.catalog
+    assert got.shape == want.shape
+    for f in (
+        "replica_broker", "replica_partition", "replica_topic", "replica_pos",
+        "replica_is_leader", "replica_valid", "replica_offline", "replica_disk",
+        "replica_load_leader", "replica_load_follower", "broker_capacity",
+        "broker_rack", "broker_host", "broker_alive", "disk_capacity",
+        "disk_alive",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f)), np.asarray(getattr(want, f)), err_msg=f
+        )
+
+
+def test_columnar_build_respects_replica_capacity_padding():
+    import numpy as np
+
+    from cruise_control_tpu.models.builder import BrokerSpec, build_state_columnar
+    from cruise_control_tpu.testing.synthetic import synthetic_topology
+
+    topo = synthetic_topology(num_brokers=4, topics={"T": 6}, seed=1)
+    cols = topo.columns()
+    P = len(topo.partitions)
+    ll = np.ones((P, 4), np.float32)
+    state, _ = build_state_columnar(
+        [BrokerSpec(b.broker_id, rack=b.rack, host=b.host) for b in topo.brokers],
+        cols, ll, ll * 0.5, replica_capacity=100,
+    )
+    assert state.shape.num_replicas == 100
+    n = int(np.asarray(state.replica_valid).sum())
+    assert n == topo.num_replicas
+    assert not np.asarray(state.replica_valid)[n:].any()
