@@ -1,0 +1,156 @@
+"""AOT export cache: skip Python tracing/lowering on warm service starts.
+
+The persistent XLA compilation cache (compilation_cache.py) removes the
+*compile* cost of a warm start, but jax.jit still re-traces and re-lowers
+every engine program in each fresh process — ~6s of pure Python/StableHLO
+work at north-star scale (scripts/profile_warmup.py).  This module
+serializes the EXPORTED program (jax.export) to disk once per
+(function, shape bucket, config, code version); later processes
+deserialize StableHLO in milliseconds and go straight to the XLA cache.
+
+Plays the role the reference gets from the JVM's always-warm process
+model: its GoalOptimizer never pays a per-process compile because it
+never restarts the compiler (analyzer/GoalOptimizer.java:124-175
+amortizes via the proposal precompute loop instead).
+
+Usage: `enable_aot_cache(dir)` at startup (bench.py, service main);
+`AotCache.current()` returns the active cache or None.  Engine wraps its
+jitted functions through `wrap()`, which transparently falls back to the
+plain jit path on any export/deserialize failure.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_active: "AotCache | None" = None
+_registered: set[type] = set()
+_reg_lock = threading.Lock()
+
+
+def register_for_export(*classes) -> None:
+    """Idempotently register custom pytree dataclasses for jax.export
+    serialization (auxdata is pickled — metadata fields like ClusterShape
+    are plain picklable dataclasses)."""
+    from jax import export
+
+    with _reg_lock:
+        for cls in classes:
+            if cls in _registered:
+                continue
+            export.register_pytree_node_serialization(
+                cls,
+                serialized_name=f"{cls.__module__}.{cls.__qualname__}",
+                serialize_auxdata=pickle.dumps,
+                deserialize_auxdata=lambda b: pickle.loads(bytes(b)),
+            )
+            _registered.add(cls)
+
+
+def enable_aot_cache(directory: str | None) -> "AotCache | None":
+    """Activate the process-wide AOT cache (None/'' disables)."""
+    global _active
+    if not directory:
+        _active = None
+        return None
+    _active = AotCache(os.path.expanduser(directory))
+    return _active
+
+
+class AotCache:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @staticmethod
+    def current() -> "AotCache | None":
+        return _active
+
+    def path_for(self, name: str, fingerprint: str) -> str:
+        return os.path.join(self.directory, f"{name}-{fingerprint}.jaxexp")
+
+    def wrap(self, jit_fn, name: str, fingerprint: str):
+        return _AotFn(self, jit_fn, name, fingerprint)
+
+
+def fingerprint_of(*parts) -> str:
+    """Stable hex key over arbitrary repr()-able parts + jax version +
+    backend platform (an export for tpu must not be loaded on cpu)."""
+    h = hashlib.sha256()
+    h.update(jax.__version__.encode())
+    h.update(jax.default_backend().encode())
+    for p in parts:
+        h.update(repr(p).encode())
+    return h.hexdigest()[:20]
+
+
+def source_fingerprint(module) -> str:
+    """Hash of a module's source — code changes invalidate saved programs."""
+    import inspect
+
+    try:
+        return hashlib.sha256(inspect.getsource(module).encode()).hexdigest()[:12]
+    except OSError:
+        return "nosource"
+
+
+class _AotFn:
+    """Callable wrapping a jitted function with disk-backed AOT export.
+
+    First call in a process: load the serialized export if present
+    (deserialize is ~ms; XLA compile then hits the persistent cache), else
+    export once (ONE trace+lower, same cost the jit path would pay),
+    persist it, and call the exported program.  Any failure logs once and
+    falls back to the plain jit path permanently for this instance.
+    """
+
+    def __init__(self, cache: AotCache, jit_fn, name: str, fingerprint: str):
+        self._cache = cache
+        self._jit = jit_fn
+        self._name = name
+        self._path = cache.path_for(name, fingerprint)
+        self._call = None
+        self._lock = threading.Lock()
+
+    def _ensure(self, args, kwargs):
+        if self._call is not None:
+            return
+        with self._lock:
+            if self._call is not None:
+                return
+            from jax import export
+
+            if os.path.exists(self._path):
+                with open(self._path, "rb") as f:
+                    self._call = export.deserialize(bytearray(f.read())).call
+                return
+            exp = export.export(self._jit)(*args, **kwargs)
+            blob = exp.serialize()
+            tmp = f"{self._path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._path)
+            self._call = exp.call
+
+    def __call__(self, *args, **kwargs):
+        if self._call is None:
+            try:
+                self._ensure(args, kwargs)
+            except Exception as e:  # noqa: BLE001 — AOT is an optimization,
+                # never a correctness dependency: any export/deserialize
+                # failure reverts to the ordinary jit path
+                log.warning("aot cache disabled for %s: %r", self._name, e)
+                self._call = self._jit
+        return self._call(*args, **kwargs)
+
+    # introspection passthroughs used by profiling scripts
+    def __getattr__(self, item):
+        return getattr(self._jit, item)
