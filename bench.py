@@ -455,18 +455,13 @@ def config_4(scale_order):
 
 
 def main():
-    from cruise_control_tpu.common.aot_cache import enable_aot_cache
     from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
 
-    # persistent XLA cache: repeat bench runs skip the ~70s warm-up compile
+    # persistent XLA cache: repeat bench runs skip the ~70s warm-up compile,
+    # making warmup_s the honest time-to-first-proposal of a restarted
+    # service with a warm cache
     enable_persistent_cache(
         os.environ.get("BENCH_COMPILE_CACHE", "~/.cache/cruise_control_tpu/xla")
-    )
-    # AOT export cache: repeat bench runs also skip trace/lower (~6s at
-    # north-star scale) — together these make warmup_s the honest
-    # time-to-first-proposal of a restarted service with warm caches
-    enable_aot_cache(
-        os.environ.get("BENCH_AOT_CACHE", "~/.cache/cruise_control_tpu/aot")
     )
     scale = os.environ.get("BENCH_SCALE", "auto")
     scale_order = [scale] if scale != "auto" else ["north_star", "mid", "small"]

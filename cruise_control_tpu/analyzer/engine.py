@@ -384,44 +384,14 @@ class Engine:
             self.K_r = config.num_candidates - self.K_l - self.K_s
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
         self.statics = build_statics(state, options)
-        jit = self._make_jit(chain, constraint, options)
-        self._scan = jit("scan", self._scan_impl)
-        self._jit_refresh = jit("refresh", self._refresh_impl)
-        self._jit_objective = jit("objective", self._objective_impl)
-        self._jit_plan = jit("plan", self._plan_impl)
-        self._jit_violations = jit("violations", self._violations_impl)
-        self._jit_cheap_violations = jit("cheap_violations", self._cheap_violations_impl)
-        self._jit_round_prep = jit("round_prep", self._round_prep_impl)
-        self._jit_init = jit("init", self._init_impl)
-
-    def _make_jit(self, chain, constraint, options):
-        """jax.jit, optionally wrapped with the disk-backed AOT export
-        cache (common/aot_cache.py) so warm service starts skip Python
-        tracing/lowering.  The fingerprint covers everything baked into
-        the compiled program: shape bucket, search config, goal chain
-        (names AND weights), constraint numbers, options, and the engine
-        source itself."""
-        from cruise_control_tpu.common import aot_cache
-
-        cache = aot_cache.AotCache.current()
-        if cache is None:
-            return lambda name, impl: jax.jit(impl)
-        import sys
-
-        aot_cache.register_for_export(
-            EngineCarry, EngineStatics, SamplingPlan, ClusterState
-        )
-        # options are NOT part of the key: they only shape statics VALUES
-        # (dest_ok/lead_ok masks), which are traced inputs — and their
-        # numpy-array reprs truncate, which would destabilize the hash
-        fp = aot_cache.fingerprint_of(
-            self.shape,
-            self.config,
-            [(g.name, float(w)) for g, w in zip(chain.goals, chain.weights)],
-            constraint,
-            aot_cache.source_fingerprint(sys.modules[__name__]),
-        )
-        return lambda name, impl: cache.wrap(jax.jit(impl), f"engine-{name}", fp)
+        self._scan = jax.jit(self._scan_impl)
+        self._jit_refresh = jax.jit(self._refresh_impl)
+        self._jit_objective = jax.jit(self._objective_impl)
+        self._jit_plan = jax.jit(self._plan_impl)
+        self._jit_violations = jax.jit(self._violations_impl)
+        self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
+        self._jit_round_prep = jax.jit(self._round_prep_impl)
+        self._jit_init = jax.jit(self._init_impl)
 
     # convenience for call sites that held `engine.state`
     @property

@@ -123,11 +123,6 @@ def _analyzer_defs() -> ConfigDef:
              "~/.cache/cruise_control_tpu/xla", I.LOW,
              "persistent XLA compilation cache directory; empty disables "
              "(compiled programs survive service restarts)", group=g)
-    d.define("tpu.aot.cache.dir", T.STRING,
-             "~/.cache/cruise_control_tpu/aot", I.LOW,
-             "AOT export cache directory: serialized engine programs skip "
-             "Python tracing/lowering on warm service starts; empty "
-             "disables", group=g)
     return d
 
 

@@ -16,6 +16,7 @@ from cruise_control_tpu.executor.executor import (
     ExecutionResult,
     Executor,
     ExecutorState,
+    NoOngoingExecutionError,
     OngoingExecutionError,
 )
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner

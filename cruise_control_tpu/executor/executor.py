@@ -107,6 +107,11 @@ class OngoingExecutionError(Exception):
     (KafkaCruiseControl.java:216-229)."""
 
 
+class NoOngoingExecutionError(Exception):
+    """Mid-execution concurrency change requested while nothing executes
+    (reference rejects ChangeExecutionConcurrency in that case)."""
+
+
 class Executor:
     def __init__(
         self,
@@ -199,6 +204,13 @@ class Executor:
                 )
             staged["interval_s"] = float(progress_check_interval_s)
         with self._lock:
+            # checked under the lock: overrides die with the execution
+            # (cleared at the next start), so accepting one after the
+            # execution finished would 200 a silent no-op
+            if not self.has_ongoing_execution:
+                raise NoOngoingExecutionError(
+                    "cannot change execution concurrency: no ongoing execution"
+                )
             self._requested.update(staged)
         return self.requested_concurrency()
 

@@ -414,21 +414,38 @@ class WindowedMetricSampleAggregator:
     def entity_key_rows(self) -> tuple:
         """(sorted int64 keys, matching rows) for vectorized entity lookup.
 
-        Keys encode (entity.group << 32) | entity.partition-or-id — the
+        Keys encode (entity.topic << 32) | entity.partition — the
         partition-entity layout the monitor's columnar model-generation
         path joins against with np.searchsorted instead of E dict probes.
-        Cached until the entity set grows.
+        Non-partition entities are rejected loudly (a silent key collision
+        would join wrong loads).  Cached until the entity set grows.
         """
         with self._lock:  # sample ingestion grows the dict concurrently
             cached = getattr(self, "_key_rows_cache", None)
             if cached is not None and cached[0] == len(self._entity_rows):
                 return cached[1]
+
+            def _key(e) -> int:
+                # loud failure beats colliding join keys: a non-partition
+                # entity or out-of-range id here would silently join wrong
+                # loads via the old getattr-default fallback
+                topic = getattr(e, "topic", None)
+                part = getattr(e, "partition", None)
+                if topic is None or part is None:
+                    raise TypeError(
+                        "entity_key_rows requires PartitionEntity-shaped "
+                        f"entities (topic, partition); got {type(e).__name__}"
+                    )
+                topic, part = int(topic), int(part)
+                if not (0 <= part < 2**32 and 0 <= topic < 2**31):
+                    raise ValueError(
+                        f"partition entity ids out of key range: "
+                        f"topic={topic} partition={part}"
+                    )
+                return (topic << 32) | part
+
             keys = np.fromiter(
-                (
-                    (int(getattr(e, "topic", getattr(e, "group", 0))) << 32)
-                    | int(getattr(e, "partition", getattr(e, "broker_id", 0)))
-                    for e in self._entity_rows
-                ),
+                (_key(e) for e in self._entity_rows),
                 np.int64,
                 count=len(self._entity_rows),
             )

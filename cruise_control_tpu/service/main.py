@@ -36,11 +36,9 @@ def build_service(
     sample_store=None,
     partitions_fn=None,
 ) -> tuple[CruiseControlApp, MetricFetcherManager]:
-    from cruise_control_tpu.common.aot_cache import enable_aot_cache
     from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
 
     enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
-    enable_aot_cache(config.get("tpu.aot.cache.dir"))
     if capacity_resolver is None:
         resolver_cls = config.get("broker.capacity.config.resolver.class")
         path = config.get("capacity.config.file")

@@ -730,18 +730,23 @@ class CruiseControlApp:
                     conc[kwarg] = cast(raw)
                 except (TypeError, ValueError) as e:
                     raise BadRequest(f"bad {pname}: {raw!r}") from e
-        for kwarg, v in conc.items():
-            if (kwarg == "progress_check_interval_s" and v <= 0) or (
-                kwarg != "progress_check_interval_s" and v < 1
-            ):
-                raise BadRequest(f"bad {kwarg}: {v}")
-        if conc and not self.cc.executor.has_ongoing_execution:
-            # the reference rejects ChangeExecutionConcurrency when nothing
-            # is executing — overrides die with the execution, so accepting
-            # one here would 200 a silent no-op
-            raise BadRequest(
-                "cannot change execution concurrency: no ongoing execution"
-            )
+        # mid-execution concurrency change first: the executor applies it
+        # atomically under its lock (raising when no execution is live, so
+        # an execution finishing mid-request 400s instead of 200ing a
+        # silent no-op) — and a 400 here must precede the self-healing /
+        # history side effects below
+        if conc:
+            from cruise_control_tpu.executor.executor import NoOngoingExecutionError
+
+            try:
+                out["requestedConcurrency"] = (
+                    self.cc.executor.set_requested_concurrency(**conc)
+                )
+            except (NoOngoingExecutionError, ValueError) as e:
+                raise BadRequest(str(e)) from e
+            # applied on the executor's next progress tick, so a live
+            # rebalance can be throttled or unstuck
+            out["ongoingExecution"] = True
 
         enable = params.get("enable_self_healing_for", [None])[0]
         disable = params.get("disable_self_healing_for", [None])[0]
@@ -761,16 +766,6 @@ class CruiseControlApp:
         if drop_dem:
             self.cc.executor.drop_demoted_brokers(int(b) for b in drop_dem.split(","))
             out["recentlyDemotedBrokers"] = sorted(self.cc.executor.demoted_brokers)
-        # mid-execution concurrency change: applied on the executor's next
-        # progress tick, so a live rebalance can be throttled or unstuck
-        if conc:
-            try:
-                out["requestedConcurrency"] = (
-                    self.cc.executor.set_requested_concurrency(**conc)
-                )
-            except ValueError as e:
-                raise BadRequest(str(e)) from e
-            out["ongoingExecution"] = True
         return 200, out
 
     def _ep_review(self, params) -> tuple[int, dict]:

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round gate: the full test suite + the multi-chip dryrun must BOTH pass
+# before a round ends (VERDICT r4: round 4 shipped a red suite because
+# nothing forced a final full run).  Reference analog: the CircleCI gate
+# running `./gradlew clean build` (.circleci/config.yml:16).
+#
+# Usage: scripts/check.sh [pytest-args...]
+# Exit: nonzero if the suite or the dryrun fails.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== check.sh: pytest tests/ -q $* =="
+python -m pytest tests/ -q "$@"
+suite_rc=$?
+
+echo "== check.sh: dryrun_multichip(8) on virtual CPU mesh =="
+GRAFT_FORCE_CPU=1 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print("dryrun_multichip(8): OK")
+EOF
+dryrun_rc=$?
+
+echo "== check.sh: single-chip entry compile check =="
+GRAFT_FORCE_CPU=1 python - <<'EOF'
+import jax, __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+print("entry(): OK")
+EOF
+entry_rc=$?
+
+echo
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ]

@@ -16,6 +16,7 @@ from cruise_control_tpu.executor import (
     ExecutionTaskPlanner,
     Executor,
     ExecutorState,
+    NoOngoingExecutionError,
     OngoingExecutionError,
     PrioritizeLargeReplicaMovementStrategy,
     PrioritizeSmallReplicaMovementStrategy,
@@ -361,6 +362,20 @@ def test_mid_execution_concurrency_decrease(sim):
     # once the initial burst drains, the loop never again exceeds 1
     drained = next(i for i, c in enumerate(concurrent) if i >= 2 and c <= 1)
     assert max(concurrent[drained:]) <= 1
+
+
+def test_concurrency_change_rejected_when_idle(sim):
+    """set_requested_concurrency raises atomically (under the executor
+    lock) when nothing is executing — an execution finishing between the
+    caller's check and the call must yield a loud error, not a lingering
+    no-op override (ADVICE r4: /admin TOCTOU)."""
+    ex = Executor(sim, topic_names={0: "T0"})
+    with pytest.raises(NoOngoingExecutionError):
+        ex.set_requested_concurrency(inter_broker=4)
+    assert ex.requested_concurrency() == {}
+    # validation still precedes the liveness check: bad values always raise
+    with pytest.raises(ValueError):
+        ex.set_requested_concurrency(inter_broker=0)
 
 
 def test_progress_check_interval_change_mid_execution(sim):
