@@ -212,16 +212,29 @@ class GoalOptimizer:
         from cruise_control_tpu.models.state import DEVICE_CHECKS, validate_on_device
 
         t0 = time.monotonic()
-        # input sanity: the ON-DEVICE check transfers a [5] count vector
-        # instead of the model's bulk arrays (the tunneled-TPU transfer
-        # costs more than the checks); the host validator re-runs for the
-        # detailed message only on failure
+        cfg = config or self.config
+        # input sanity first — a rejected state must not trigger engine
+        # construction or background compilation.  The ON-DEVICE check
+        # transfers a [5] count vector instead of the model's bulk arrays
+        # (the tunneled-TPU transfer costs more than the checks); the host
+        # validator re-runs for the detailed message only on failure
         input_checks = np.asarray(validate_on_device(state))
         if input_checks.any():
             validate(state)  # raises with per-invariant detail
             bad = [n for n, c in zip(DEVICE_CHECKS, input_checks) if c]
             raise ValueError(f"input state failed sanity checks: {bad}")
-        cfg = config or self.config
+        # build + warm the engine BEFORE the report: program tracing/
+        # compiling proceeds on background threads while the main thread
+        # traces the report programs below — the restarted-service warm
+        # start (engine.precompile_async docstring)
+        engine = None
+        if self.parallel_mode == "single":
+            engine = self._engine_for(state, options, cfg)
+            # only at production scale: tiny test engines compile in
+            # hundreds of ms, and eagerly tracing the rarely-used programs
+            # (full-chain violations) would cost more than the overlap wins
+            if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
+                engine.precompile_async()
         (obj_b, viol_b), stats_b = self._report(state)
         # the proposal diff needs bulk BEFORE-state arrays on host; pull
         # them on a side thread while the device anneals — input buffers
@@ -229,8 +242,7 @@ class GoalOptimizer:
         # host would otherwise spend blocked on the engine
         with ThreadPoolExecutor(max_workers=1) as pool:
             before_host_f = pool.submit(fetch_before_host, state)
-            if self.parallel_mode == "single":
-                engine = self._engine_for(state, options, cfg)
+            if engine is not None:
                 final, history = engine.run(verbose=verbose)
             else:
                 final, history = self._parallel_engine(state, options, cfg).run(

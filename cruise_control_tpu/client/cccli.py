@@ -57,7 +57,12 @@ ENDPOINTS: dict[str, dict] = {
                                   "--entries": ("entries", positive_int_param)}},
     "proposals": {"method": "GET", "endpoint": "proposals",
                   "params": {"--ignore-proposal-cache": ("ignore_proposal_cache", boolean_param)}},
-    "user_tasks": {"method": "GET", "endpoint": "user_tasks", "params": {}},
+    "user_tasks": {"method": "GET", "endpoint": "user_tasks",
+                   "params": {"--user-task-ids": ("user_task_ids", str),
+                              "--client-ids": ("client_ids", str),
+                              "--endpoints": ("endpoints", str),
+                              "--types": ("types", str),
+                              "--fetch-completed-task": ("fetch_completed_task", boolean_param)}},
     "review_board": {"method": "GET", "endpoint": "review_board", "params": {}},
     "bootstrap": {"method": "GET", "endpoint": "bootstrap", "params": {}},
     "train": {"method": "GET", "endpoint": "train", "params": {}},
@@ -100,7 +105,18 @@ ENDPOINTS: dict[str, dict] = {
     "admin": {"method": "POST", "endpoint": "admin",
               "params": {"--enable-self-healing-for": ("enable_self_healing_for", str),
                          "--disable-self-healing-for": ("disable_self_healing_for", str),
-                         "--drop-recently-removed-brokers": ("drop_recently_removed_brokers", csv_int_param)}},
+                         "--drop-recently-removed-brokers": ("drop_recently_removed_brokers", csv_int_param),
+                         "--drop-recently-demoted-brokers": ("drop_recently_demoted_brokers", csv_int_param),
+                         # mid-execution concurrency control (reference
+                         # AdminParameters ChangeExecutionConcurrency)
+                         "--concurrent-partition-movements-per-broker":
+                             ("concurrent_partition_movements_per_broker", positive_int_param),
+                         "--concurrent-intra-broker-partition-movements":
+                             ("concurrent_intra_broker_partition_movements", positive_int_param),
+                         "--concurrent-leader-movements":
+                             ("concurrent_leader_movements", positive_int_param),
+                         "--execution-progress-check-interval-ms":
+                             ("execution_progress_check_interval_ms", positive_int_param)}},
     "review": {"method": "POST", "endpoint": "review",
                "params": {"--approve": ("approve", csv_int_param),
                           "--discard": ("discard", csv_int_param),

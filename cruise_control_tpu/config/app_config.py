@@ -163,6 +163,20 @@ def _monitor_defs() -> ConfigDef:
     d.define("metric.sampler.class", T.CLASS,
              "cruise_control_tpu.testing.synthetic.SyntheticWorkloadSampler", I.HIGH,
              "MetricSampler plugin", group=g)
+    d.define("cruise.control.metrics.topic", T.STRING, "__CruiseControlMetrics",
+             I.MEDIUM,
+             "metrics-reporter topic the sampler consumes (reference "
+             "CruiseControlMetricsReporterConfig cruise.control.metrics.topic)",
+             group=g)
+    d.define("cruise.control.metrics.serde.format", T.STRING, "native", I.MEDIUM,
+             "wire format of the metrics topic: 'native' (this framework's "
+             "reporter) or 'reference' (records produced by the reference's "
+             "in-broker CruiseControlMetricsReporter plugin — drop-in "
+             "ingestion of broker-internal metrics)",
+             lambda n, v: None if v in ("native", "reference") else
+             (_ for _ in ()).throw(ConfigException(
+                 f"{n}: {v!r} not in ('native', 'reference')")),
+             group=g)
     d.define("sample.store.class", T.CLASS,
              "cruise_control_tpu.monitor.sampling.NoopSampleStore", I.MEDIUM,
              "SampleStore plugin", group=g)

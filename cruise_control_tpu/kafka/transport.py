@@ -153,10 +153,18 @@ class KafkaMetricsConsumer:
         topic: str = DEFAULT_TOPIC,
         *,
         max_bytes_per_fetch: int = 8 * 1024 * 1024,
+        serde=None,
     ):
+        """serde: record deserializer — native MetricSerde (default) or
+        ReferenceMetricSerde when the topic is fed by the REFERENCE's
+        in-broker reporter plugin (drop-in ingestion interop)."""
+        from cruise_control_tpu.reporter.metrics import MetricSerde
+
         self.client = client
         self.topic = topic
         self.max_bytes = max_bytes_per_fetch
+        self.serde = serde or MetricSerde
+        self.framed_native = self.serde is MetricSerde
         self._router = _TopicRouter(client, topic)
         self._offsets: dict[int, int] = {}
         #: fetched-but-undelivered payloads (a max_records poll must not
@@ -287,7 +295,7 @@ class KafkaMetricsConsumer:
         return frame_records(self.poll_records(max_records))
 
     def poll(self, max_records: int | None = None):
-        """Object-path compatibility with the MetricSampler SPI."""
-        from cruise_control_tpu.reporter.metrics import MetricSerde
-
-        return [MetricSerde.deserialize(r) for r in self.poll_records(max_records)]
+        """Object-path compatibility with the MetricSampler SPI; unknown
+        record classes (serde returns None) are skipped."""
+        decoded = (self.serde.deserialize(r) for r in self.poll_records(max_records))
+        return [m for m in decoded if m is not None]

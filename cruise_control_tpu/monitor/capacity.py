@@ -72,14 +72,22 @@ class FileCapacityResolver:
                 disk_total = sum(disks.values())
             else:
                 disk_total = float(disk)
+            cpu = cap["CPU"]
+            if isinstance(cpu, dict):
+                # cores schema (reference config/capacityCores.json):
+                # CPU = {"num.cores": N}; utilization stays percent-based
+                # with the core count carried alongside
+                cores = int(cpu["num.cores"])
+                cpu_cap = 100.0
+            else:
+                cores = int(entry.get("numCores", 1))
+                cpu_cap = float(cpu)
             arr = np.zeros(NUM_RESOURCES, np.float32)
-            arr[Resource.CPU] = float(cap["CPU"])
+            arr[Resource.CPU] = cpu_cap
             arr[Resource.NW_IN] = float(cap["NW_IN"])
             arr[Resource.NW_OUT] = float(cap["NW_OUT"])
             arr[Resource.DISK] = disk_total
-            self._by_id[bid] = BrokerCapacityInfo(
-                arr, disks, int(entry.get("numCores", 1))
-            )
+            self._by_id[bid] = BrokerCapacityInfo(arr, disks, cores)
         if DEFAULT_BROKER_ID not in self._by_id:
             raise ValueError("capacity file must define the default broker (-1)")
 

@@ -106,7 +106,7 @@ class CruiseControlMetricsReporterSampler:
         broker_values: dict[int, np.ndarray] = {}
         times: dict[int, int] = {}
 
-        if hasattr(self.transport, "poll_framed"):
+        if getattr(self.transport, "framed_native", hasattr(self.transport, "poll_framed")):
             # columnar fast path: one native pass over the whole batch
             # (cruise_control_tpu/native/serde.cpp), numpy masks instead of
             # a per-record object loop — the JVM sampler's hot loop analog

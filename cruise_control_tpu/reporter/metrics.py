@@ -78,10 +78,33 @@ class MetricType(enum.IntEnum):
     TOPIC_MESSAGES_IN_PER_SEC = 46
     # partition scope
     PARTITION_SIZE = 50
+    # broker scope, percentile latencies (reference serde v1 additions,
+    # RawMetricType.java ids 43-62 — SlowBrokerFinder inputs); 60-79 is a
+    # second broker-scope range so the earlier ranges stay stable
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH = 60
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH = 61
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_50TH = 62
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_999TH = 63
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_50TH = 64
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_999TH = 65
+    BROKER_PRODUCE_TOTAL_TIME_MS_50TH = 66
+    BROKER_PRODUCE_TOTAL_TIME_MS_999TH = 67
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_50TH = 68
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_999TH = 69
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_50TH = 70
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_999TH = 71
+    BROKER_PRODUCE_LOCAL_TIME_MS_50TH = 72
+    BROKER_PRODUCE_LOCAL_TIME_MS_999TH = 73
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_50TH = 74
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH = 75
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_50TH = 76
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH = 77
+    BROKER_LOG_FLUSH_TIME_MS_50TH = 78
+    BROKER_LOG_FLUSH_TIME_MS_999TH = 79
 
     @property
     def is_broker_scope(self) -> bool:
-        return self < 40
+        return self < 40 or 60 <= self < 80
 
     @property
     def is_topic_scope(self) -> bool:
@@ -89,7 +112,7 @@ class MetricType(enum.IntEnum):
 
     @property
     def is_partition_scope(self) -> bool:
-        return self >= 50
+        return 50 <= self < 60
 
 
 _VERSION = 0
@@ -162,3 +185,153 @@ class MetricSerde:
             return TopicMetric(mt, time_ms, broker_id, value, topic=topic)
         (partition,) = struct.unpack_from("<i", rest, 2 + tlen)
         return PartitionMetric(mt, time_ms, broker_id, value, topic=topic, partition=partition)
+
+
+# ---------------------------------------------------------------------------
+# drop-in interop with the REFERENCE reporter plugin's wire format
+# ---------------------------------------------------------------------------
+
+#: our MetricType name at each reference RawMetricType id (index == id) —
+#: transcribed from RawMetricType.java:27-97 (id, scope, version-since).
+#: The names are identical by construction; only the id spaces differ.
+_REFERENCE_TYPE_NAMES = (
+    "ALL_TOPIC_BYTES_IN",                                   # 0  v1 BROKER
+    "ALL_TOPIC_BYTES_OUT",                                  # 1  v1 BROKER
+    "TOPIC_BYTES_IN",                                       # 2  v1 TOPIC
+    "TOPIC_BYTES_OUT",                                      # 3  v1 TOPIC
+    "PARTITION_SIZE",                                       # 4  v1 PARTITION
+    "BROKER_CPU_UTIL",                                      # 5  v1 BROKER
+    "ALL_TOPIC_REPLICATION_BYTES_IN",                       # 6
+    "ALL_TOPIC_REPLICATION_BYTES_OUT",                      # 7
+    "ALL_TOPIC_PRODUCE_REQUEST_RATE",                       # 8
+    "ALL_TOPIC_FETCH_REQUEST_RATE",                         # 9
+    "ALL_TOPIC_MESSAGES_IN_PER_SEC",                        # 10
+    "TOPIC_REPLICATION_BYTES_IN",                           # 11
+    "TOPIC_REPLICATION_BYTES_OUT",                          # 12
+    "TOPIC_PRODUCE_REQUEST_RATE",                           # 13
+    "TOPIC_FETCH_REQUEST_RATE",                             # 14
+    "TOPIC_MESSAGES_IN_PER_SEC",                            # 15
+    "BROKER_PRODUCE_REQUEST_RATE",                          # 16
+    "BROKER_CONSUMER_FETCH_REQUEST_RATE",                   # 17
+    "BROKER_FOLLOWER_FETCH_REQUEST_RATE",                   # 18
+    "BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT",              # 19
+    "BROKER_REQUEST_QUEUE_SIZE",                            # 20
+    "BROKER_RESPONSE_QUEUE_SIZE",                           # 21
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX",             # 22
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN",            # 23
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX",      # 24
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN",     # 25
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX",      # 26
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN",     # 27
+    "BROKER_PRODUCE_TOTAL_TIME_MS_MAX",                     # 28
+    "BROKER_PRODUCE_TOTAL_TIME_MS_MEAN",                    # 29
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MAX",              # 30
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MEAN",             # 31
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MAX",              # 32
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MEAN",             # 33
+    "BROKER_PRODUCE_LOCAL_TIME_MS_MAX",                     # 34
+    "BROKER_PRODUCE_LOCAL_TIME_MS_MEAN",                    # 35
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MAX",              # 36
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MEAN",             # 37
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MAX",              # 38
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MEAN",             # 39
+    "BROKER_LOG_FLUSH_RATE",                                # 40
+    "BROKER_LOG_FLUSH_TIME_MS_MAX",                         # 41
+    "BROKER_LOG_FLUSH_TIME_MS_MEAN",                        # 42
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH",            # 43 v5
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH",           # 44 v5
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_50TH",     # 45
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_999TH",    # 46
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_50TH",     # 47
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_999TH",    # 48
+    "BROKER_PRODUCE_TOTAL_TIME_MS_50TH",                    # 49
+    "BROKER_PRODUCE_TOTAL_TIME_MS_999TH",                   # 50
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_50TH",             # 51
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_999TH",            # 52
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_50TH",             # 53
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_999TH",            # 54
+    "BROKER_PRODUCE_LOCAL_TIME_MS_50TH",                    # 55
+    "BROKER_PRODUCE_LOCAL_TIME_MS_999TH",                   # 56
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_50TH",             # 57
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH",            # 58
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_50TH",             # 59
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH",            # 60
+    "BROKER_LOG_FLUSH_TIME_MS_50TH",                        # 61
+    "BROKER_LOG_FLUSH_TIME_MS_999TH",                       # 62
+)
+
+_REF_TYPE_BY_ID = {i: MetricType[n] for i, n in enumerate(_REFERENCE_TYPE_NAMES)}
+_REF_ID_BY_TYPE = {t: i for i, t in _REF_TYPE_BY_ID.items()}
+
+_REFERENCE_METRIC_VERSION = 0
+
+
+class ReferenceMetricSerde:
+    """The REFERENCE reporter plugin's exact wire format (big-endian):
+
+      class_id u8 | version u8 | raw_type u8 | time i64 | broker_id i32
+        [| topic_len i32 | topic utf8 [| partition i32]] | value f64
+
+    per metric/MetricSerde.java (class-id header byte) +
+    BrokerMetric.java:30-41 / TopicMetric.java:37-52 /
+    PartitionMetric.java:44-60 (field layouts; value LAST, unlike our
+    native serde).  With this serde the service ingests records produced
+    by the reference's in-broker plugin unchanged — the drop-in path for
+    broker-internal metrics (request-handler idle ratio, queue sizes, the
+    SlowBrokerFinder's percentile latencies) that no process-external
+    sidecar can observe.
+
+    deserialize returns None for an unknown class id, exactly like the
+    reference's fromBytes (new metric class on old code -> skip).
+    """
+
+    @staticmethod
+    def serialize(m: CruiseControlMetric) -> bytes:
+        ref_id = _REF_ID_BY_TYPE.get(m.metric_type)
+        if ref_id is None:
+            raise ValueError(
+                f"{m.metric_type.name} has no reference RawMetricType id"
+            )
+        head = struct.pack(
+            ">BBBqi", int(m.class_id), _REFERENCE_METRIC_VERSION, ref_id,
+            m.time_ms, m.broker_id,
+        )
+        if isinstance(m, PartitionMetric):
+            t = m.topic.encode()
+            return head + struct.pack(">i", len(t)) + t + struct.pack(
+                ">id", m.partition, m.value
+            )
+        if isinstance(m, TopicMetric):
+            t = m.topic.encode()
+            return head + struct.pack(">i", len(t)) + t + struct.pack(">d", m.value)
+        return head + struct.pack(">d", m.value)
+
+    @staticmethod
+    def deserialize(data: bytes) -> CruiseControlMetric | None:
+        class_id = data[0]
+        if class_id > max(MetricClassId):
+            return None  # newer metric class than we know: skip (reference behavior)
+        version, ref_id, time_ms, broker_id = struct.unpack_from(">BBqi", data, 1)
+        if version > _REFERENCE_METRIC_VERSION:
+            raise ValueError(f"unsupported reference metric version {version}")
+        mt = _REF_TYPE_BY_ID.get(ref_id)
+        if mt is None:
+            # a newer reporter plugin emitting a type we don't know yet —
+            # skip the record (ids 43-62 were added exactly this way);
+            # raising here would discard the whole drained batch
+            return None
+        off = 1 + struct.calcsize(">BBqi")
+        if class_id == MetricClassId.BROKER_METRIC:
+            (value,) = struct.unpack_from(">d", data, off)
+            return BrokerMetric(mt, time_ms, broker_id, value)
+        (tlen,) = struct.unpack_from(">i", data, off)
+        off += 4
+        topic = data[off: off + tlen].decode()
+        off += tlen
+        if class_id == MetricClassId.TOPIC_METRIC:
+            (value,) = struct.unpack_from(">d", data, off)
+            return TopicMetric(mt, time_ms, broker_id, value, topic=topic)
+        partition, value = struct.unpack_from(">id", data, off)
+        return PartitionMetric(
+            mt, time_ms, broker_id, value, topic=topic, partition=partition
+        )

@@ -39,12 +39,21 @@ class MetricTransport(Protocol):
 
 
 class InMemoryTransport:
-    """Bounded in-process topic standing in for __CruiseControlMetrics."""
+    """Bounded in-process topic standing in for __CruiseControlMetrics.
 
-    def __init__(self, max_records: int = 1_000_000):
+    `serde` picks the record wire format: the native MetricSerde (default)
+    or ReferenceMetricSerde to carry records in the REFERENCE reporter
+    plugin's exact byte layout (drop-in interop path).
+    """
+
+    def __init__(self, max_records: int = 1_000_000, *, serde=MetricSerde):
         self._records: list[bytes] = []
         self._lock = threading.Lock()
         self._max = max_records
+        self.serde = serde
+        #: the native columnar decoder only parses the native layout; the
+        #: sampler falls back to the object path for other serdes
+        self.framed_native = serde is MetricSerde
 
     def send(self, payload: bytes) -> None:
         with self._lock:
@@ -56,11 +65,14 @@ class InMemoryTransport:
         pass
 
     def poll(self, max_records: int | None = None) -> list[CruiseControlMetric]:
-        """Consumer side (the sampler drains this)."""
+        """Consumer side (the sampler drains this).  Records the serde does
+        not recognize (None — e.g. a newer metric class id) are skipped,
+        matching the reference sampler's behavior."""
         with self._lock:
             n = len(self._records) if max_records is None else min(max_records, len(self._records))
             out, self._records = self._records[:n], self._records[n:]
-        return [MetricSerde.deserialize(r) for r in out]
+        decoded = (self.serde.deserialize(r) for r in out)
+        return [m for m in decoded if m is not None]
 
     def poll_framed(self, max_records: int | None = None) -> bytes:
         """Drain as one u32-length-framed batch for the native columnar
@@ -112,10 +124,15 @@ class MetricsReporter:
         transport: MetricTransport,
         *,
         reporting_interval_ms: int = 60_000,
+        serde=MetricSerde,
     ):
+        """serde: MetricSerde (native) or ReferenceMetricSerde — the latter
+        produces records a REFERENCE Cruise Control service consumes
+        unchanged (interop in both directions)."""
         self.snapshotter = snapshotter
         self.transport = transport
         self.reporting_interval_ms = reporting_interval_ms
+        self.serde = serde
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.reported = 0
@@ -124,7 +141,7 @@ class MetricsReporter:
         now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         metrics = self.snapshotter.snapshot(now_ms)
         for m in metrics:
-            self.transport.send(MetricSerde.serialize(m))
+            self.transport.send(self.serde.serialize(m))
         self.transport.flush()
         self.reported += len(metrics)
         return len(metrics)

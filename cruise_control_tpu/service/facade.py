@@ -510,6 +510,60 @@ class CruiseControl:
             "stopped": out.stopped,
         }
 
+    def _execution_eta(self, result) -> dict:
+        """Per-phase execution ETA for an optimization result.
+
+        Derived, transparently, from data-to-move over the ACTIVE caps —
+        the live mid-execution overrides (POST /admin) shift the estimate:
+          * interBroker/intraBroker: bytes over the aggregate replication
+            bandwidth (per-broker throttle x brokers moving concurrently);
+            null when no throttle is configured (bandwidth unknown).
+          * leadership: election batches x progress-check interval.
+        The reference exposes only dataToMoveMB
+        (executor/ExecutionProposal.java:106-229); the ETA is this
+        framework's derived convenience, with its inputs echoed under
+        "assumptions" so operators can audit it.
+        """
+        import math
+
+        cfg = self.config
+        req = self.executor.requested_concurrency()
+        lead_cap = req.get("leadership", cfg.get("num.concurrent.leader.movements"))
+        interval_s = req.get(
+            "interval_s", cfg.get("execution.progress.check.interval.ms") / 1000.0
+        )
+        throttle = cfg.get("default.replication.throttle")  # bytes/s per broker
+        leads = result.num_leadership_moves
+        # brokers shipping data concurrently.  The per-broker MOVE cap does
+        # not appear in the formula on purpose: under a per-BROKER byte
+        # throttle, splitting a broker's bandwidth across more concurrent
+        # moves does not change its aggregate egress rate.
+        src_brokers = {
+            b for p in result.proposals if p.has_replica_action
+            for b in p.old_replicas if b not in p.new_replicas
+        }
+        inter_s = intra_s = None
+        if throttle:
+            agg_bw = float(throttle) * max(1, len(src_brokers))
+            inter_s = result.data_to_move * 1024.0 * 1024.0 / agg_bw
+            intra_mb = sum(p.intra_broker_data_to_move for p in result.proposals)
+            intra_s = intra_mb * 1024.0 * 1024.0 / agg_bw if intra_mb else 0.0
+        lead_s = math.ceil(leads / max(1, lead_cap)) * interval_s if leads else 0.0
+        return {
+            "interBrokerSeconds": round(inter_s, 1) if inter_s is not None else None,
+            "intraBrokerSeconds": round(intra_s, 1) if intra_s is not None else None,
+            "leadershipSeconds": round(lead_s, 1),
+            # only inputs the estimate actually uses, so operators can
+            # audit it
+            "assumptions": {
+                "replicationThrottleBytesPerSec": throttle,
+                "concurrentLeaderMovements": lead_cap,
+                "progressCheckIntervalSeconds": interval_s,
+                "sourceBrokers": len(src_brokers),
+                "dataToMoveMB": result.data_to_move,
+            },
+        }
+
     def _exec_options(self, ov: dict | None = None) -> ExecutionOptions:
         """ExecutionOptions from config + per-request overrides — ONE
         builder for every execution path (rebalance/add/remove/demote/
@@ -672,6 +726,7 @@ class CruiseControl:
                 progress, allow_capacity_estimation=allow_capacity_estimation
             )
         out = result.summary()
+        out["estimatedExecutionTime"] = self._execution_eta(result)
         out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
             out["execution"] = self._execute(
@@ -701,6 +756,7 @@ class CruiseControl:
         )
         result = self.optimizer.optimize(state, options=options)
         out = result.summary()
+        out["estimatedExecutionTime"] = self._execution_eta(result)
         if not dryrun:
             out["execution"] = self._execute(
                 result, progress, removed=set(broker_ids),
@@ -731,6 +787,7 @@ class CruiseControl:
         drives evacuation of dead brokers/disks during a normal optimize."""
         result = self.proposals(progress, ignore_cache=True)
         out = result.summary()
+        out["estimatedExecutionTime"] = self._execution_eta(result)
         out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
             out["execution"] = self._execute(result, progress)

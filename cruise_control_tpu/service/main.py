@@ -199,6 +199,30 @@ def parse_bootstrap_servers(bootstrap_servers: str) -> list[tuple[str, int]]:
     return seeds
 
 
+def sasl_credentials_from_config(config: CruiseControlConfig):
+    """SaslCredentials from sasl.* keys (None when SASL is off) — EVERY
+    client a deployment opens (admin, metrics consumer) must authenticate
+    the same way (sasl.password.file wins over sasl.password)."""
+    if not config.get("sasl.mechanism"):
+        return None
+    from cruise_control_tpu.kafka.sasl import SaslCredentials
+
+    password = config.get("sasl.password")
+    pw_file = config.get("sasl.password.file")
+    if pw_file:
+        with open(pw_file) as f:
+            password = f.read().strip()
+    if not config.get("sasl.username") or password is None:
+        raise ValueError(
+            "sasl.mechanism set but sasl.username/sasl.password missing"
+        )
+    return SaslCredentials(
+        username=config.get("sasl.username"),
+        password=password,
+        mechanism=config.get("sasl.mechanism"),
+    )
+
+
 def build_kafka_service(
     config: CruiseControlConfig,
     bootstrap_servers: str,
@@ -223,24 +247,7 @@ def build_kafka_service(
         KafkaMetadataProvider,
     )
 
-    sasl = None
-    if config.get("sasl.mechanism"):
-        from cruise_control_tpu.kafka.sasl import SaslCredentials
-
-        password = config.get("sasl.password")
-        pw_file = config.get("sasl.password.file")
-        if pw_file:
-            with open(pw_file) as f:
-                password = f.read().strip()
-        if not config.get("sasl.username") or password is None:
-            raise ValueError(
-                "sasl.mechanism set but sasl.username/sasl.password missing"
-            )
-        sasl = SaslCredentials(
-            username=config.get("sasl.username"),
-            password=password,
-            mechanism=config.get("sasl.mechanism"),
-        )
+    sasl = sasl_credentials_from_config(config)
     client = KafkaAdminClient(
         parse_bootstrap_servers(bootstrap_servers), client_id=client_id, sasl=sasl
     )
@@ -299,12 +306,56 @@ def build_simulated_service(
 
 
 def main(argv=None):  # pragma: no cover — manual entry point
+    """Operator entry (reference KafkaCruiseControlMain.java:26-40):
+    `python -m cruise_control_tpu.service.main config/cruisecontrol.properties`.
+
+    With `bootstrap.servers` set, runs against the live Kafka cluster over
+    the wire-protocol adapters, consuming the metrics-reporter topic in
+    the configured serde format; without it, boots the simulated demo
+    cluster."""
     argv = argv if argv is not None else sys.argv[1:]
     props = load_properties(argv[0]) if argv else {}
     config = CruiseControlConfig(props)
-    app, fetcher, admin, sampler = build_simulated_service(config)
-    app.cc.start_up()
-    fetcher.start(lambda: sampler.all_partition_entities())
+    bootstrap = props.get("bootstrap.servers")
+    if bootstrap:
+        from cruise_control_tpu.kafka import KafkaAdminClient
+        from cruise_control_tpu.kafka.transport import KafkaMetricsConsumer
+        from cruise_control_tpu.monitor.reporter_sampler import (
+            CruiseControlMetricsReporterSampler,
+        )
+
+        serde = None
+        if config.get("cruise.control.metrics.serde.format") == "reference":
+            from cruise_control_tpu.reporter.metrics import ReferenceMetricSerde
+
+            serde = ReferenceMetricSerde
+        # one extra client for the metrics data plane (fetch volume must
+        # not contend with admin calls) — authenticated like the admin
+        # client; topology comes from the SERVICE's own metadata provider
+        # (monitor.metadata), not a third connection pool
+        consumer_client = KafkaAdminClient(
+            parse_bootstrap_servers(bootstrap),
+            sasl=sasl_credentials_from_config(config),
+        )
+        monitor_meta: list = []
+        sampler = CruiseControlMetricsReporterSampler(
+            KafkaMetricsConsumer(
+                consumer_client,
+                config.get("cruise.control.metrics.topic"),
+                serde=serde,
+            ),
+            lambda: monitor_meta[0].topology(),
+        )
+        app, fetcher, _admin, _client = build_kafka_service(
+            config, bootstrap, sampler
+        )
+        monitor_meta.append(app.cc.monitor.metadata)
+        partitions_fn = app.cc.task_runner.partitions_fn
+    else:
+        app, fetcher, _admin, sim_sampler = build_simulated_service(config)
+        partitions_fn = sim_sampler.all_partition_entities
+    app.cc.start_up(precompute=True)
+    fetcher.start(lambda: partitions_fn())
     app.start()
     print(f"cruise-control-tpu listening on {app.host}:{app.port}{app.prefix}")
     try:

@@ -60,6 +60,9 @@ OPTIMIZATION_RESULT = Schema((
     Field("objectiveAfter", NUM),
     Field("violatedGoalsAfter", LIST),
     Field("wallSeconds", NUM),
+    # per-phase execution ETA derived from data-to-move over the active
+    # caps/throttle (facade._execution_eta); absent on demote (leader-only)
+    Field("estimatedExecutionTime", DICT, required=False),
     Field("proposals", LIST, item_schema=PROPOSAL_ITEM),
     Field("execution", DICT, required=False),
     Field("_userTaskId", STR, required=False),

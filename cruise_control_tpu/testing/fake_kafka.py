@@ -66,6 +66,8 @@ class FakeKafkaCluster:
         self.logs: dict[tuple[str, int], list[bytes]] = {}
         self.log_end: dict[tuple[str, int], int] = {}
         self.scram_users = scram_users or {}
+        #: brokers crashed via kill_broker (absent from metadata)
+        self._dead: set[int] = set()
         self._servers: list[_BrokerListener] = []
         for bid, spec in sorted(brokers.items()):
             self.brokers[bid] = {"rack": spec.get("rack", ""), "port": None}
@@ -127,6 +129,30 @@ class FakeKafkaCluster:
         self._auto_complete_after = polls
         self._list_polls = 0
 
+    def kill_broker(self, broker_id: int) -> None:
+        """Chaos: crash one broker — its listener closes (connections die),
+        it vanishes from Metadata responses, and partitions it led fail
+        over to their first surviving replica (the controller's ISR
+        election).  Its replica assignments REMAIN in the partition lists,
+        which is exactly the referenced-but-absent signal the
+        BrokerFailureDetector reads (kafka/admin.py topology derivation;
+        reference BrokerFailureDetector.java:88 ZK watch analog)."""
+        if broker_id == self.controller:
+            raise ValueError("refusing to kill the controller in this fake")
+        with self._lock:
+            self._dead.add(broker_id)
+            for parts in self.topics.values():
+                for p in parts.values():
+                    if p["leader"] == broker_id:
+                        alive = [
+                            b for b in p["replicas"]
+                            if b != broker_id and b not in self._dead
+                        ]
+                        p["leader"] = alive[0] if alive else -1
+        for s in self._servers:
+            if s.node_id == broker_id:
+                s.stop()
+
     # ------------------------------------------------------ request logic
 
     def handle(self, node_id: int, api: proto.Api, body: dict) -> dict:
@@ -152,6 +178,7 @@ class FakeKafkaCluster:
                 {"node_id": b, "host": "127.0.0.1", "port": info["port"],
                  "rack": info["rack"] or None}
                 for b, info in sorted(self.brokers.items())
+                if b not in self._dead
             ],
             "controller_id": self.controller,
             "topics": [

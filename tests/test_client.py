@@ -53,6 +53,51 @@ def test_cli_async_proposals(service, capsys):
 def test_cli_rebalance_dryrun(service, capsys):
     rc, payload = run_cli(service, capsys, "rebalance", "--dryrun", "true")
     assert rc == 0 and "proposals" in payload
+    # per-phase ETA derived from data-to-move over active caps (ADVICE r4
+    # weak #8: dataToMoveMB alone was surfaced)
+    eta = payload["estimatedExecutionTime"]
+    assert set(eta) == {
+        "interBrokerSeconds", "intraBrokerSeconds", "leadershipSeconds",
+        "assumptions",
+    }
+    assert eta["assumptions"]["concurrentLeaderMovements"] >= 1
+    assert eta["assumptions"]["dataToMoveMB"] == payload["dataToMoveMB"]
+
+
+def test_cli_user_tasks_filters(service, capsys):
+    """user_tasks filter flags reach the server-side filters
+    (service/parameters.py user_task_ids/client_ids/endpoints/types)."""
+    rc, _ = run_cli(service, capsys, "proposals")  # async op -> user task
+    rc, payload = run_cli(service, capsys, "user_tasks",
+                          "--endpoints", "PROPOSALS")
+    assert rc == 0
+    tasks = payload["userTasks"]
+    assert tasks and all("proposals" in t["RequestURL"].lower() for t in tasks)
+    # a filter that matches nothing returns an empty list, not an error
+    rc, payload = run_cli(service, capsys, "user_tasks",
+                          "--endpoints", "TRAIN")
+    assert rc == 0 and payload["userTasks"] == []
+
+
+def test_cli_admin_concurrency_flags(service, capsys):
+    """ADMIN mid-execution concurrency flags serialize to the server's
+    parameter names; with no live execution the server answers 400 and
+    the CLI reports the error body (exit 1)."""
+    p = build_parser()
+    args = p.parse_args([
+        "admin",
+        "--concurrent-partition-movements-per-broker", "8",
+        "--concurrent-leader-movements", "500",
+        "--execution-progress-check-interval-ms", "100",
+    ])
+    assert args.concurrent_partition_movements_per_broker == "8"
+    with pytest.raises(SystemExit):
+        p.parse_args(["admin", "--concurrent-leader-movements", "0"])  # < 1
+    rc, payload = run_cli(
+        service, capsys, "admin",
+        "--concurrent-partition-movements-per-broker", "8",
+    )
+    assert rc == 1 and "no ongoing execution" in json.dumps(payload)
 
 
 def test_cli_error_reporting(service, capsys):
