@@ -1376,25 +1376,33 @@ class Engine:
 
         ls, rs, lcs, ps, lbs = gather(src)
         ld, rd, lcd, pd, lbd = gather(dst)
-        old = self._broker_terms(sx, src, ls, rs, lcs, ps, lbs, g) + self._broker_terms(
-            sx, dst, ld, rd, lcd, pd, lbd, g
+        # ONE stacked _broker_terms call over a [4, K] lane axis
+        # (src-old, dst-old, src-new, dst-new) instead of four separate
+        # inlines: element-wise identical math, but the traced step program
+        # shrinks by ~1.5k equations — warm-start trace time is paced by
+        # graph size (this helper is reached from all three candidate kinds)
+        b4 = jnp.stack([src, dst, src, dst])
+        t4 = self._broker_terms(
+            sx,
+            b4,
+            jnp.stack([ls, ld, ls + dload_src, ld + dload_dst]),
+            jnp.stack([rs, rd, rs - dcount, rd + dcount]),
+            jnp.stack([lcs, lcd, lcs - dlcount, lcd + dlcount]),
+            jnp.stack([ps, pd, ps - dpot, pd + dpot]),
+            jnp.stack([lbs, lbd, lbs - dlbin_src, lbd + dlbin]),
+            g,
         )
-        new = self._broker_terms(
-            sx, src, ls + dload_src, rs - dcount, lcs - dlcount, ps - dpot, lbs - dlbin_src, g
-        ) + self._broker_terms(
-            sx, dst, ld + dload_dst, rd + dcount, lcd + dlcount, pd + dpot, lbd + dlbin, g
-        )
-        delta = new - old
+        delta = (t4[2] + t4[3]) - (t4[0] + t4[1])
 
         # host-granularity capacity (same-host moves cancel)
         h_s, h_d = st.broker_host[src], st.broker_host[dst]
         hl_s, hl_d = carry.host_load[h_s], carry.host_load[h_d]
-        dh = (
-            self._host_terms(sx, h_s, hl_s + dload_src)
-            - self._host_terms(sx, h_s, hl_s)
-            + self._host_terms(sx, h_d, hl_d + dload_dst)
-            - self._host_terms(sx, h_d, hl_d)
+        th4 = self._host_terms(
+            sx,
+            jnp.stack([h_s, h_s, h_d, h_d]),
+            jnp.stack([hl_s + dload_src, hl_s, hl_d + dload_dst, hl_d]),
         )
+        dh = th4[0] - th4[1] + th4[2] - th4[3]
         delta += jnp.where(h_s != h_d, dh, 0.0)
 
         # intra-broker disk goals
@@ -1406,12 +1414,14 @@ class Engine:
             row_s2 = row_s - oh_s * ddisk_src[:, None]
             row_d2 = row_d + oh_d * ddisk[:, None]
             bsum_s, bsum_d = row_s.sum(-1), row_d.sum(-1)
-            delta += (
-                self._disk_terms(sx, src, row_s2, bsum_s - ddisk_src, g)
-                - self._disk_terms(sx, src, row_s, bsum_s, g)
-                + self._disk_terms(sx, dst, row_d2, bsum_d + ddisk, g)
-                - self._disk_terms(sx, dst, row_d, bsum_d, g)
+            td4 = self._disk_terms(
+                sx,
+                jnp.stack([src, src, dst, dst]),
+                jnp.stack([row_s2, row_s, row_d2, row_d]),
+                jnp.stack([bsum_s - ddisk_src, bsum_s, bsum_d + ddisk, bsum_d]),
+                g,
             )
+            delta += td4[0] - td4[1] + td4[2] - td4[3]
 
         # dispersion tiebreaker via sufficient statistics
         cap_s = st.broker_capacity[src] + 1e-12

@@ -21,7 +21,11 @@ from cruise_control_tpu.analyzer.objective import (
     balancedness_score,
 )
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
-from cruise_control_tpu.analyzer.proposals import ExecutionProposal, extract_proposals
+from cruise_control_tpu.analyzer.proposals import (
+    ExecutionProposal,
+    ProposalSet,
+    extract_proposals,
+)
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.state import ClusterState, validate
 from cruise_control_tpu.models.stats import ClusterStats, compute_stats
@@ -48,17 +52,27 @@ class OptimizerResult:
 
     @property
     def num_inter_broker_moves(self) -> int:
-        return sum(1 for p in self.proposals if p.has_replica_action)
+        # ProposalSet answers from its columns without materializing the
+        # ~100k ExecutionProposal objects; plain lists (tests, ad-hoc
+        # results) take the object path
+        ps = self.proposals
+        if isinstance(ps, ProposalSet):
+            return ps.num_inter_broker_moves
+        return sum(1 for p in ps if p.has_replica_action)
 
     @property
     def num_leadership_moves(self) -> int:
-        return sum(
-            1 for p in self.proposals if p.has_leader_action and not p.has_replica_action
-        )
+        ps = self.proposals
+        if isinstance(ps, ProposalSet):
+            return ps.num_leadership_moves
+        return sum(1 for p in ps if p.has_leader_action and not p.has_replica_action)
 
     @property
     def data_to_move(self) -> float:
-        return sum(p.inter_broker_data_to_move for p in self.proposals)
+        ps = self.proposals
+        if isinstance(ps, ProposalSet):
+            return ps.data_to_move
+        return sum(p.inter_broker_data_to_move for p in ps)
 
     def violated_goals_after(self, tol: float = 1e-6) -> list[str]:
         """Default tol matches balancedness_score's goal-satisfied epsilon

@@ -76,17 +76,145 @@ def fetch_before_host(state: ClusterState) -> dict:
     return out
 
 
+class ProposalSet:
+    """Columnar proposal set with LAZY ExecutionProposal materialization.
+
+    The optimizer's native diff output is columnar (per-touched-partition
+    numpy rows); building ~100k Python dataclass instances costs more than
+    an entire device annealing round at north-star scale.  This sequence
+    keeps the columns and materializes objects only when a consumer
+    actually iterates (the executor at execution start, REST serializing
+    its first-100 preview) — aggregate stats (move counts, data to move)
+    come straight off the arrays.
+
+    Quacks like the list the rest of the stack always consumed: len(),
+    iteration, indexing/slicing, bool, list() all work.
+    """
+
+    def __init__(self, columns: dict, disk_rows: dict):
+        self._c = columns
+        self._disk_rows = disk_rows
+        self._all: list[ExecutionProposal] | None = None
+
+    # ---------------------------------------------------- aggregate stats
+
+    def __len__(self) -> int:
+        return len(self._c["touched"])
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    @property
+    def num_inter_broker_moves(self) -> int:
+        """Rows whose replica SET changed (ExecutionProposal.has_replica_action)."""
+        return int(self._c["set_changed"].sum())
+
+    @property
+    def num_leadership_moves(self) -> int:
+        c = self._c
+        return int(((c["old_leader"] != c["new_leader"]) & ~c["set_changed"]).sum())
+
+    @property
+    def data_to_move(self) -> float:
+        return float(self._c["data"].sum())
+
+    @property
+    def intra_data_to_move(self) -> float:
+        return float(self._c["intra_data"].sum())
+
+    @property
+    def source_brokers(self) -> set[int]:
+        """Brokers shipping replica data away (execution-ETA input)."""
+        c = self._c
+        src = c["tb_old"][c["moved"]]
+        return {int(b) for b in np.unique(src)}
+
+    # ---------------------------------------------------- materialization
+
+    def _rows(self, ks) -> list[ExecutionProposal]:
+        c = self._c
+        # the values tuple below is hand-ordered to match — this assert
+        # makes a field reorder/insert in ExecutionProposal fail loudly
+        # here instead of silently scrambling every proposal
+        fields = tuple(f.name for f in dataclasses.fields(ExecutionProposal))
+        assert fields == (
+            "partition", "topic", "old_leader", "new_leader",
+            "old_replicas", "new_replicas", "disk_moves",
+            "inter_broker_data_to_move", "intra_broker_data_to_move",
+        ), fields
+        new = ExecutionProposal.__new__
+        cls = ExecutionProposal
+        disk_rows = self._disk_rows
+        empty: tuple = ()
+        out: list[ExecutionProposal] = []
+        append = out.append
+        for k, (p, t, olr, nlr, obk, nbk, nv, dt, idt) in zip(ks, zip(
+            c["touched"][ks].tolist(), c["topic"][ks].tolist(),
+            c["old_leader"][ks].tolist(), c["new_leader"][ks].tolist(),
+            c["ob"][ks].tolist(), c["nb"][ks].tolist(),
+            c["n_valid"][ks].tolist(), c["data"][ks].tolist(),
+            c["intra_data"][ks].tolist(),
+        )):
+            o = new(cls)
+            # frozen dataclass: populate __dict__ directly —
+            # object.__setattr__ per field costs ~4x across ~100k proposals
+            o.__dict__.update(zip(fields, (
+                p, t, olr, nlr, tuple(obk[:nv]), tuple(nbk[:nv]),
+                disk_rows.get(int(k), empty), dt, idt,
+            )))
+            append(o)
+        return out
+
+    def _materialize(self) -> list[ExecutionProposal]:
+        if self._all is None:
+            self._all = self._rows(np.arange(len(self)))
+        return self._all
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __getitem__(self, item):
+        if isinstance(item, slice):
+            if self._all is not None:
+                return self._all[item]
+            return self._rows(np.arange(len(self))[item])
+        return self._materialize()[item]
+
+    def __eq__(self, other):
+        if isinstance(other, (list, tuple)):
+            return self._materialize() == list(other)
+        if isinstance(other, ProposalSet):
+            return self._materialize() == other._materialize()
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"ProposalSet({len(self)} proposals)"
+
+
+def _empty_proposal_set() -> ProposalSet:
+    z = np.zeros(0, np.int64)
+    return ProposalSet(
+        dict(touched=z, topic=z, old_leader=z, new_leader=z,
+             ob=np.zeros((0, 1), np.int64), nb=np.zeros((0, 1), np.int64),
+             n_valid=z, data=np.zeros(0), intra_data=np.zeros(0),
+             set_changed=np.zeros(0, bool), moved=np.zeros((0, 1), bool),
+             tb_old=np.zeros((0, 1), np.int64)),
+        {},
+    )
+
+
 def extract_proposals(
     before: ClusterState,
     after: ClusterState,
     before_host: dict | None = None,
-) -> list[ExecutionProposal]:
+) -> ProposalSet:
     """Diff two placements into per-partition proposals
     (reference analyzer/AnalyzerUtils.getDiff:50-117).
 
     Vectorized over a padded [P, max_rf] partition-replica table: at
     LinkedIn scale a rebalance touches >100k partitions and per-partition
-    numpy slicing would dominate the optimizer wall-clock.
+    numpy slicing would dominate the optimizer wall-clock.  Returns a
+    columnar ProposalSet; ExecutionProposal objects materialize lazily.
 
     before_host: pre-fetched numpy copies of the before-state arrays
     (fetch_before_host) — skips re-transferring them.
@@ -115,7 +243,7 @@ def extract_proposals(
 
     changed = valid & ((b_old != b_new) | (l_old != l_new) | (d_old != d_new))
     if not changed.any():
-        return []
+        return _empty_proposal_set()
     touched = np.unique(part_arr[changed])
 
     # padded per-partition replica rows, already in preferred (pos) order
@@ -150,9 +278,9 @@ def extract_proposals(
         idx = np.argsort(key, axis=1, kind="stable")
         return np.take_along_axis(tb, idx, axis=1)
 
-    n_valid = mask.sum(1).tolist()
-    ob = reorder(tb_old, old_leader).tolist()
-    nb = reorder(tb_new, new_leader).tolist()
+    n_valid = mask.sum(1)
+    ob = reorder(tb_old, old_leader)
+    nb = reorder(tb_new, new_leader)
     has_disk = disk_changed.any(1)
     disk_rows = {
         int(k): tuple(
@@ -163,31 +291,16 @@ def extract_proposals(
     }
 
     intra_data = np.where(disk_changed, disk_bytes[rows], 0.0).sum(1)
+    # replica SET change per row (has_replica_action semantics: a
+    # within-partition slot swap is not a membership change)
+    set_changed = (np.sort(tb_old, axis=1) != np.sort(tb_new, axis=1)).any(1)
 
-    # the values tuple below is hand-ordered to match — this assert makes a
-    # field reorder/insert in ExecutionProposal fail loudly here instead of
-    # silently scrambling every proposal
-    fields = tuple(f.name for f in dataclasses.fields(ExecutionProposal))
-    assert fields == (
-        "partition", "topic", "old_leader", "new_leader",
-        "old_replicas", "new_replicas", "disk_moves", "inter_broker_data_to_move",
-        "intra_broker_data_to_move",
-    ), fields
-    new = ExecutionProposal.__new__
-    cls = ExecutionProposal
-    proposals: list[ExecutionProposal] = []
-    append = proposals.append
-    empty: tuple = ()
-    for k, (p, t, olr, nlr, obk, nbk, nv, dt, idt) in enumerate(zip(
-        touched.tolist(), t_topic.tolist(), old_leader.tolist(),
-        new_leader.tolist(), ob, nb, n_valid, data.tolist(), intra_data.tolist(),
-    )):
-        o = new(cls)
-        # frozen dataclass: populate __dict__ directly — object.__setattr__
-        # per field costs ~4x as much across ~100k proposals
-        o.__dict__.update(zip(fields, (
-            p, t, olr, nlr, tuple(obk[:nv]), tuple(nbk[:nv]),
-            disk_rows.get(k, empty), dt, idt,
-        )))
-        append(o)
-    return proposals
+    return ProposalSet(
+        dict(
+            touched=touched, topic=t_topic, old_leader=old_leader,
+            new_leader=new_leader, ob=ob, nb=nb, n_valid=n_valid,
+            data=data, intra_data=intra_data, set_changed=set_changed,
+            moved=moved, tb_old=tb_old,
+        ),
+        disk_rows,
+    )

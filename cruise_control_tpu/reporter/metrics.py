@@ -313,7 +313,10 @@ class ReferenceMetricSerde:
             return None  # newer metric class than we know: skip (reference behavior)
         version, ref_id, time_ms, broker_id = struct.unpack_from(">BBqi", data, 1)
         if version > _REFERENCE_METRIC_VERSION:
-            raise ValueError(f"unsupported reference metric version {version}")
+            # a bumped record version may have changed the field layout —
+            # skip the record rather than decode garbage; raising would
+            # discard the entire already-drained poll batch
+            return None
         mt = _REF_TYPE_BY_ID.get(ref_id)
         if mt is None:
             # a newer reporter plugin emitting a type we don't know yet —

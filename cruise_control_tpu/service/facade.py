@@ -510,14 +510,15 @@ class CruiseControl:
             "stopped": out.stopped,
         }
 
-    def _execution_eta(self, result) -> dict:
+    def _execution_eta(self, result, execution_overrides: dict | None = None) -> dict:
         """Per-phase execution ETA for an optimization result.
 
-        Derived, transparently, from data-to-move over the ACTIVE caps —
-        the live mid-execution overrides (POST /admin) shift the estimate:
+        Derived, transparently, from data-to-move over the caps THIS
+        request's execution would run with: request execution overrides
+        first, then any live mid-execution /admin override, then config:
           * interBroker/intraBroker: bytes over the aggregate replication
             bandwidth (per-broker throttle x brokers moving concurrently);
-            null when no throttle is configured (bandwidth unknown).
+            null when no throttle applies (bandwidth unknown).
           * leadership: election batches x progress-check interval.
         The reference exposes only dataToMoveMB
         (executor/ExecutionProposal.java:106-229); the ETA is this
@@ -527,26 +528,41 @@ class CruiseControl:
         import math
 
         cfg = self.config
-        req = self.executor.requested_concurrency()
-        lead_cap = req.get("leadership", cfg.get("num.concurrent.leader.movements"))
+        ov = execution_overrides or {}
+        req = (
+            self.executor.requested_concurrency()
+            if self.executor.has_ongoing_execution
+            else {}
+        )
+        lead_cap = ov.get("concurrent_leader_movements") or req.get(
+            "leadership", cfg.get("num.concurrent.leader.movements")
+        )
         interval_s = req.get(
             "interval_s", cfg.get("execution.progress.check.interval.ms") / 1000.0
         )
-        throttle = cfg.get("default.replication.throttle")  # bytes/s per broker
+        throttle = self._effective_throttle(ov)  # bytes/s per broker
         leads = result.num_leadership_moves
         # brokers shipping data concurrently.  The per-broker MOVE cap does
         # not appear in the formula on purpose: under a per-BROKER byte
         # throttle, splitting a broker's bandwidth across more concurrent
         # moves does not change its aggregate egress rate.
-        src_brokers = {
-            b for p in result.proposals if p.has_replica_action
-            for b in p.old_replicas if b not in p.new_replicas
-        }
+        ps = result.proposals
+        if hasattr(ps, "source_brokers"):
+            src_brokers = ps.source_brokers  # columnar, no materialization
+        else:
+            src_brokers = {
+                b for p in ps if p.has_replica_action
+                for b in p.old_replicas if b not in p.new_replicas
+            }
         inter_s = intra_s = None
         if throttle:
             agg_bw = float(throttle) * max(1, len(src_brokers))
             inter_s = result.data_to_move * 1024.0 * 1024.0 / agg_bw
-            intra_mb = sum(p.intra_broker_data_to_move for p in result.proposals)
+            intra_mb = (
+                ps.intra_data_to_move
+                if hasattr(ps, "intra_data_to_move")
+                else sum(p.intra_broker_data_to_move for p in ps)
+            )
             intra_s = intra_mb * 1024.0 * 1024.0 / agg_bw if intra_mb else 0.0
         lead_s = math.ceil(leads / max(1, lead_cap)) * interval_s if leads else 0.0
         return {
@@ -563,6 +579,16 @@ class CruiseControl:
                 "dataToMoveMB": result.data_to_move,
             },
         }
+
+    def _effective_throttle(self, ov: dict | None = None) -> float | None:
+        """Replication throttle for a request: request override, else the
+        configured default; non-positive values (the conventional -1 =
+        'disabled') normalize to None so neither the throttle helper nor
+        the ETA ever sees a bogus negative rate."""
+        v = (ov or {}).get("replication_throttle")
+        if v is None:
+            v = self.config.get("default.replication.throttle")
+        return float(v) if v is not None and v > 0 else None
 
     def _exec_options(self, ov: dict | None = None) -> ExecutionOptions:
         """ExecutionOptions from config + per-request overrides — ONE
@@ -595,9 +621,7 @@ class CruiseControl:
             intra_broker_rate_alerting_mb_s=self.config.get(
                 "intra.broker.replica.movement.rate.alerting.threshold"
             ),
-            replication_throttle_bytes_per_s=_ov(
-                "replication_throttle", "default.replication.throttle"
-            ),
+            replication_throttle_bytes_per_s=self._effective_throttle(ov),
             progress_check_interval_s=self.config.get(
                 "execution.progress.check.interval.ms"
             )
@@ -726,7 +750,9 @@ class CruiseControl:
                 progress, allow_capacity_estimation=allow_capacity_estimation
             )
         out = result.summary()
-        out["estimatedExecutionTime"] = self._execution_eta(result)
+        out["estimatedExecutionTime"] = self._execution_eta(
+            result, execution_overrides
+        )
         out["proposals"] = [p.to_json() for p in result.proposals[:100]]
         if not dryrun:
             out["execution"] = self._execute(
@@ -756,7 +782,9 @@ class CruiseControl:
         )
         result = self.optimizer.optimize(state, options=options)
         out = result.summary()
-        out["estimatedExecutionTime"] = self._execution_eta(result)
+        out["estimatedExecutionTime"] = self._execution_eta(
+            result, execution_overrides
+        )
         if not dryrun:
             out["execution"] = self._execute(
                 result, progress, removed=set(broker_ids),

@@ -531,6 +531,7 @@ class CruiseControlApp:
                 allow_capacity_estimation=allow_est,
             )
             out = result.summary()
+            out["estimatedExecutionTime"] = self.cc._execution_eta(result)
             out["proposals"] = [p.to_json() for p in result.proposals[:100]]
             return out
 

@@ -62,6 +62,33 @@ def test_capacity_json_cores():
     assert r.capacity_for_broker("r0", "h0", 0).num_cores == 32
 
 
+def test_openapi_spec_is_current():
+    """docs/openapi.json must match what scripts/gen_api_spec.py derives
+    from the served endpoint/parameter/schema declarations — a drifted
+    spec is worse than none (reference regenerates its Swagger wiki via
+    build_api_wiki.sh)."""
+    import json
+    import importlib.util
+
+    spec_path = os.path.join(REPO, "docs", "openapi.json")
+    gen_path = os.path.join(REPO, "scripts", "gen_api_spec.py")
+    s = importlib.util.spec_from_file_location("gen_api_spec", gen_path)
+    mod = importlib.util.module_from_spec(s)
+    s.loader.exec_module(mod)
+    with open(spec_path) as f:
+        committed = json.load(f)
+    assert committed == mod.build_spec(), (
+        "docs/openapi.json is stale — run scripts/gen_api_spec.py"
+    )
+    # every served endpoint appears with its method
+    from cruise_control_tpu.config.endpoints import GET_ENDPOINTS, POST_ENDPOINTS
+
+    for ep in GET_ENDPOINTS:
+        assert "get" in committed["paths"][f"/{ep}"]
+    for ep in POST_ENDPOINTS:
+        assert "post" in committed["paths"][f"/{ep}"]
+
+
 def test_service_boots_from_shipped_properties():
     """The start script's exact path: load the shipped properties, boot the
     service from them (simulated backend — no bootstrap.servers), serve a
