@@ -6,12 +6,14 @@ target < 10 s on one TPU chip) printed LAST so drivers that parse the
 final line get the headline metric.  `vs_baseline` on the headline is
 wall / 10s (the fraction of the north-star budget used; < 1.0 beats it).
 
-Configs (BASELINE.md "Benchmark configs to implement"):
+Configs (BASELINE.md "Benchmark configs to implement" + additions):
   1 deterministic 3-broker parity oracle vs reference-style greedy
   2 RandomCluster 50/5k, ResourceDistribution+ReplicaCapacity goals
   3 JBOD 500/50k, DiskCapacity+RackAware goals
   4 north-star 2600/200k, full default.goals          <- headline
   5 broker-decommission self-healing on the 2600/200k model
+  6 cluster-model generation wall-clock at north-star scale
+  7 ShardedEngine (model-sharded scale-out path) at north-star scale
 
 Greedy comparisons (configs 1,2,3,5) run the CPU oracle
 (cruise_control_tpu/analyzer/greedy.py) under a wall-clock budget — the

@@ -419,6 +419,7 @@ class Engine:
         self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
         self._jit_round_prep = jax.jit(self._round_prep_impl)
         self._jit_init = jax.jit(self._init_impl)
+        self._jit_eval = jax.jit(self._eval_impl)
         self._warm_futures: dict | None = None
 
     # ------------------------------------------------------------------
@@ -466,7 +467,7 @@ class Engine:
             ("_jit_objective", (sx_av, carry_av)),
             ("_jit_plan", (sx_av, carry_av)),
             ("_jit_round_prep", (sx_av, carry_av)),
-            ("_jit_violations", (sx_av, carry_av)),
+            ("_jit_eval", (sx_av, carry_av)),
         ]
         pool = cf.ThreadPoolExecutor(max_workers=2, thread_name_prefix="engine-warm")
         self._warm_futures = {
@@ -623,6 +624,15 @@ class Engine:
         """Authoritative early-stop signal: the WORST per-goal violation
         from the full goal chain — evaluated against the carry's incremental
         aggregates, so no O(R) segment-sums are recomputed."""
+        return self._eval_impl(sx, carry)[1]
+
+    def _eval_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """(full objective, worst per-goal violation) as ONE program.
+
+        run() needs the objective at round start (temperature scaling) and
+        the violation max at the early-stop gate; tracing the full goal
+        chain once instead of twice halves the chain's share of the
+        warm-start trace bill."""
         from cruise_control_tpu.models.aggregates import BrokerAggregates
 
         agg = BrokerAggregates(
@@ -635,10 +645,10 @@ class Engine:
             part_rack_count=carry.part_rack_count,
             disk_load=carry.disk_load,
         )
-        _, viol, _ = self.chain.evaluate(
+        obj, viol, _ = self.chain.evaluate(
             self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint
         )
-        return jnp.max(viol)
+        return obj, jnp.max(viol)
 
     def _plan_impl(self, sx: EngineStatics, carry: EngineCarry) -> SamplingPlan:
         """Importance-sampling + movement-pricing plan from current aggregates."""
@@ -1714,7 +1724,7 @@ class Engine:
         sx = self.statics
         carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
 
-        t0_obj = float(self._fn("_jit_objective")(sx, carry)) * cfg.init_temperature_scale
+        t0_obj = float(self._fn("_jit_eval")(sx, carry)[0]) * cfg.init_temperature_scale
         plan = self._fn("_jit_plan")(sx, carry)
         history = []
         # the authoritative (full-chain) early-stop check is bounded: when
@@ -1755,7 +1765,7 @@ class Engine:
             accepted = int(step_accepts.sum())
             history.append(dict(round=rnd, temperature=_temp(rnd), accepted=accepted))
             if verbose:
-                history[-1]["objective"] = float(self._fn("_jit_objective")(sx, carry))
+                history[-1]["objective"] = float(self._fn("_jit_eval")(sx, carry)[0])
             # early stop: all goals already satisfied.  The O(B) lower bound
             # gates the authoritative full-chain check so healthy rounds pay
             # ~nothing.
@@ -1765,7 +1775,7 @@ class Engine:
                 and full_checks_left > 0
                 and float(cheap) <= cfg.early_stop_violations
             ):
-                if float(self._fn("_jit_violations")(sx, carry)) <= cfg.early_stop_violations:
+                if float(self._fn("_jit_eval")(sx, carry)[1]) <= cfg.early_stop_violations:
                     history[-1]["early_stop"] = True
                     break
                 full_checks_left -= 1
@@ -1777,7 +1787,7 @@ class Engine:
                 tol = cfg.early_stop_violations
                 prev_v = None
                 for _ in range(cfg.max_extra_rounds):
-                    v = float(self._fn("_jit_violations")(sx, carry))
+                    v = float(self._fn("_jit_eval")(sx, carry)[1])
                     if v <= tol or (prev_v is not None and v > prev_v * 0.9):
                         break
                     prev_v = v

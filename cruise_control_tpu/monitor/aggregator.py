@@ -332,40 +332,56 @@ class WindowedMetricSampleAggregator:
                 values /= np.maximum(counts[..., None], 1)
             values[:, :, nonavg] = saved
 
-            ext = np.full((E, widx.size), Extrapolation.NO_VALID_EXTRAPOLATION, np.int8)
-            ext[counts >= 1] = Extrapolation.FORCED_INSUFFICIENT
-            # AVG_ADJACENT: zero-count window whose neighbors (in window-index
-            # space) both have >= min_samples
-            cnt_full = self._counts[:E]  # ring layout
-            left = np.clip(widx + 1, 0, None)  # newer neighbor
-            right = widx - 1
-            left_ok = np.zeros((E, widx.size), bool)
-            right_ok = np.zeros((E, widx.size), bool)
-            in_range = (left <= self._current_window)
-            left_ok[:, in_range] = cnt_full[:, (left[in_range]) % self._W] >= self.min_samples
-            in_range_r = right >= oldest
-            right_ok[:, in_range_r] = cnt_full[:, (right[in_range_r]) % self._W] >= self.min_samples
-            adj = (counts == 0) & left_ok & right_ok
-            ext[adj] = Extrapolation.AVG_ADJACENT
-            # fill adjacent-average values
-            if adj.any():
-                e_i, w_i = np.nonzero(adj)
-                lv = self._acc[:E][e_i, (widx[w_i] + 1) % self._W]
-                lc = cnt_full[e_i, (widx[w_i] + 1) % self._W]
-                rv = self._acc[:E][e_i, (widx[w_i] - 1) % self._W]
-                rc = cnt_full[e_i, (widx[w_i] - 1) % self._W]
-                lval = lv.copy()
-                rval = rv.copy()
-                lval[:, avg] = lv[:, avg] / np.maximum(lc[:, None], 1)
-                rval[:, avg] = rv[:, avg] / np.maximum(rc[:, None], 1)
-                values[e_i, w_i] = 0.5 * (lval + rval)
-            ext[counts >= self.half_min] = Extrapolation.AVG_AVAILABLE
-            ext[counts >= self.min_samples] = Extrapolation.NONE
+            if (counts >= self.min_samples).all():
+                # healthy fast path — every (entity, window) cell fully
+                # sampled, the steady-state norm: no extrapolation masks,
+                # no neighbor machinery.  At 200k entities this skips
+                # ~1/3 of the aggregation wall (the reference's
+                # cluster-model-creation-timer path,
+                # monitor/LoadMonitor.java:100,510)
+                ext = np.full((E, widx.size), Extrapolation.NONE, np.int8)
+                window_valid = np.ones((E, widx.size), bool)
+                entity_valid = np.ones(E, bool)
+            else:
+                ext = np.full(
+                    (E, widx.size), Extrapolation.NO_VALID_EXTRAPOLATION, np.int8
+                )
+                ext[counts >= 1] = Extrapolation.FORCED_INSUFFICIENT
+                # AVG_ADJACENT: zero-count window whose neighbors (in
+                # window-index space) both have >= min_samples
+                cnt_full = self._counts[:E]  # ring layout
+                left = np.clip(widx + 1, 0, None)  # newer neighbor
+                right = widx - 1
+                left_ok = np.zeros((E, widx.size), bool)
+                right_ok = np.zeros((E, widx.size), bool)
+                in_range = (left <= self._current_window)
+                left_ok[:, in_range] = cnt_full[:, (left[in_range]) % self._W] >= self.min_samples
+                in_range_r = right >= oldest
+                right_ok[:, in_range_r] = cnt_full[:, (right[in_range_r]) % self._W] >= self.min_samples
+                adj = (counts == 0) & left_ok & right_ok
+                ext[adj] = Extrapolation.AVG_ADJACENT
+                # fill adjacent-average values
+                if adj.any():
+                    e_i, w_i = np.nonzero(adj)
+                    lv = self._acc[:E][e_i, (widx[w_i] + 1) % self._W]
+                    lc = cnt_full[e_i, (widx[w_i] + 1) % self._W]
+                    rv = self._acc[:E][e_i, (widx[w_i] - 1) % self._W]
+                    rc = cnt_full[e_i, (widx[w_i] - 1) % self._W]
+                    lval = lv.copy()
+                    rval = rv.copy()
+                    lval[:, avg] = lv[:, avg] / np.maximum(lc[:, None], 1)
+                    rval[:, avg] = rv[:, avg] / np.maximum(rc[:, None], 1)
+                    values[e_i, w_i] = 0.5 * (lval + rval)
+                ext[counts >= self.half_min] = Extrapolation.AVG_AVAILABLE
+                ext[counts >= self.min_samples] = Extrapolation.NONE
 
-            window_valid = ext != Extrapolation.NO_VALID_EXTRAPOLATION
-            extrapolated = window_valid & (ext != Extrapolation.NONE)
-            too_many_ext = extrapolated.sum(1) > options.max_allowed_extrapolations_per_entity
-            entity_valid = window_valid.all(axis=1) & ~too_many_ext
+                window_valid = ext != Extrapolation.NO_VALID_EXTRAPOLATION
+                extrapolated = window_valid & (ext != Extrapolation.NONE)
+                too_many_ext = (
+                    extrapolated.sum(1)
+                    > options.max_allowed_extrapolations_per_entity
+                )
+                entity_valid = window_valid.all(axis=1) & ~too_many_ext
 
             # group validity: all entities of the group must be valid.
             # The hash pass over E entities only runs when group

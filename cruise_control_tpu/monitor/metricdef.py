@@ -134,6 +134,29 @@ def kafka_metric_def() -> MetricDef:
         "BROKER_LOG_FLUSH_RATE",
         "BROKER_LOG_FLUSH_TIME_MS_MAX",
         "BROKER_LOG_FLUSH_TIME_MS_MEAN",
+        # percentile latencies (reference KafkaMetricDef BROKER_ONLY v5
+        # additions; SlowBrokerFinder evidence) — ingested from the
+        # reference reporter plugin's RawMetricType ids 43-62
+        "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH",
+        "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH",
+        "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_50TH",
+        "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_999TH",
+        "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_50TH",
+        "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_999TH",
+        "BROKER_PRODUCE_TOTAL_TIME_MS_50TH",
+        "BROKER_PRODUCE_TOTAL_TIME_MS_999TH",
+        "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_50TH",
+        "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_999TH",
+        "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_50TH",
+        "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_999TH",
+        "BROKER_PRODUCE_LOCAL_TIME_MS_50TH",
+        "BROKER_PRODUCE_LOCAL_TIME_MS_999TH",
+        "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_50TH",
+        "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH",
+        "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_50TH",
+        "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH",
+        "BROKER_LOG_FLUSH_TIME_MS_50TH",
+        "BROKER_LOG_FLUSH_TIME_MS_999TH",
     ):
         d.define(name, AVG, B)
     return d

@@ -50,6 +50,13 @@ _BROKER_METRIC_MAP = {
     MetricType.ALL_TOPIC_REPLICATION_BYTES_IN: "REPLICATION_BYTES_IN_RATE",
     MetricType.ALL_TOPIC_REPLICATION_BYTES_OUT: "REPLICATION_BYTES_OUT_RATE",
 }
+# percentile latencies (reference reporter ids 43-62): MetricType and
+# KafkaMetricDef names coincide, so the map rows are mechanical
+_BROKER_METRIC_MAP.update({
+    mt: mt.name
+    for mt in MetricType
+    if mt.name.endswith(("_50TH", "_999TH"))
+})
 
 
 class CruiseControlMetricsReporterSampler:

@@ -201,6 +201,7 @@ def test_reference_format_records_flow_into_aggregator():
         # broker-internal metrics: the SlowBrokerFinder's inputs
         BrokerMetric(MetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT, 500, 0, 0.8),
         BrokerMetric(MetricType.BROKER_PRODUCE_LOCAL_TIME_MS_MEAN, 500, 0, 3.5),
+        BrokerMetric(MetricType.BROKER_PRODUCE_LOCAL_TIME_MS_999TH, 500, 0, 25.0),
         TopicMetric(MetricType.TOPIC_BYTES_IN, 500, 0, 300.0, topic="T0"),
         TopicMetric(MetricType.TOPIC_BYTES_OUT, 500, 0, 600.0, topic="T0"),
         PartitionMetric(MetricType.PARTITION_SIZE, 500, 0, 1000.0, topic="T0", partition=0),
@@ -217,6 +218,8 @@ def test_reference_format_records_flow_into_aggregator():
     bvals = result.broker_samples[0].values
     assert bvals[md.metric_id("BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT")] == pytest.approx(0.8)
     assert bvals[md.metric_id("BROKER_PRODUCE_LOCAL_TIME_MS_MEAN")] == pytest.approx(3.5)
+    # percentile latency (reference reporter id-space 43-62) landed too
+    assert bvals[md.metric_id("BROKER_PRODUCE_LOCAL_TIME_MS_999TH")] == pytest.approx(25.0)
 
     agg = WindowedMetricSampleAggregator(3, 1000, 1, KAFKA_METRIC_DEF)
     for s in result.partition_samples:
