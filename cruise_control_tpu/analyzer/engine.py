@@ -464,7 +464,6 @@ class Engine:
             # it while worker 2 clears the small programs in use order
             ("_scan", (sx_av, carry_av, temps_av, plan_av)),
             ("_jit_init", (sx_av, key_av)),
-            ("_jit_objective", (sx_av, carry_av)),
             ("_jit_plan", (sx_av, carry_av)),
             ("_jit_round_prep", (sx_av, carry_av)),
             ("_jit_eval", (sx_av, carry_av)),

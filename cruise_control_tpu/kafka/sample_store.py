@@ -63,10 +63,14 @@ class KafkaSampleStore:
         broker_topic: str = BROKER_SAMPLE_TOPIC,
         topic_name_fn: Callable[[int], str] | None = None,
         topic_id_fn: Callable[[str], int] | None = None,
+        metric_def=None,
     ):
+        from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+
         self.client = client
         self.topic_name_fn = topic_name_fn or str
         self.topic_id_fn = topic_id_fn or int
+        self.metric_def = metric_def or KAFKA_METRIC_DEF
         # ensure the store topics exist (reference ensureTopicsCreated;
         # 36 = TOPIC_ALREADY_EXISTS is the normal warm-restart case)
         codes = client.create_topics(
@@ -97,6 +101,14 @@ class KafkaSampleStore:
         vals = np.frombuffer(
             payload, np.float32, count=n, offset=_HEAD.size + name_len
         )
+        # samples persisted before a metric-def extension replay with the
+        # OLD vector width — pad new metrics with zeros (and tolerate a
+        # future shrink by truncating) so a warm restart survives upgrades
+        m = self.metric_def.num_metrics
+        if vals.size < m:
+            vals = np.concatenate([vals, np.zeros(m - vals.size, np.float32)])
+        elif vals.size > m:
+            vals = vals[:m]
         if kind == 0:
             entity = PartitionEntity(self.topic_id_fn(name), b)
         else:

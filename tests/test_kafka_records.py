@@ -213,7 +213,9 @@ def test_kafka_sample_store_warm_restart():
                 (s for r in replayed for s in r.partition_samples),
                 key=lambda s: (s.time_ms, s.entity.partition),
             )
-            np.testing.assert_allclose(s0.values, [0.0, 1.0, 2.0, 3.0])
+            # stored 4-wide; replay zero-pads to the live metric-def width
+            np.testing.assert_allclose(s0.values[:4], [0.0, 1.0, 2.0, 3.0])
+            assert not s0.values[4:].any()
         finally:
             client2.close()
     finally:
@@ -260,3 +262,27 @@ def test_kafka_sample_store_load_drains_past_one_fetch_round(monkeypatch):
     finally:
         client.close()
         cluster.stop()
+
+
+def test_sample_store_replays_pre_extension_vector_width():
+    """Samples persisted BEFORE a metric-def extension (e.g. the 36 -> 56
+    broker percentile additions) must replay into the wider current def:
+    short vectors zero-pad, longer ones truncate — a warm restart across
+    an upgrade must not lose the persisted history (reference
+    SampleLoadingTask warm restart)."""
+    import numpy as np
+
+    from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+    from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+
+    store = KafkaSampleStore.__new__(KafkaSampleStore)  # no cluster needed
+    store.topic_id_fn = {"T0": 0}.__getitem__
+    store.metric_def = KAFKA_METRIC_DEF
+    m = KAFKA_METRIC_DEF.num_metrics
+    old = np.arange(36, dtype=np.float32)  # pre-extension width
+    s = store._unpack(store._pack(0, 0, 3, 1234, "T0", old))
+    assert s.values.shape == (m,)
+    assert np.all(s.values[:36] == old) and np.all(s.values[36:] == 0.0)
+    long = np.arange(m + 7, dtype=np.float32)  # hypothetical future shrink
+    s2 = store._unpack(store._pack(1, 5, 0, 99, "b", long))
+    assert s2.values.shape == (m,) and np.all(s2.values == long[:m])
