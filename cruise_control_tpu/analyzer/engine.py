@@ -468,14 +468,35 @@ class Engine:
             ("_jit_round_prep", (sx_av, carry_av)),
             ("_jit_eval", (sx_av, carry_av)),
         ]
-        pool = cf.ThreadPoolExecutor(max_workers=2, thread_name_prefix="engine-warm")
-        self._warm_futures = {
-            name: pool.submit(
-                lambda fn, av: fn.trace(*av).lower().compile(), getattr(self, name), av
-            )
-            for name, av in targets
-        }
-        pool.shutdown(wait=False)
+        # DAEMON worker threads, not ThreadPoolExecutor: concurrent.futures
+        # joins its (non-daemon) workers at interpreter exit, so a compile
+        # stuck on an unresponsive device would block process shutdown
+        # forever.  Warm-up must never outlive the process.
+        import collections
+        import threading
+
+        queue = collections.deque(
+            (name, cf.Future(), getattr(self, name), av) for name, av in targets
+        )
+        self._warm_futures = {name: fut for name, fut, _, _ in queue}
+
+        def worker():
+            while True:
+                try:
+                    name, fut, fn, av = queue.popleft()
+                except IndexError:
+                    return
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn.trace(*av).lower().compile())
+                except BaseException as e:  # noqa: BLE001 — surface via _fn
+                    fut.set_exception(e)
+
+        for i in range(2):
+            threading.Thread(
+                target=worker, daemon=True, name=f"engine-warm-{i}"
+            ).start()
 
     def _fn(self, name: str):
         """The program `name`, swapped to its precompiled executable once

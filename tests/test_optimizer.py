@@ -321,3 +321,37 @@ def test_random_self_healing(seed):
         np.asarray(state.replica_broker) != np.asarray(after.replica_broker)
     ) & np.asarray(state.replica_valid)
     assert np.asarray(after.broker_alive)[np.asarray(after.replica_broker)[moved]].all()
+
+
+def test_engine_precompile_async_swaps_in_compiled_programs():
+    """The warm-start pool (daemon threads — a stuck compile must never
+    block process exit) compiles every run()-path program from abstract
+    shapes, and _fn swaps the executables in; results must match the
+    plain-jit path bit-for-bit (same programs, same inputs)."""
+    from cruise_control_tpu.analyzer import DEFAULT_CHAIN, Engine, OptimizerConfig
+    from cruise_control_tpu.analyzer.engine import _WarmedFn
+    from cruise_control_tpu.models.state import validate
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster
+
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=8, num_partitions=64, num_racks=4,
+                          num_topics=5, skew=1.0),
+        seed=0,
+    )
+    cfg = OptimizerConfig(num_candidates=128, leadership_candidates=32,
+                          steps_per_round=4, num_rounds=2)
+    warm = Engine(state, DEFAULT_CHAIN, config=cfg)
+    warm.precompile_async()
+    final_w, _ = warm.run()
+    assert validate(final_w) == []
+    for name in ("_scan", "_jit_init", "_jit_plan", "_jit_round_prep", "_jit_eval"):
+        assert isinstance(getattr(warm, name), _WarmedFn), name
+
+    cold = Engine(state, DEFAULT_CHAIN, config=cfg)
+    final_c, _ = cold.run()
+    np.testing.assert_array_equal(
+        np.asarray(final_w.replica_broker), np.asarray(final_c.replica_broker)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final_w.replica_is_leader), np.asarray(final_c.replica_is_leader)
+    )
