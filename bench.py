@@ -497,8 +497,58 @@ def config_4(scale_order):
     return opt, used, result
 
 
+def _device_watchdog(timeout_s: float = 180.0) -> str | None:
+    """None when the accelerator answers a trivial op within the budget,
+    else a diagnosis string (hang vs immediate failure).
+
+    The tunneled TPU can wedge (observed: every device op hangs
+    indefinitely); without this gate the whole bench blocks forever and
+    the driver records a timeout kill instead of a diagnosable artifact.
+    Runs the probe on a DAEMON thread so a hung runtime cannot block
+    process exit either."""
+    import threading
+
+    done = threading.Event()
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.arange(8).sum())
+            result["ok"] = True
+        except BaseException as e:  # noqa: BLE001 — diagnosis, not control flow
+            result["error"] = f"device probe failed: {e!r}"
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True, name="device-watchdog")
+    t.start()
+    # waits on the event, not the thread: a probe that RAISES quickly (import
+    # error, PJRT client init failure) reports immediately with the real
+    # exception instead of burning the full budget and claiming a hang
+    done.wait(timeout_s)
+    if result.get("ok"):
+        return None
+    return result.get(
+        "error", f"device unresponsive: trivial op did not complete in {timeout_s:.0f}s"
+    )
+
+
 def main():
     from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
+
+    device_error = _device_watchdog()
+    if device_error is not None:
+        _emit(
+            metric="proposal_wall_clock",
+            value=-1.0,
+            unit="s",
+            vs_baseline=-1.0,
+            error=device_error,
+        )
+        os._exit(1)  # daemon probe thread may be wedged in the runtime
 
     # persistent XLA cache: repeat bench runs skip the ~70s warm-up compile,
     # making warmup_s the honest time-to-first-proposal of a restarted
