@@ -31,12 +31,33 @@ slow at 500k-replica scale.  With statics-as-arguments one Engine per
 ClusterShape serves every model generation; `rebind()` swaps in fresh
 data with zero recompilation (the TPU analog of the reference's proposal
 precompute amortization, GoalOptimizer.java:124-175).
+
+Execution model (fused rounds, the default): the ENTIRE multi-round
+anneal is ONE device-resident XLA program — a `lax.scan` over rounds
+whose body is the per-round step scan plus the between-rounds program
+(aggregate refresh, sampling-plan rebuild, cheap early-stop signal), with
+the temperature schedule, the authoritative full-goal-chain early stop,
+and the extra-polish-rounds loop expressed in-graph as cond-masked
+rounds.  The host dispatches twice (init, fused run), then performs ONE
+blocking device sync to fetch scalar per-round stats; the final carry
+stays on device for the result report / proposal diff to consume, so
+host-side extraction overlaps the tail of device work.  The EngineCarry
+input is donated (`donate_argnums`) so HBM holds a single placement copy
+at 500k-replica scale instead of one per dispatch.
+
+The legacy Python round loop (`fused_rounds=False`) dispatches one scan
+per round and syncs O(num_rounds) times.  It remains the right tool for
+fused-vs-legacy parity testing, per-round host-side debugging (inspect
+the carry between rounds), and experimenting with host-driven schedules;
+both paths share every traced sub-program, temperatures, and RNG chain,
+so at T=0 with a fixed seed they produce identical move trajectories.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from functools import partial
 
 import jax
@@ -95,6 +116,51 @@ class OptimizerConfig:
     #: tuned for steady-state rebalances runs out on much-worse starts
     #: (mass decommissions).  0 disables.
     max_extra_rounds: int = 8
+    #: run the whole multi-round anneal as ONE device-resident program
+    #: (scan-of-scans with in-graph aggregate refresh, sampling-plan
+    #: rebuild, temperature schedule, early stop, and extra polish rounds;
+    #: the EngineCarry input is donated so HBM holds one placement copy).
+    #: False selects the legacy Python round loop — one dispatch + one
+    #: blocking sync per round — kept for parity testing, per-round
+    #: debugging, and host-side schedule experiments.
+    fused_rounds: bool = True
+
+    def __post_init__(self):
+        # round-count knobs validated in ONE place: both the in-graph
+        # (fused) early stop and the legacy host-side early stop derive
+        # their round budgets from these values via `extra_round_budget`
+        # and `early_stop_tol`, so the two paths cannot disagree on how
+        # many rounds may run
+        if self.num_rounds < 1:
+            raise ValueError(f"num_rounds must be >= 1, got {self.num_rounds}")
+        if self.steps_per_round < 1:
+            raise ValueError(
+                f"steps_per_round must be >= 1, got {self.steps_per_round}"
+            )
+        if self.max_extra_rounds < 0:
+            raise ValueError(
+                f"max_extra_rounds must be >= 0, got {self.max_extra_rounds}"
+            )
+        if self.num_candidates < 1:
+            raise ValueError(
+                f"num_candidates must be >= 1, got {self.num_candidates}"
+            )
+
+    @property
+    def extra_round_budget(self) -> int:
+        """Extra T=0 polish rounds actually runnable.  The extra-rounds
+        loop is gated on the early-stop violation signal, so disabling
+        early stop (early_stop_violations < 0) disables extra rounds with
+        it — in BOTH round-loop implementations."""
+        return self.max_extra_rounds if self.early_stop_violations >= 0.0 else 0
+
+    @property
+    def early_stop_tol(self) -> float:
+        """The early-stop threshold as the f32 value both paths compare
+        against.  The fused in-graph compare is f32; the legacy host
+        compare must use the same quantized constant or the two could
+        disagree on round counts at the boundary."""
+        return float(np.float32(self.early_stop_violations))
 
 
 @partial(
@@ -347,6 +413,12 @@ class _Weights:
 
 log = logging.getLogger(__name__)
 
+#: budget of AUTHORITATIVE (full goal chain) early-stop checks per run when
+#: the cheap O(B) gate opens but delta-folded goals still have work — shared
+#: by the fused in-graph loop and the legacy host loop so the two can never
+#: disagree on how many checks (and therefore rounds) may run
+FULL_CHECK_BUDGET = 2
+
 
 class _WarmedFn:
     """A precompiled engine program with the plain jit as safety net.
@@ -420,6 +492,11 @@ class Engine:
         self._jit_round_prep = jax.jit(self._round_prep_impl)
         self._jit_init = jax.jit(self._init_impl)
         self._jit_eval = jax.jit(self._eval_impl)
+        # the fused whole-anneal program: the carry is DONATED — its
+        # buffers are reused for the output placement, so HBM holds one
+        # EngineCarry at 500k-replica scale, not one per dispatch
+        self._jit_run_fused = jax.jit(self._run_fused_impl, donate_argnums=(1,))
+        self._jit_run_fused_verbose = None  # built lazily (adds per-round eval)
         self._warm_futures: dict | None = None
 
     # ------------------------------------------------------------------
@@ -458,16 +535,25 @@ class Engine:
         carry_av = jax.eval_shape(self._init_impl, sx_av, key_av)
         plan_av = jax.eval_shape(self._plan_impl, sx_av, carry_av)
         temps_av = jax.ShapeDtypeStruct((self.config.steps_per_round,), jnp.float32)
-        targets = [
-            # scan first: it is by far the largest program and gates the
-            # first round's dispatch — worker 1 spends its whole warm-up on
-            # it while worker 2 clears the small programs in use order
-            ("_scan", (sx_av, carry_av, temps_av, plan_av)),
-            ("_jit_init", (sx_av, key_av)),
-            ("_jit_plan", (sx_av, carry_av)),
-            ("_jit_round_prep", (sx_av, carry_av)),
-            ("_jit_eval", (sx_av, carry_av)),
-        ]
+        if self.config.fused_rounds:
+            # the fused run() path touches exactly two programs: init and
+            # the whole-anneal scan-of-scans (everything else is inlined
+            # into it).  Fused first: it is by far the largest program.
+            targets = [
+                ("_jit_run_fused", (sx_av, carry_av)),
+                ("_jit_init", (sx_av, key_av)),
+            ]
+        else:
+            targets = [
+                # scan first: it is by far the largest program and gates the
+                # first round's dispatch — worker 1 spends its whole warm-up
+                # on it while worker 2 clears the small programs in use order
+                ("_scan", (sx_av, carry_av, temps_av, plan_av)),
+                ("_jit_init", (sx_av, key_av)),
+                ("_jit_plan", (sx_av, carry_av)),
+                ("_jit_round_prep", (sx_av, carry_av)),
+                ("_jit_eval", (sx_av, carry_av)),
+            ]
         # DAEMON worker threads, not ThreadPoolExecutor: concurrent.futures
         # joins its (non-daemon) workers at interpreter exit, so a compile
         # stuck on an unresponsive device would block process shutdown
@@ -1735,23 +1821,226 @@ class Engine:
         return self._scan_impl
 
     # ------------------------------------------------------------------
+    # fused whole-anneal program (scan over rounds, rounds scan over steps)
+    # ------------------------------------------------------------------
+
+    def _run_fused_impl(self, sx: EngineStatics, carry: EngineCarry):
+        return self._fused_rounds_body(sx, carry, verbose=False)
+
+    def _run_fused_verbose_impl(self, sx: EngineStatics, carry: EngineCarry):
+        return self._fused_rounds_body(sx, carry, verbose=True)
+
+    def _fused_rounds_body(
+        self, sx: EngineStatics, carry: EngineCarry, *, verbose: bool
+    ):
+        """The entire multi-round anneal as ONE program.
+
+        `lax.scan` over `num_rounds + extra_round_budget` rounds; each
+        round body is the existing per-round step scan plus the
+        between-rounds program (`_round_prep_impl`: aggregate refresh,
+        sampling-plan rebuild, cheap early-stop signal).  The temperature
+        schedule, the authoritative full-goal-chain early stop, and the
+        extra-polish-rounds loop run in-graph as cond-masked rounds: once
+        the `done` flag sets, the remaining round bodies are cheap no-ops.
+
+        Semantics match the legacy host loop exactly — same round budgets,
+        same bounded full-chain check count, same RNG chain — with one
+        re-phrasing: the early-stop checks run at the TOP of each round
+        against the previous round's post-refresh carry, which is the same
+        decision the legacy loop takes at the BOTTOM of the previous round
+        (the host rebuilds the legacy history shape from the per-round
+        flags this returns).
+
+        Returns (final carry, per-round scalars): `accepted`, `ran`,
+        `stopped` (early stop fired before this round), `temperature`,
+        `cheap`, and — in the verbose variant — the full-chain `objective`.
+        Only these O(rounds) scalars are ever fetched eagerly; the carry
+        stays on device for the result report to consume.
+        """
+        cfg = self.config
+        n_main = cfg.num_rounds
+        total = n_main + cfg.extra_round_budget
+        tol_on = cfg.early_stop_violations >= 0.0
+        tol = jnp.float32(cfg.early_stop_tol)
+
+        obj0, _ = self._eval_impl(sx, carry)
+        t0 = obj0 * cfg.init_temperature_scale
+        plan0 = self._plan_impl(sx, carry)
+
+        def round_body(st, rnd):
+            carry, plan, cheap_prev, done, checks_left, prev_v, has_prev = st
+            active = ~done
+            is_extra = rnd >= n_main
+            main_stop = jnp.bool_(False)
+            run = active
+            if tol_on:
+                # main-round gate: the previous round's cheap O(B) signal
+                # opens the bounded authoritative check (legacy
+                # full_checks_left semantics); extra-round gate: the
+                # full-chain violation decides continue/stop every round
+                main_gate = (
+                    active & ~is_extra & (rnd > 0)
+                    & (checks_left > 0) & (cheap_prev <= tol)
+                )
+                extra_gate = active & is_extra
+                need_full = main_gate | extra_gate
+                full_v = jax.lax.cond(
+                    need_full,
+                    lambda: self._eval_impl(sx, carry)[1],
+                    lambda: jnp.float32(jnp.inf),
+                )
+                main_stop = main_gate & (full_v <= tol)
+                checks_left = jnp.where(
+                    main_gate & ~main_stop, checks_left - 1, checks_left
+                )
+                extra_stop = extra_gate & (
+                    (full_v <= tol) | (has_prev & (full_v > prev_v * 0.9))
+                )
+                stop = main_stop | extra_stop
+                done = done | stop
+                run = active & ~stop
+                prev_v = jnp.where(run & is_extra, full_v, prev_v)
+                has_prev = has_prev | (run & is_extra)
+
+            t_r = jnp.where(
+                is_extra | (rnd == n_main - 1),
+                jnp.float32(0.0),
+                t0 * cfg.temperature_decay ** rnd.astype(jnp.float32),
+            ).astype(jnp.float32)
+
+            def do_round(carry, plan):
+                temps = jnp.full((cfg.steps_per_round,), t_r, jnp.float32)
+                carry, stats = self._scan_impl(sx, carry, temps, plan)
+                carry, plan, cheap = self._round_prep_impl(sx, carry)
+                return carry, plan, cheap, stats["accepted"].sum()
+
+            carry, plan, cheap_prev, acc = jax.lax.cond(
+                run,
+                do_round,
+                lambda c, p: (c, p, jnp.float32(jnp.inf), jnp.int32(0)),
+                carry,
+                plan,
+            )
+            # `stopped` marks only the MAIN early stop: the legacy history
+            # flags early_stop on the round whose post-refresh state
+            # satisfied the full chain, never on an extra-round exit
+            ys = dict(
+                accepted=acc, ran=run, stopped=main_stop, temperature=t_r,
+                cheap=cheap_prev,
+            )
+            if verbose:
+                ys["objective"] = jax.lax.cond(
+                    run,
+                    lambda: self._eval_impl(sx, carry)[0],
+                    lambda: jnp.float32(jnp.nan),
+                )
+            return (carry, plan, cheap_prev, done, checks_left, prev_v, has_prev), ys
+
+        init = (
+            carry, plan0, jnp.float32(jnp.inf), jnp.bool_(False),
+            jnp.int32(FULL_CHECK_BUDGET), jnp.float32(jnp.inf), jnp.bool_(False),
+        )
+        (carry, *_), ys = jax.lax.scan(round_body, init, jnp.arange(total))
+        return carry, ys
+
+    # ------------------------------------------------------------------
     # driver
     # ------------------------------------------------------------------
 
     def run(self, *, verbose: bool = False):
-        """Execute the annealing schedule; returns (final_state, history)."""
+        """Execute the annealing schedule; returns (final_state, history).
+
+        history is a list of per-round dicts (round, temperature, accepted,
+        optional early_stop/extra/objective) plus ONE timing record
+        (`timing=True`) carrying the device/host split and the number of
+        blocking host<->device syncs the optimization performed — the
+        fused path's contract is O(1) syncs regardless of round count.
+        """
+        if self.config.fused_rounds:
+            return self._run_fused(verbose=verbose)
+        return self._run_legacy(verbose=verbose)
+
+    def _run_fused(self, *, verbose: bool = False):
         cfg = self.config
         sx = self.statics
+        t_start = time.monotonic()
+        carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
+        if verbose:
+            if self._jit_run_fused_verbose is None:
+                self._jit_run_fused_verbose = jax.jit(
+                    self._run_fused_verbose_impl, donate_argnums=(1,)
+                )
+            fused = self._jit_run_fused_verbose
+        else:
+            fused = self._fn("_jit_run_fused")
+        carry, ys = fused(sx, carry)
+        t_disp = time.monotonic()
+        # the run's ONE blocking sync: O(rounds) scalars (completes only
+        # when the whole fused program has); the final carry stays on
+        # device for the report/proposal-diff programs to consume.
+        # Timing-split caveat: with ASYNC dispatch (TPU) host_dispatch_s is
+        # host-side trace/dispatch work and device_s is device search time;
+        # on a synchronous backend (CPU) the fused call above executes the
+        # program inline, so device compute lands in host_dispatch_s and
+        # device_s measures only this drain — compare wall clocks, not the
+        # split, on CPU.
+        ys = jax.device_get(ys)
+        t_sync = time.monotonic()
+
+        history = []
+        for r in range(len(ys["ran"])):
+            if ys["stopped"][r] and history:
+                history[-1]["early_stop"] = True
+            if not ys["ran"][r]:
+                continue
+            rec = dict(
+                round=len(history),
+                temperature=float(ys["temperature"][r]),
+                accepted=int(ys["accepted"][r]),
+            )
+            if r >= cfg.num_rounds:
+                rec["extra"] = True
+            if verbose:
+                rec["objective"] = float(ys["objective"][r])
+            history.append(rec)
+        history.append(dict(
+            timing=True, fused=True, blocking_syncs=1,
+            host_dispatch_s=round(t_disp - t_start, 6),
+            device_s=round(t_sync - t_disp, 6),
+        ))
+        return self.carry_to_state(carry), history
+
+    def _run_legacy(self, *, verbose: bool = False):
+        """Legacy Python round loop: one scan dispatch + one blocking sync
+        per round.  Kept behind `fused_rounds=False` for parity testing and
+        per-round host-side debugging."""
+        cfg = self.config
+        sx = self.statics
+        t_start = time.monotonic()
+        sync = dict(n=0, s=0.0)
+
+        def fetch(x):
+            """device_get with the blocking wait metered (timing record)."""
+            t0 = time.monotonic()
+            v = jax.device_get(x)
+            sync["n"] += 1
+            sync["s"] += time.monotonic() - t0
+            return v
+
         carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
 
-        t0_obj = float(self._fn("_jit_eval")(sx, carry)[0]) * cfg.init_temperature_scale
+        t0_obj = float(fetch(self._fn("_jit_eval")(sx, carry)[0]))
+        t0_obj *= cfg.init_temperature_scale
         plan = self._fn("_jit_plan")(sx, carry)
         history = []
         # the authoritative (full-chain) early-stop check is bounded: when
         # the cheap gate opens but goals folded into candidate deltas (topic
         # dist) still have work, re-checking every round would cost more
         # than it saves
-        full_checks_left = 2
+        full_checks_left = FULL_CHECK_BUDGET
+        # f32-quantized threshold: must take the SAME branch the fused
+        # in-graph compare would (OptimizerConfig.early_stop_tol)
+        tol = cfg.early_stop_tol
 
         def _temp(rnd: int) -> float:
             if rnd == cfg.num_rounds - 1:
@@ -1781,11 +2070,13 @@ class Engine:
             # ONE device round-trip per round: cheap (control flow) and the
             # per-step accept counts ride the same fetch — each extra
             # device_get is a full network round trip
-            cheap, step_accepts = jax.device_get((cheap, stats["accepted"]))
+            cheap, step_accepts = fetch((cheap, stats["accepted"]))
             accepted = int(step_accepts.sum())
             history.append(dict(round=rnd, temperature=_temp(rnd), accepted=accepted))
             if verbose:
-                history[-1]["objective"] = float(self._fn("_jit_eval")(sx, carry)[0])
+                history[-1]["objective"] = float(
+                    fetch(self._fn("_jit_eval")(sx, carry)[0])
+                )
             # early stop: all goals already satisfied.  The O(B) lower bound
             # gates the authoritative full-chain check so healthy rounds pay
             # ~nothing.
@@ -1793,9 +2084,9 @@ class Engine:
                 cfg.early_stop_violations >= 0.0
                 and rnd < cfg.num_rounds - 1
                 and full_checks_left > 0
-                and float(cheap) <= cfg.early_stop_violations
+                and float(cheap) <= tol
             ):
-                if float(self._fn("_jit_eval")(sx, carry)[1]) <= cfg.early_stop_violations:
+                if float(fetch(self._fn("_jit_eval")(sx, carry)[1])) <= tol:
                     history[-1]["early_stop"] = True
                     break
                 full_checks_left -= 1
@@ -1803,19 +2094,30 @@ class Engine:
             # schedule exhausted with goals possibly unsatisfied (bad starts:
             # mass decommission) — polish with extra greedy rounds while the
             # full chain reports violations and they keep shrinking
-            if cfg.early_stop_violations >= 0.0:
-                tol = cfg.early_stop_violations
-                prev_v = None
-                for _ in range(cfg.max_extra_rounds):
-                    v = float(self._fn("_jit_eval")(sx, carry)[1])
-                    if v <= tol or (prev_v is not None and v > prev_v * 0.9):
-                        break
-                    prev_v = v
-                    temps = jnp.zeros((cfg.steps_per_round,), jnp.float32)
-                    carry, stats = self._fn("_scan")(sx, carry, temps, plan)
-                    carry, plan, _cheap = self._fn("_jit_round_prep")(sx, carry)
-                    history.append(dict(
-                        round=len(history), temperature=0.0, extra=True,
-                        accepted=int(jax.device_get(stats["accepted"]).sum()),
-                    ))
+            prev_v = None
+            for _ in range(cfg.extra_round_budget):
+                v = float(fetch(self._fn("_jit_eval")(sx, carry)[1]))
+                if v <= tol or (
+                    prev_v is not None
+                    and v > float(np.float32(prev_v) * np.float32(0.9))
+                ):
+                    break
+                prev_v = v
+                temps = jnp.zeros((cfg.steps_per_round,), jnp.float32)
+                carry, stats = self._fn("_scan")(sx, carry, temps, plan)
+                carry, plan, _cheap = self._fn("_jit_round_prep")(sx, carry)
+                history.append(dict(
+                    round=len(history), temperature=0.0, extra=True,
+                    accepted=int(fetch(stats["accepted"]).sum()),
+                ))
+                if verbose:
+                    # same record schema as the fused path's verbose extras
+                    history[-1]["objective"] = float(
+                        fetch(self._fn("_jit_eval")(sx, carry)[0])
+                    )
+        history.append(dict(
+            timing=True, fused=False, blocking_syncs=sync["n"],
+            device_s=round(sync["s"], 6),
+            host_s=round(time.monotonic() - t_start - sync["s"], 6),
+        ))
         return self.carry_to_state(carry), history

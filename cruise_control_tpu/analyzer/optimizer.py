@@ -267,7 +267,17 @@ class GoalOptimizer:
         # the host-side proposal diff while the device drains them
         (obj_a, viol_a), stats_a = self._report(final)
         final_checks = validate_on_device(final)
+        t_extract = time.monotonic()
         proposals = extract_proposals(state, final, before_host=before_host)
+        extract_s = time.monotonic() - t_extract
+        # complete the device/host timing split the engine started: the
+        # proposal diff is the optimizer's host-side share of the wall
+        # clock, overlapping the device draining the report programs above
+        timing = next((h for h in history if h.get("timing")), None)
+        if timing is None:
+            timing = dict(timing=True)
+            history.append(timing)
+        timing["host_extract_s"] = round(extract_s, 6)
         final_checks = np.asarray(final_checks)
         if final_checks.any():
             bad = [n for n, c in zip(DEVICE_CHECKS, final_checks) if c]

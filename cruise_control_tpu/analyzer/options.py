@@ -30,6 +30,21 @@ class OptimizationOptions:
     #: requestedDestinationBrokerIds; used by add_broker/rebalance-to)
     requested_destination_brokers: np.ndarray | None = None  # bool[B]
 
+    def __post_init__(self):
+        # normalize every mask to a 1-D bool ndarray at construction — a
+        # wrong-rank or non-boolean mask otherwise broadcasts or fails deep
+        # inside the jitted engine with an inscrutable shape error
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            arr = np.asarray(v, bool)
+            if arr.ndim != 1:
+                raise ValueError(
+                    f"{f.name} must be a 1-D boolean mask, got shape {arr.shape}"
+                )
+            object.__setattr__(self, f.name, arr)
+
     def dest_allowed(self, state: ClusterState) -> np.ndarray:
         B = state.shape.B
         allowed = np.ones(B, bool)

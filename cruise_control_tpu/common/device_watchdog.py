@@ -1,0 +1,47 @@
+"""Accelerator liveness probe with a hard timeout.
+
+The tunneled TPU can wedge (observed: every device op hangs indefinitely,
+MULTICHIP_r05: bare rc=124 driver kill).  Any entry point that is about to
+touch the backend — bench ladder, dryrun_multichip, ad-hoc scripts — runs
+this gate first so a wedged runtime produces a diagnosable error record
+within a bounded budget instead of an opaque process timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def device_watchdog(timeout_s: float = 180.0) -> str | None:
+    """None when the accelerator answers a trivial op within the budget,
+    else a diagnosis string (hang vs immediate failure).
+
+    Runs the probe on a DAEMON thread so a hung runtime cannot block
+    process exit either.  Waits on an event, not the thread: a probe that
+    RAISES quickly (import error, PJRT client init failure) reports
+    immediately with the real exception instead of burning the full budget
+    and claiming a hang.
+    """
+    done = threading.Event()
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.arange(8).sum())
+            result["ok"] = True
+        except BaseException as e:  # noqa: BLE001 — diagnosis, not control flow
+            result["error"] = f"device probe failed: {e!r}"
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True, name="device-watchdog")
+    t.start()
+    done.wait(timeout_s)
+    if result.get("ok"):
+        return None
+    return result.get(
+        "error", f"device unresponsive: trivial op did not complete in {timeout_s:.0f}s"
+    )

@@ -18,6 +18,8 @@ device pair.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -92,6 +94,14 @@ class GridEngine(ShardedEngine):
                        in_specs=(spec_sx, spec_c, P()),
                        out_specs=(spec_c, spec_c))
         )
+        # fused multi-round program (inherited _run_fn body; the MODEL_AXIS
+        # collectives keep each restart chain isolated under the 2D mesh)
+        self._jit_run = jax.jit(
+            _shard_map(self._run_fn, self.mesh,
+                       in_specs=(spec_sx, spec_c, P()),
+                       out_specs=(spec_c, spec_c)),
+            donate_argnums=(1,),
+        )
         self._jit_obj = jax.jit(
             _shard_map(self._obj_fn, self.mesh,
                        in_specs=(spec_sx, spec_c), out_specs=spec_c)
@@ -101,6 +111,9 @@ class GridEngine(ShardedEngine):
         return jax.tree.map(lambda x: x[0, 0], blk)
 
     def _restack_carry(self, tree):
+        return jax.tree.map(lambda x: x[None, None], tree)
+
+    def _restack_stats(self, tree):
         return jax.tree.map(lambda x: x[None, None], tree)
 
     # ---- traced entry points (blocks: sx [1,...], carry [1,1,...]) ----
@@ -115,9 +128,7 @@ class GridEngine(ShardedEngine):
         sx = _unstack(sx_blk)
         carry = self._unstack_carry(carry_blk)
         carry, stats = self._run_round(sx, carry, temps)
-        return self._restack_carry(carry), jax.tree.map(
-            lambda x: x[None, None], stats
-        )
+        return self._restack_carry(carry), self._restack_stats(stats)
 
     def _obj_fn(self, sx_blk, carry_blk):
         obj = self._sharded_objective(_unstack(sx_blk), self._unstack_carry(carry_blk))
@@ -133,11 +144,63 @@ class GridEngine(ShardedEngine):
     def run(self, *, verbose: bool = False):
         self.last_info = None  # never report a previous run's diagnostics
         cfg = self.engine.config
+        if not cfg.fused_rounds:
+            return self._run_legacy(verbose=verbose)
+        t_start = time.monotonic()
+        keys = jax.random.split(
+            jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n
+        ).reshape(self.n_restarts, self.n, 2)
+        carry = self._jit_init(self.statics, keys)
+        objs0 = np.asarray(self._jit_obj(self.statics, carry))  # sync 1
+        t0_obj = float(objs0[0, 0]) * cfg.init_temperature_scale
+        temps = self._temp_schedule(t0_obj)
+        t_disp = time.monotonic()
+        carry, ys = self._jit_run(self.statics, carry, jnp.asarray(temps))
+        ys = jax.device_get(ys)  # sync 2: per-round, per-chain scalars
+        t_sync = time.monotonic()
+        accepted = np.asarray(ys["accepted"])  # [restarts, model, rounds]
+        objectives = np.asarray(ys["objective"])
+        history = []
+        for rnd in range(cfg.num_rounds):
+            rec = dict(
+                round=rnd, temperature=float(temps[rnd, 0]),
+                # per-chain counts: the stat is replicated across the model
+                # axis (computed from the all-gathered candidate set), so
+                # take shard column 0 of each chain
+                accepted=int(accepted[:, 0, rnd].sum()),
+            )
+            if verbose:
+                rec["objectives"] = objectives[:, 0, rnd].tolist()
+            history.append(rec)
+        history.append(dict(
+            timing=True, fused=True, blocking_syncs=2,
+            host_dispatch_s=round(t_disp - t_start, 6),
+            device_s=round(t_sync - t_disp, 6),
+        ))
+        # winner: best chain by final objective (identical across the model
+        # axis of a chain — take column 0; already fetched with the stats)
+        objs = objectives[:, 0, -1]
+        winner = int(np.argmin(objs))
+        win_carry = jax.tree.map(lambda x: x[winner], carry)
+        state = self.final_state(win_carry)
+        #: per-run diagnostics beyond the uniform (state, history) contract
+        self.last_info = {
+            "objectives": objs, "winner": winner,
+            "n_chains": self.n_restarts, "n_shards": self.n,
+        }
+        return state, history
+
+    def _run_legacy(self, *, verbose: bool = False):
+        """Legacy per-round loop (one dispatch + stats sync per round)."""
+        cfg = self.engine.config
+        t_start = time.monotonic()
+        syncs = 0
         keys = jax.random.split(
             jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n
         ).reshape(self.n_restarts, self.n, 2)
         carry = self._jit_init(self.statics, keys)
         objs0 = np.asarray(self._jit_obj(self.statics, carry))
+        syncs += 1
         t0_obj = float(objs0[0, 0]) * cfg.init_temperature_scale
         history = []
         for rnd in range(cfg.num_rounds):
@@ -149,23 +212,24 @@ class GridEngine(ShardedEngine):
             carry, stats = self._jit_round(self.statics, carry, temps)
             rec = dict(
                 round=rnd, temperature=t_round,
-                # per-chain counts: the stat is replicated across the model
-                # axis (computed from the all-gathered candidate set), so
-                # take shard column 0 of each chain
                 accepted=int(np.asarray(stats["accepted"])[:, 0].sum()),
             )
+            syncs += 1
             if verbose:
                 rec["objectives"] = np.asarray(
                     self._jit_obj(self.statics, carry)
                 )[:, 0].tolist()
+                syncs += 1
             history.append(rec)
-        # winner: best chain by final objective (identical across the model
-        # axis of a chain — take column 0)
         objs = np.asarray(self._jit_obj(self.statics, carry))[:, 0]
+        syncs += 1
         winner = int(np.argmin(objs))
         win_carry = jax.tree.map(lambda x: x[winner], carry)
         state = self.final_state(win_carry)
-        #: per-run diagnostics beyond the uniform (state, history) contract
+        history.append(dict(
+            timing=True, fused=False, blocking_syncs=syncs,
+            wall_s=round(time.monotonic() - t_start, 6),
+        ))
         self.last_info = {
             "objectives": objs, "winner": winner,
             "n_chains": self.n_restarts, "n_shards": self.n,

@@ -46,8 +46,15 @@ def portfolio_run(
 ) -> tuple[ClusterState, dict]:
     """Run one annealing chain per mesh device; return the best final state.
 
-    temps: f32[S] per-step temperature schedule (shared by all chains).
+    temps: f32[S] (one round) or f32[rounds, S] (multi-round).  Multi-round
+    chains stay ENTIRELY device-resident — each chain refreshes its
+    aggregates and rebuilds its sampling plan between rounds in-graph
+    (engine._round_prep_impl), matching the fused single-device execution
+    model: one dispatch, one winner fetch, zero per-round host syncs.
     """
+    temps = jnp.asarray(temps, jnp.float32)
+    if temps.ndim == 1:
+        temps = temps[None]
     n = mesh.devices.size
     keys = jax.random.split(jax.random.PRNGKey(seed), n)
     run_round = engine._make_scan()
@@ -57,7 +64,17 @@ def portfolio_run(
         # per-device chain: same initial carry, device-specific key
         key = key.reshape(-1)[0:2].reshape(2)  # shard_map passes [1, 2]
         carry = dataclasses.replace(carry, key=key)
-        carry, stats = run_round(sx, carry, temps, plan)
+
+        def round_body(cp, t_row):
+            c, p = cp
+            c, stats = run_round(sx, c, t_row, p)
+            # between-rounds program: wash float drift, rebuild the
+            # chain-specific sampling plan — chains diverge, so the plan
+            # must too (the shared init plan only seeds round 0)
+            c, p, _cheap = engine._round_prep_impl(sx, c)
+            return (c, p), stats["accepted"].sum()
+
+        (carry, _), _accepted = jax.lax.scan(round_body, (carry, plan), temps)
         obj = _sa_objective(engine, sx, carry)
         # race resolution: gather objectives, broadcast the winner's placement
         objs = jax.lax.all_gather(obj, RESTART_AXIS)  # [n]

@@ -39,6 +39,7 @@ is the TPU-native scale-out story for it.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -259,6 +260,17 @@ class ShardedEngine:
                 in_specs=(spec_in, spec_in, P()), out_specs=(spec_in, spec_in),
             )
         )
+        # fused multi-round program (engine.py execution model): ALL rounds
+        # chain on device — the per-round host dispatch+sync of the legacy
+        # loop disappears, and the carry is donated so each restart/model
+        # shard holds one placement copy in HBM
+        self._jit_run = jax.jit(
+            _shard_map(
+                self._run_fn, self.mesh,
+                in_specs=(spec_in, spec_in, P()), out_specs=(spec_in, spec_in),
+            ),
+            donate_argnums=(1,),
+        )
         self._jit_obj = jax.jit(
             _shard_map(
                 self._obj_fn, self.mesh,
@@ -381,6 +393,16 @@ class ShardedEngine:
 
     # ---- shard_map entry points (blocks have a leading axis of 1) ----
 
+    def _unstack_carry(self, blk):
+        """Carry block -> local pytree (GridEngine strips two axes)."""
+        return _unstack(blk)
+
+    def _restack_carry(self, tree):
+        return _restack(tree)
+
+    def _restack_stats(self, tree):
+        return jax.tree.map(lambda x: x[None], tree)
+
     def _zero_carry(self, sx, key) -> EngineCarry:
         eng = self.engine
         st = sx.state
@@ -429,25 +451,98 @@ class ShardedEngine:
 
     def _round_fn(self, sx_blk, carry_blk, temps):
         sx = _unstack(sx_blk)
-        carry, stats = self._run_round(sx, _unstack(carry_blk), temps)
-        return _restack(carry), jax.tree.map(lambda x: x[None], stats)
+        carry, stats = self._run_round(sx, self._unstack_carry(carry_blk), temps)
+        return self._restack_carry(carry), self._restack_stats(stats)
+
+    def _run_fn(self, sx_blk, carry_blk, temps2d):
+        """Fused multi-round body: scan over rounds, each round = plan +
+        step scan + psum'd refresh, all device-resident.  temps2d is the
+        f32[rounds, steps] schedule; per-round scalar stats (accept count,
+        SA objective) come back stacked so the host syncs ONCE."""
+        sx = _unstack(sx_blk)
+        carry = self._unstack_carry(carry_blk)
+
+        def body(c, t_row):
+            c, stats = self._run_round(sx, c, t_row)
+            # per-round SA objective (carry sufficient-statistics, O(B +
+            # R_local) + a 2-scalar psum — marginal next to the round's
+            # step scan): GridEngine's winner selection reads the last
+            # round's value and verbose histories read them all, with no
+            # extra dispatch or sync for either
+            return c, dict(
+                accepted=stats["accepted"].sum(),
+                objective=self._sharded_objective(sx, c),
+            )
+
+        carry, ys = jax.lax.scan(body, carry, temps2d)
+        return self._restack_carry(carry), self._restack_stats(ys)
 
     def _obj_fn(self, sx_blk, carry_blk):
-        obj = self._sharded_objective(_unstack(sx_blk), _unstack(carry_blk))
+        obj = self._sharded_objective(_unstack(sx_blk), self._unstack_carry(carry_blk))
         return obj[None]
 
     # ---- host-side driver ----
 
+    def _temp_schedule(self, t0_obj: float) -> np.ndarray:
+        """f32[rounds, steps] host-built temperature schedule (same values
+        the legacy per-round loop dispatches; last round T=0)."""
+        cfg = self.engine.config
+        temps = np.zeros((cfg.num_rounds, cfg.steps_per_round), np.float32)
+        for rnd in range(cfg.num_rounds - 1):
+            temps[rnd] = t0_obj * (cfg.temperature_decay**rnd)
+        return temps
+
     def run(self, *, verbose: bool = False):
         """Execute the annealing schedule over the sharded model.
 
-        Mirrors Engine.run: python rounds, each one jitted scan over the
-        mesh; refresh between rounds washes out incremental float drift.
+        Default (fused_rounds): ONE device-resident program runs every
+        round (plan + scan + psum'd refresh chained in-graph); the host
+        syncs twice — the initial objective for the temperature scale, and
+        the per-round scalar stats.  `fused_rounds=False` falls back to
+        the legacy one-dispatch-per-round loop.
         """
         cfg = self.engine.config
+        if not cfg.fused_rounds:
+            return self._run_legacy(verbose=verbose)
+        t_start = time.monotonic()
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), self.n)
+        carry = self._jit_init(self.statics, keys)
+        t0_obj = float(np.asarray(self._jit_obj(self.statics, carry))[0])  # sync 1
+        t0_obj *= cfg.init_temperature_scale
+        temps = self._temp_schedule(t0_obj)
+        t_disp = time.monotonic()
+        carry, ys = self._jit_run(self.statics, carry, jnp.asarray(temps))
+        ys = jax.device_get(ys)  # sync 2: O(rounds) scalars, carry stays put
+        t_sync = time.monotonic()
+        accepted = np.asarray(ys["accepted"])[0]
+        objectives = np.asarray(ys["objective"])[0]
+        history = []
+        for rnd in range(cfg.num_rounds):
+            rec = dict(
+                round=rnd,
+                temperature=float(temps[rnd, 0]),
+                accepted=int(accepted[rnd]),
+            )
+            if verbose:
+                rec["objective"] = float(objectives[rnd])
+            history.append(rec)
+        history.append(dict(
+            timing=True, fused=True, blocking_syncs=2,
+            host_dispatch_s=round(t_disp - t_start, 6),
+            device_s=round(t_sync - t_disp, 6),
+        ))
+        return self.final_state(carry), history
+
+    def _run_legacy(self, *, verbose: bool = False):
+        """Legacy per-round loop: one jitted round + one blocking stats
+        sync per round (kept for parity testing and per-round debugging)."""
+        cfg = self.engine.config
+        t_start = time.monotonic()
+        syncs = 0
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed), self.n)
         carry = self._jit_init(self.statics, keys)
         t0_obj = float(np.asarray(self._jit_obj(self.statics, carry))[0])
+        syncs += 1
         t0_obj *= cfg.init_temperature_scale
         history = []
         for rnd in range(cfg.num_rounds):
@@ -462,9 +557,15 @@ class ShardedEngine:
                 temperature=t_round,
                 accepted=int(np.asarray(stats["accepted"])[0].sum()),
             )
+            syncs += 1
             if verbose:
                 rec["objective"] = float(np.asarray(self._jit_obj(self.statics, carry))[0])
+                syncs += 1
             history.append(rec)
+        history.append(dict(
+            timing=True, fused=False, blocking_syncs=syncs,
+            wall_s=round(time.monotonic() - t_start, 6),
+        ))
         return self.final_state(carry), history
 
     def objective(self, carry) -> float:

@@ -236,6 +236,11 @@ def test_intra_broker_disk_rebalance():
         assert sorted(p.old_replicas) == sorted(p.new_replicas)
 
 
+def _rounds(history):
+    """Round records only (history also carries ONE timing record)."""
+    return [h for h in history if not h.get("timing")]
+
+
 def test_early_stop_breaks_when_goals_satisfied():
     """A run starting from an already-satisfied cluster MUST early-stop
     (OptimizerConfig.early_stop_violations), and the exit must only ever
@@ -250,7 +255,7 @@ def test_early_stop_breaks_when_goals_satisfied():
     _, viol, _ = DEFAULT_CHAIN.evaluate(final)
     if any(h.get("early_stop") for h in history):
         assert float(np.max(np.asarray(viol))) <= 1e-6
-        assert len(history) < 12
+        assert len(_rounds(history)) < 12
     if float(np.max(np.asarray(viol))) <= 1e-9:
         # second run from the satisfied state: the stop is GUARANTEED on
         # an early round (this pins the feature against regressions that
@@ -258,7 +263,7 @@ def test_early_stop_breaks_when_goals_satisfied():
         eng2 = Engine(final, DEFAULT_CHAIN, config=cfg)
         _, history2 = eng2.run()
         assert any(h.get("early_stop") for h in history2)
-        assert len(history2) < 12
+        assert len(_rounds(history2)) < 12
 
 
 def test_goal_order_permutations():
@@ -323,7 +328,8 @@ def test_random_self_healing(seed):
     assert np.asarray(after.broker_alive)[np.asarray(after.replica_broker)[moved]].all()
 
 
-def test_engine_precompile_async_swaps_in_compiled_programs():
+@pytest.mark.parametrize("fused", [True, False])
+def test_engine_precompile_async_swaps_in_compiled_programs(fused):
     """The warm-start pool (daemon threads — a stuck compile must never
     block process exit) compiles every run()-path program from abstract
     shapes, and _fn swaps the executables in; results must match the
@@ -339,12 +345,17 @@ def test_engine_precompile_async_swaps_in_compiled_programs():
         seed=0,
     )
     cfg = OptimizerConfig(num_candidates=128, leadership_candidates=32,
-                          steps_per_round=4, num_rounds=2)
+                          steps_per_round=4, num_rounds=2, fused_rounds=fused)
     warm = Engine(state, DEFAULT_CHAIN, config=cfg)
     warm.precompile_async()
     final_w, _ = warm.run()
     assert validate(final_w) == []
-    for name in ("_scan", "_jit_init", "_jit_plan", "_jit_round_prep", "_jit_eval"):
+    names = (
+        ("_jit_run_fused", "_jit_init")
+        if fused
+        else ("_scan", "_jit_init", "_jit_plan", "_jit_round_prep", "_jit_eval")
+    )
+    for name in names:
         assert isinstance(getattr(warm, name), _WarmedFn), name
 
     cold = Engine(state, DEFAULT_CHAIN, config=cfg)
@@ -355,3 +366,88 @@ def test_engine_precompile_async_swaps_in_compiled_programs():
     np.testing.assert_array_equal(
         np.asarray(final_w.replica_is_leader), np.asarray(final_c.replica_is_leader)
     )
+
+
+def test_fused_matches_legacy_round_loop():
+    """Tentpole parity pin: fixed seed, T=0 (init_temperature_scale=0) —
+    the fused on-device round loop and the legacy Python round loop must
+    produce the IDENTICAL accepted-move trajectory (same final placement,
+    leadership, and logdirs), the same per-round accept counts and round
+    budget (early stop / extra rounds included), and the same final
+    objective."""
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=10, num_partitions=150, skew=1.2), seed=13
+    )
+    base = dataclasses.replace(
+        FAST, num_rounds=4, seed=9, init_temperature_scale=0.0
+    )
+    eng_f = Engine(
+        state, DEFAULT_CHAIN, config=dataclasses.replace(base, fused_rounds=True)
+    )
+    final_f, hist_f = eng_f.run()
+    eng_l = Engine(
+        state, DEFAULT_CHAIN, config=dataclasses.replace(base, fused_rounds=False)
+    )
+    final_l, hist_l = eng_l.run()
+
+    np.testing.assert_array_equal(
+        np.asarray(final_f.replica_broker), np.asarray(final_l.replica_broker)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final_f.replica_is_leader), np.asarray(final_l.replica_is_leader)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final_f.replica_disk), np.asarray(final_l.replica_disk)
+    )
+    obj_f, _, _ = DEFAULT_CHAIN.evaluate(final_f)
+    obj_l, _, _ = DEFAULT_CHAIN.evaluate(final_l)
+    assert float(obj_f) == float(obj_l)
+
+    def key(h):
+        return (h["round"], h["accepted"], h.get("early_stop"), h.get("extra"))
+
+    assert [key(h) for h in _rounds(hist_f)] == [key(h) for h in _rounds(hist_l)]
+
+
+def test_history_timing_split_and_sync_contract():
+    """OptimizerResult.history must carry ONE timing record with the
+    device/host split; the fused path's contract is O(1) blocking syncs
+    during optimization (vs O(num_rounds) legacy) — the assertable form
+    of 'the round loop is device-resident'."""
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=8, num_partitions=100, skew=1.0), seed=17
+    )
+    res_f = GoalOptimizer(config=FAST).optimize(state)
+    timing = [h for h in res_f.history if h.get("timing")]
+    assert len(timing) == 1
+    t = timing[0]
+    assert t["fused"] is True
+    assert t["blocking_syncs"] == 1
+    assert t["device_s"] >= 0.0 and t["host_extract_s"] >= 0.0
+
+    cfg_l = dataclasses.replace(FAST, fused_rounds=False)
+    res_l = GoalOptimizer(config=cfg_l).optimize(state)
+    t_l = next(h for h in res_l.history if h.get("timing"))
+    assert t_l["fused"] is False
+    # per-round sync floor: at least one blocking fetch per executed round
+    assert t_l["blocking_syncs"] >= len(_rounds(res_l.history))
+
+
+def test_optimizer_config_validation():
+    """Round-budget knobs are validated in one place; the interaction of
+    early_stop_violations with max_extra_rounds resolves identically for
+    both round-loop implementations via extra_round_budget."""
+    with pytest.raises(ValueError):
+        OptimizerConfig(num_rounds=0)
+    with pytest.raises(ValueError):
+        OptimizerConfig(steps_per_round=0)
+    with pytest.raises(ValueError):
+        OptimizerConfig(max_extra_rounds=-1)
+    # early stop disabled => extra polish rounds disabled with it
+    assert OptimizerConfig(early_stop_violations=-1.0).extra_round_budget == 0
+    assert (
+        OptimizerConfig(early_stop_violations=1e-6, max_extra_rounds=5)
+        .extra_round_budget == 5
+    )
+    # both paths compare against the SAME f32-quantized threshold
+    assert OptimizerConfig().early_stop_tol == float(np.float32(1e-6))

@@ -44,3 +44,27 @@ def test_portfolio_winner_matches_best_chain():
     assert abs(float(obj_final) - float(info["objectives"].min())) < max(
         1e-3, 1e-3 * abs(float(obj_final))
     )
+
+
+def test_portfolio_multi_round_device_resident():
+    """A [rounds, steps] schedule runs every chain's rounds ON-DEVICE
+    (plan rebuild + aggregate refresh between rounds in-graph, one
+    dispatch) and must beat the single-round run of the same step budget's
+    first row — more rounds, never a worse winner than its own prefix."""
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=10, num_partitions=150, skew=1.5), seed=19
+    )
+    cfg = OptimizerConfig(num_candidates=64, leadership_candidates=16, steps_per_round=6)
+    eng = Engine(state, DEFAULT_CHAIN, config=cfg)
+    temps = jnp.zeros((3, 6), jnp.float32)  # 3 greedy rounds, fused
+    final, info = portfolio_run(eng, default_mesh(), temps, seed=4)
+    validate(final)
+    assert info["n_chains"] == len(jax.devices())
+    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
+    obj_multi, _, _ = DEFAULT_CHAIN.evaluate(final)
+    assert float(obj_multi) < float(obj0)
+
+    final_1, _ = portfolio_run(eng, default_mesh(), temps[0], seed=4)
+    obj_1, _, _ = DEFAULT_CHAIN.evaluate(final_1)
+    # 3 greedy rounds from the same seeds can only improve on round 1
+    assert float(obj_multi) <= float(obj_1) + max(1e-5, abs(float(obj_1)) * 1e-3)
