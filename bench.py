@@ -23,6 +23,10 @@ budgeted objective is what it achieves in comparable time.
 Env: BENCH_CONFIGS="1,2,3,4,5" to select (default all);
 BENCH_SCALE=north_star|mid|small retained for the headline fixture size.
 
+`bench.py --churn [--smoke]` runs the topology-churn scenario instead:
+N generations with partition creates (+ a broker add) served bucketed vs
+exact, gating on "churned generations compile zero engines" (see churn()).
+
 warmup_s on the headline is the FIRST optimize() call in a fresh process
 with a warm persistent XLA cache: engine statics build + program
 trace/lower + cache-hit compile + one full proposal computation.  It is
@@ -582,7 +586,125 @@ def smoke() -> int:
     return 0 if ok else 1
 
 
+def _churn_states(n_gens, *, brokers, partitions, parts_per_gen, broker_add_at, seed):
+    """One synthetic churn stream: generation g has `partitions + g*delta`
+    partitions (partition creates) and one broker added at broker_add_at —
+    the monitor's view of a live cluster between proposal calls."""
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+    states = []
+    for g in range(n_gens):
+        b = brokers + (1 if broker_add_at is not None and g >= broker_add_at else 0)
+        states.append(random_cluster_fast(
+            RandomClusterSpec(
+                num_brokers=b,
+                num_partitions=partitions + g * parts_per_gen,
+                num_racks=6,
+                num_topics=12,
+                skew=1.0,
+            ),
+            seed=seed,
+        ))
+    return states
+
+
+def churn(smoke_mode: bool) -> int:
+    """`bench.py --churn [--smoke]`: serve a stream of churned generations.
+
+    N model generations with partitions created every generation (and one
+    broker add mid-stream) are served twice: with shape bucketing (states
+    padded to ShapeBucketPolicy buckets, the service default) and exact.
+    Emits one JSON line with p50/p95 proposal wall-clock and the engine
+    compile count for each mode.  Gate (--smoke, wired into
+    scripts/check.sh): every bucketed generation whose shape matches the
+    previous one must hit the engine cache — churned generations compile
+    ZERO engines — while the exact mode recompiles per generation.
+    """
+    import jax
+
+    if smoke_mode:
+        jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+    from cruise_control_tpu.models.builder import pad_state
+    from cruise_control_tpu.models.state import DEFAULT_BUCKET_POLICY
+
+    if smoke_mode:
+        scale = dict(brokers=24, partitions=1200, parts_per_gen=9,
+                     broker_add_at=3, seed=11)
+        n_gens = 6
+        cfg = OptimizerConfig(
+            num_candidates=512, leadership_candidates=128, swap_candidates=64,
+            steps_per_round=16, num_rounds=3, seed=0,
+        )
+    else:
+        scale = dict(brokers=500, partitions=50_000, parts_per_gen=250,
+                     broker_add_at=4, seed=11)
+        n_gens = 8
+        cfg = OptimizerConfig(**SEARCH)
+
+    states = _churn_states(n_gens, **scale)
+    out: dict = {}
+    in_bucket_compiles = 0
+    in_bucket_gens = 0
+    for mode in ("bucketed", "exact"):
+        if mode == "bucketed":
+            served = [
+                pad_state(s, DEFAULT_BUCKET_POLICY.bucket_shape(s.shape))
+                for s in states
+            ]
+        else:
+            served = states
+        opt = GoalOptimizer(config=cfg)
+        walls, compiles = [], []
+        for g, s in enumerate(served):
+            misses0 = opt.engine_cache_misses
+            t0 = time.monotonic()
+            res = opt.optimize(s)
+            walls.append(time.monotonic() - t0)
+            compiled = opt.engine_cache_misses - misses0
+            compiles.append(compiled)
+            if mode == "bucketed" and g > 0:
+                if served[g].shape == served[g - 1].shape:
+                    in_bucket_gens += 1
+                    in_bucket_compiles += compiled
+            del res
+        ws = sorted(walls[1:] or walls)  # steady state: drop the cold gen 0
+
+        def pct(p):
+            return round(ws[min(len(ws) - 1, int(p * len(ws)))], 3)
+
+        out[mode] = dict(
+            p50_wall_s=pct(0.50), p95_wall_s=pct(0.95),
+            first_gen_s=round(walls[0], 3),
+            compiles=int(sum(compiles)), per_gen_compiles=compiles,
+            cache_hits=opt.engine_cache_hits,
+        )
+    # the scenario must actually exercise in-bucket churn, and those
+    # generations must be compile-free (the acceptance gate)
+    scenario_ok = in_bucket_gens >= 3
+    zero_ok = in_bucket_compiles == 0
+    exact_recompiles = out["exact"]["compiles"] >= max(2, n_gens - 2)
+    ok = scenario_ok and zero_ok and exact_recompiles
+    _emit(
+        metric="churn_bucketed_vs_exact",
+        value=out["bucketed"]["p50_wall_s"],
+        unit="s",
+        vs_baseline=round(
+            out["bucketed"]["p50_wall_s"] / max(out["exact"]["p50_wall_s"], 1e-9), 4
+        ),
+        generations=n_gens,
+        in_bucket_generations=in_bucket_gens,
+        churned_generation_compiles=in_bucket_compiles,
+        bucketed=out["bucketed"],
+        exact=out["exact"],
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 def main():
+    if "--churn" in sys.argv:
+        sys.exit(churn("--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         sys.exit(smoke())
 

@@ -32,6 +32,19 @@ ClusterShape serves every model generation; `rebind()` swaps in fresh
 data with zero recompilation (the TPU analog of the reference's proposal
 precompute amortization, GoalOptimizer.java:124-175).
 
+Shape bucketing extends that amortization across TOPOLOGY CHURN: a live
+cluster creates partitions and adds brokers continuously, so exact shapes
+would make nearly every generation a compile miss anyway.  Model builds
+round each ClusterShape axis up to a geometric bucket
+(`models.state.ShapeBucketPolicy`, config `tpu.shape.bucket.*`) and mask
+the padding (replica_valid / broker_valid); sampling draws are scaled by
+the RUNTIME valid counts (`EngineStatics.n_source/n_dest/n_brokers`, not
+the padded axis sizes), so an exact and a bucketed build of the same
+cluster produce byte-identical move trajectories — bucketing changes the
+compile key and nothing else.  `GoalOptimizer` keeps compiled engines in
+a bounded LRU (`tpu.engine.cache.size`) whose eviction calls `release()`
+to free the evicted generation's HBM.
+
 Execution model (fused rounds, the default): the ENTIRE multi-round
 anneal is ONE device-resident XLA program — a `lax.scan` over rounds
 whose body is the per-round step scan plus the between-rounds program
@@ -217,6 +230,9 @@ class EngineCarry:
         "n_alive",
         "n_valid",
         "total_disk_cap",
+        "n_source",
+        "n_dest",
+        "n_brokers",
     ],
     meta_fields=[],
 )
@@ -237,6 +253,21 @@ class EngineStatics:
     n_alive: jax.Array  # f32 scalar
     n_valid: jax.Array  # f32 scalar
     total_disk_cap: jax.Array  # f32 scalar
+    #: i32 scalar — leading replica slots uniform source draws cover (the
+    #: valid prefix when replicas are front-packed, else the full padded R).
+    #: Sampling ``floor(u * n_source)`` instead of ``randint(0, R)`` makes
+    #: candidate streams independent of the PADDED R: an exact and a
+    #: shape-bucketed build of the same cluster draw identical candidates,
+    #: so bucketing changes nothing but the compile key (and no draws are
+    #: wasted on padding rows).
+    n_source: jax.Array
+    #: i32 scalar — real entries at the head of dest_ids (same role as
+    #: n_source for destination draws: padded-B invariance)
+    n_dest: jax.Array
+    #: i32 scalar — valid (real, front-packed) broker count; clips the
+    #: importance sampler's CDF search so a u ~ 1.0 edge draw resolves to
+    #: the last REAL broker under any padding
+    n_brokers: jax.Array
 
 
 @partial(
@@ -332,6 +363,12 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
     host_cap = np.zeros((s.num_hosts, NUM_RESOURCES), np.float32)
     np.add.at(host_cap, host[valid_b & alive], cap[valid_b & alive])
     dmask = h["disk_alive"] & alive[:, None]
+    # shape-invariant sampling bounds: uniform draws cover only the valid
+    # replica prefix / real destination list, so the padded sizes never
+    # leak into the RNG stream (exact-vs-bucketed trajectory parity)
+    n_valid_int = int(h["replica_valid"].sum())
+    front_packed = bool(h["replica_valid"][:n_valid_int].all())
+    n_source = n_valid_int if front_packed else s.R
     return EngineStatics(
         state=state,
         part_replicas=jnp.asarray(partition_replica_table(state, host=h)),
@@ -350,6 +387,9 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
         total_disk_cap=jnp.asarray(
             float((h["disk_capacity"] * dmask).sum() + 1e-12), jnp.float32
         ),
+        n_source=jnp.asarray(max(1, n_source), jnp.int32),
+        n_dest=jnp.asarray(int(dest_idx.size), jnp.int32),
+        n_brokers=jnp.asarray(max(1, int(h["broker_valid"].sum())), jnp.int32),
     )
 
 
@@ -445,6 +485,18 @@ class _WarmedFn:
 
 def _relu(x):
     return jnp.maximum(x, 0.0)
+
+
+def _uniform_idx(key: jax.Array, shape, n: jax.Array) -> jax.Array:
+    """Uniform i32 indices in [0, n) with n a TRACED bound (n >= 1).
+
+    `randint(0, axis_size)` would bake the PADDED axis size into the draw,
+    making shape-bucketed and exact builds of the same cluster diverge;
+    scaling a unit uniform by the runtime count keeps the candidate stream
+    identical across padded shapes (and wastes no draws on padding rows).
+    """
+    u = jax.random.uniform(key, shape)
+    return jnp.minimum((u * n.astype(jnp.float32)).astype(jnp.int32), n - 1)
 
 
 class Engine:
@@ -612,6 +664,30 @@ class Engine:
             )
         self.statics = build_statics(state, options)
         return self
+
+    def release(self) -> None:
+        """Free this engine's device buffers (engine-cache LRU eviction).
+
+        Deletes the ENGINE-DERIVED statics arrays explicitly — dropping the
+        Python reference alone leaves the HBM release to GC timing, and a
+        service cycling through cluster shapes would hold every evicted
+        model generation until collection.  `statics.state` is the CALLER'S
+        ClusterState (also alive as result.state_before, the facade's
+        proposal cache, sibling engines under other configs): its arrays
+        are never deleted here, only de-referenced so GC can reclaim them
+        once the caller lets go.  The engine is unusable afterwards."""
+        sx = self.statics
+        if sx is not None:
+            for f in dataclasses.fields(EngineStatics):
+                if f.name == "state":
+                    continue  # caller-owned model arrays: drop the ref only
+                for leaf in jax.tree.leaves(getattr(sx, f.name)):
+                    try:
+                        leaf.delete()
+                    except Exception:  # noqa: BLE001 — already-deleted/np
+                        pass
+        self.statics = None
+        self._warm_futures = None
 
     # ------------------------------------------------------------------
     # state <-> carry
@@ -990,23 +1066,24 @@ class Engine:
     # candidate generation + delta evaluation
     # ------------------------------------------------------------------
 
-    def _sample_sources(self, key: jax.Array, n: int, plan) -> jax.Array:
+    def _sample_sources(self, sx, key: jax.Array, n: int, plan) -> jax.Array:
         """n source replica ids; `importance_fraction` of them drawn by a
         two-stage plan draw (broker ~ categorical(objective contribution),
-        then a replica uniformly on that broker), the rest uniform."""
+        then a replica uniformly on that broker), the rest uniform over the
+        valid prefix (sx.n_source — see EngineStatics: padded-R invariance)."""
         k1, k3, k4, k5 = jax.random.split(key, 4)
         n_imp = (
             int(round(n * self.config.importance_fraction)) if plan is not None else 0
         )
-        r = jax.random.randint(k1, (n - n_imp,), 0, self.shape.R)
+        r = _uniform_idx(k1, (n - n_imp,), sx.n_source)
         if n_imp:
             u = jax.random.uniform(k3, (n_imp,))
             bsel = jnp.clip(
-                jnp.searchsorted(plan.broker_cdf, u, side="right"), 0, self.shape.B - 1
+                jnp.searchsorted(plan.broker_cdf, u, side="right"), 0, sx.n_brokers - 1
             ).astype(jnp.int32)
             j = (jax.random.uniform(k4, (n_imp,)) * plan.count[bsel]).astype(jnp.int32)
             r_imp = plan.order[jnp.clip(plan.start[bsel] + j, 0, self.shape.R - 1)]
-            fallback = jax.random.randint(k5, (n_imp,), 0, self.shape.R)
+            fallback = _uniform_idx(k5, (n_imp,), sx.n_source)
             r_imp = jnp.where(plan.count[bsel] > 0, r_imp, fallback)
             r = jnp.concatenate([r, r_imp])
         return r
@@ -1016,8 +1093,8 @@ class Engine:
         st = sx.state
         K = self.K_r
         k1, k2 = jax.random.split(key)
-        r = self._sample_sources(k1, K, plan)
-        dst = sx.dest_ids[jax.random.randint(k2, (K,), 0, sx.dest_ids.shape[0])]
+        r = self._sample_sources(sx, k1, K, plan)
+        dst = sx.dest_ids[_uniform_idx(k2, (K,), sx.n_dest)]
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
 
@@ -1135,7 +1212,7 @@ class Engine:
         st = sx.state
         K = self.K_r
         D = self.shape.max_disks_per_broker
-        r = self._sample_sources(key, K, plan)
+        r = self._sample_sources(sx, key, K, plan)
         b = carry.replica_broker[r]
         d_src = carry.replica_disk[r]
         part = st.replica_partition[r]
@@ -1214,8 +1291,8 @@ class Engine:
             )
             return z, zb, zi, zi, zi, zi, payload
         k1, k2 = jax.random.split(key)
-        r = self._sample_sources(k1, K, plan)
-        q = jax.random.randint(k2, (K,), 0, self.shape.R)
+        r = self._sample_sources(sx, k1, K, plan)
+        q = _uniform_idx(k2, (K,), sx.n_source)
         src = carry.replica_broker[r]
         dst = carry.replica_broker[q]
         part_r = st.replica_partition[r]
@@ -1381,7 +1458,7 @@ class Engine:
             zl = jnp.zeros((0, NUM_RESOURCES), jnp.float32)
             payload = dict(kind=1, rf=zi, rt=zi, dl_f=zl, dl_t=zl, dlbin_src=z, dlbin_dst=z)
             return z, zb, zi, zi, zi, payload
-        rt = jax.random.randint(key, (K,), 0, R)
+        rt = _uniform_idx(key, (K,), sx.n_source)
         part = st.replica_partition[rt]
         members = sx.part_replicas[part]  # [K, max_rf]
         m_valid = members < R

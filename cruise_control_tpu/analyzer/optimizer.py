@@ -110,6 +110,18 @@ def parse_parallel_mode(mode: str) -> tuple[int, int] | None:
     )
 
 
+def _release_engine(engine) -> None:
+    """Free an evicted engine's device buffers (HBM) explicitly.
+
+    Only via the engine's own release() — it knows which statics arrays
+    are engine-derived vs caller-owned (deleting blindly would destroy the
+    caller's ClusterState buffers, still alive as result.state_before and
+    in sibling engines).  Engines without release() fall back to GC."""
+    release = getattr(engine, "release", None)
+    if release is not None:
+        release()
+
+
 class GoalOptimizer:
     """Entry point the service layer calls (reference GoalOptimizer.optimizations:416)."""
 
@@ -120,6 +132,9 @@ class GoalOptimizer:
         config: OptimizerConfig = OptimizerConfig(),
         parallel_mode: str = "single",
         balancedness_weights: tuple[float, float] = (1.1, 1.5),
+        engine_cache_size: int = 8,
+        sensors=None,
+        shape_bucket=None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (model sharded over every device,
@@ -128,7 +143,21 @@ class GoalOptimizer:
 
         balancedness_weights = (priority_weight, strictness_weight) for the
         0-100 balancedness score (reference AnalyzerConfig
-        goal.balancedness.{priority,strictness}.weight)."""
+        goal.balancedness.{priority,strictness}.weight).
+
+        engine_cache_size (config key tpu.engine.cache.size) bounds the
+        per-(shape, config) compiled-engine LRU; evicted engines have
+        their device buffers released.  sensors: optional SensorRegistry
+        receiving engine-cache hit/miss counters and a size gauge.
+
+        shape_bucket (config keys tpu.shape.bucket.*): ShapeBucketPolicy
+        the MULTI-DEVICE engines pad their inputs under, so shard layouts
+        derive from bucketed shapes and exact-vs-bucketed builds shard
+        identically.  Defaults to the service default policy; the
+        single-device path needs no padding here because model builds are
+        already bucketed upstream and the engine masks padding anyway."""
+        import threading
+
         import jax
 
         self.chain = chain
@@ -147,11 +176,32 @@ class GoalOptimizer:
         elif self.parallel_mode != "single" and len(jax.devices()) < 2:
             # single-chip host: sharded degenerates to the local engine
             self.parallel_mode = "single"
-        self._parallel_engines: dict = {}
-        #: engines cached per (ClusterShape, search config) — rebinding data
-        #: is free, recompiling is not (reference amortizes the same way via
-        #: its proposal precompute loop, GoalOptimizer.java:124-175)
-        self._engines: dict = {}
+        if engine_cache_size < 1:
+            raise ValueError(
+                f"engine_cache_size must be >= 1, got {engine_cache_size}"
+            )
+        from collections import OrderedDict
+
+        #: engines cached per (ClusterShape, search config) in LRU order —
+        #: rebinding data is free, recompiling is not (reference amortizes
+        #: the same way via its proposal precompute loop,
+        #: GoalOptimizer.java:124-175).  Bounded: under topology churn an
+        #: unbounded map accretes one full model generation of HBM per
+        #: bucket transition; eviction releases the engine's buffers.
+        self._engines: OrderedDict = OrderedDict()
+        self._parallel_engines: OrderedDict = OrderedDict()
+        self._cache_capacity = engine_cache_size
+        self._cache_lock = threading.Lock()
+        self.sensors = sensors
+        from cruise_control_tpu.models.state import DEFAULT_BUCKET_POLICY
+
+        self.shape_bucket = (
+            shape_bucket if shape_bucket is not None else DEFAULT_BUCKET_POLICY
+        )
+        #: compile-vs-rebind outcome counters (the churn bench and tests
+        #: assert "zero compiles across a churned generation" through these)
+        self.engine_cache_hits = 0
+        self.engine_cache_misses = 0
         # one persistent jitted program for objective+violations+stats:
         # eager per-op dispatch on large models costs orders of magnitude
         # more than the computation itself
@@ -162,19 +212,113 @@ class GoalOptimizer:
             )
         )
 
+    # ------------------------------------------------------------------
+    # engine cache (bounded LRU, explicit HBM release on eviction)
+    # ------------------------------------------------------------------
+
+    def _cache_size(self) -> int:
+        return len(self._engines) + len(self._parallel_engines)
+
+    def _record(self, hit: bool, *, count: bool = True) -> None:
+        if count:
+            if hit:
+                self.engine_cache_hits += 1
+            else:
+                self.engine_cache_misses += 1
+            if self.sensors is not None:
+                name = "hits" if hit else "misses"
+                self.sensors.counter(f"analyzer.engine-cache-{name}").inc()
+        if self.sensors is not None:
+            self.sensors.gauge("analyzer.engine-cache-size").set(self._cache_size())
+
+    def _cache_get(self, cache, key):
+        """Fetch + pin: the engine's busy count is raised under the lock so
+        a concurrent eviction never hard-releases an engine mid-run (the
+        facade shares one optimizer between request threads and the
+        precompute/prewarm thread).  Callers MUST pair with _unpin."""
+        with self._cache_lock:
+            engine = cache.get(key)
+            if engine is not None:
+                cache.move_to_end(key)
+                engine._cc_busy = getattr(engine, "_cc_busy", 0) + 1
+            return engine
+
+    def _unpin(self, engine) -> None:
+        # under the same lock as the pinning read-modify-writes: an
+        # unlocked decrement could clobber a concurrent _cache_get pin
+        # (freeing a live engine) or lose a decrement (leaking it forever)
+        with self._cache_lock:
+            engine._cc_busy = max(0, getattr(engine, "_cc_busy", 1) - 1)
+
+    def _cache_put(self, cache, key, engine, *, if_absent: bool = False) -> bool:
+        """Insert pinned + evict LRU overflow; returns whether `engine`
+        was published.  With if_absent=True an existing entry wins and the
+        offered engine is released instead (it was never published, so no
+        run can be using it) — prewarm's lost-race path.  Evicted (or
+        silently replaced) engines are hard-released only when no thread
+        holds a pin; a still-busy engine is dropped from the cache and
+        left to GC — a rare deferred release beats deleting buffers under
+        a live run."""
+        released = []
+        published = True
+        with self._cache_lock:
+            old = cache.get(key)
+            if old is not None and old is not engine:
+                if if_absent:
+                    published = False
+                else:
+                    released.append(old)  # replaced under the same key
+            if published:
+                engine._cc_busy = getattr(engine, "_cc_busy", 0) + 1
+                cache[key] = engine
+                cache.move_to_end(key)
+                while len(cache) > self._cache_capacity:
+                    released.append(cache.popitem(last=False)[1])
+        if not published:
+            _release_engine(engine)
+        for e in released:
+            if not getattr(e, "_cc_busy", 0):
+                _release_engine(e)
+        return published
+
     def _engine_for(
-        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
-    ) -> Engine:
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        config: OptimizerConfig,
+        *,
+        count: bool = True,
+    ) -> tuple[Engine, dict]:
+        """Cached engine for (shape, config) + a compile-vs-rebind outcome
+        record ({engine_cache_hit, engine_build_s}) for the result timing.
+        The engine comes back PINNED — the caller unpins after run().
+
+        engine_build_s is host construction/rebind time only: the jitted
+        programs compile lazily at first run, so the XLA compile itself
+        lands in the run's device wall — engine_cache_hit (False exactly
+        when that compile will be paid) is the compile signal."""
         key = (state.shape, config)
-        engine = self._engines.get(key)
-        if engine is None:
+        engine = self._cache_get(self._engines, key)
+        hit = engine is not None
+        t0 = time.monotonic()
+        if hit:
+            try:
+                engine.rebind(state, options)
+            except BaseException:
+                # a failed rebind (bad options mask, device error) must not
+                # leave the _cache_get pin behind — a stuck pin exempts the
+                # engine from hard release on eviction forever
+                self._unpin(engine)
+                raise
+        else:
             engine = Engine(
                 state, self.chain, constraint=self.constraint, options=options, config=config
             )
-            self._engines[key] = engine
-        else:
-            engine.rebind(state, options)
-        return engine
+            self._cache_put(self._engines, key, engine)
+        self._record(hit, count=count)
+        return engine, dict(
+            engine_cache_hit=hit, engine_build_s=round(time.monotonic() - t0, 6)
+        )
 
     def _parallel_engine(
         self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
@@ -182,18 +326,74 @@ class GoalOptimizer:
         """Multi-device engine per parallel_mode, cached per (shape, config)
         with a data rebind like _engine_for — recompiling the sharded
         programs per request would cost seconds to minutes.  Shard layouts
-        are data-dependent, so a rebind that changes the local shapes falls
-        back to building a fresh engine."""
+        derive from the (bucketed) global shape, but max_rf remains
+        data-dependent; a rebind that changes the local shapes falls back
+        to building a fresh engine."""
         key = (state.shape, config)
-        engine = self._parallel_engines.get(key)
+        engine = self._cache_get(self._parallel_engines, key)
+        t0 = time.monotonic()
         if engine is not None:
             try:
-                return engine.rebind(state, options)
+                engine = engine.rebind(state, options)
+                self._record(True)
+                return engine, dict(
+                    engine_cache_hit=True,
+                    engine_build_s=round(time.monotonic() - t0, 6),
+                )
             except ValueError:
-                pass  # local shard shapes changed: rebuild below
+                self._unpin(engine)  # local shard shapes changed: rebuild
+            except BaseException:
+                self._unpin(engine)  # pin must not outlive a failed rebind
+                raise
         engine = self._build_parallel_engine(state, options, config)
-        self._parallel_engines[key] = engine
-        return engine
+        self._cache_put(self._parallel_engines, key, engine)
+        self._record(False)
+        return engine, dict(
+            engine_cache_hit=False, engine_build_s=round(time.monotonic() - t0, 6)
+        )
+
+    def has_engine_for(
+        self, shape, *, config: OptimizerConfig | None = None
+    ) -> bool:
+        """True when a compiled engine for (shape, config) is cached —
+        lets the facade's precompute loop skip the padded-model build when
+        the next bucket is already warm."""
+        key = (shape, config or self.config)
+        with self._cache_lock:
+            return key in self._engines or key in self._parallel_engines
+
+    def prewarm(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        *,
+        config: OptimizerConfig | None = None,
+    ) -> None:
+        """Build + background-compile the engine for `state`'s shape without
+        running it (the facade pre-warms the NEXT shape bucket with a padded
+        model so a bucket overflow hits a warm engine instead of a cold
+        compile).  Build-only, never rebind: if an engine for the shape
+        already exists — including one a foreground request inserted while
+        we were building — it is left untouched, because rebinding it to
+        this (possibly stale, zero-padded) snapshot could swap statics
+        under a live run.  Does not touch the hit/miss counters."""
+        if self.parallel_mode != "single":
+            return  # parallel engines compile on use; no async warm path
+        cfg = config or self.config
+        key = (state.shape, cfg)
+        with self._cache_lock:
+            if key in self._engines:
+                return
+        engine = Engine(
+            state, self.chain, constraint=self.constraint, options=options, config=cfg
+        )
+        if not self._cache_put(self._engines, key, engine, if_absent=True):
+            return  # a foreground request built the engine first
+        self._record(False, count=False)
+        try:
+            engine.precompile_async()
+        finally:
+            self._unpin(engine)
 
     def _build_parallel_engine(
         self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
@@ -205,11 +405,13 @@ class GoalOptimizer:
             return ShardedEngine(
                 state, self.chain, mesh=model_mesh(),
                 constraint=self.constraint, options=options, config=config,
+                bucket=self.shape_bucket,
             )
         r, m = self._grid_shape
         return GridEngine(
             state, self.chain, mesh=grid_mesh(r, m),
             constraint=self.constraint, options=options, config=config,
+            bucket=self.shape_bucket,
         )
 
     def optimize(
@@ -242,27 +444,34 @@ class GoalOptimizer:
         # traces the report programs below — the restarted-service warm
         # start (engine.precompile_async docstring)
         engine = None
-        if self.parallel_mode == "single":
-            engine = self._engine_for(state, options, cfg)
-            # only at production scale: tiny test engines compile in
-            # hundreds of ms, and eagerly tracing the rarely-used programs
-            # (full-chain violations) would cost more than the overlap wins
-            if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
-                engine.precompile_async()
-        (obj_b, viol_b), stats_b = self._report(state)
-        # the proposal diff needs bulk BEFORE-state arrays on host; pull
-        # them on a side thread while the device anneals — input buffers
-        # are immutable, and the copy rides the link during compute the
-        # host would otherwise spend blocked on the engine
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            before_host_f = pool.submit(fetch_before_host, state)
-            if engine is not None:
+        cache_info = None
+        try:
+            if self.parallel_mode == "single":
+                engine, cache_info = self._engine_for(state, options, cfg)
+                # only at production scale: tiny test engines compile in
+                # hundreds of ms, and eagerly tracing the rarely-used
+                # programs (full-chain violations) would cost more than
+                # the overlap wins
+                if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
+                    engine.precompile_async()
+            (obj_b, viol_b), stats_b = self._report(state)
+            # the proposal diff needs bulk BEFORE-state arrays on host;
+            # pull them on a side thread while the device anneals — input
+            # buffers are immutable, and the copy rides the link during
+            # compute the host would otherwise spend blocked on the engine
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                before_host_f = pool.submit(fetch_before_host, state)
+                if engine is None:
+                    engine, cache_info = self._parallel_engine(state, options, cfg)
                 final, history = engine.run(verbose=verbose)
-            else:
-                final, history = self._parallel_engine(state, options, cfg).run(
-                    verbose=verbose
-                )
-            before_host = before_host_f.result()
+                before_host = before_host_f.result()
+        finally:
+            # run() is done with the engine's buffers (everything below
+            # reads only the run's OUTPUT arrays); release the eviction
+            # pin on EVERY exit path — a pin leaked on an exception would
+            # exempt the engine from hard release forever
+            if engine is not None:
+                self._unpin(engine)
         # dispatch the result report + the on-device sanity check, then do
         # the host-side proposal diff while the device drains them
         (obj_a, viol_a), stats_a = self._report(final)
@@ -278,6 +487,13 @@ class GoalOptimizer:
             timing = dict(timing=True)
             history.append(timing)
         timing["host_extract_s"] = round(extract_s, 6)
+        # compile-vs-rebind outcome + the (bucketed) shape served: the
+        # observable proof that shape bucketing absorbed a topology change
+        # (engine_cache_hit=True, compile_s ~ rebind cost) vs paid a compile
+        if cache_info is not None:
+            timing.update(cache_info)
+        s = state.shape
+        timing["bucket"] = dict(R=s.R, B=s.B, P=s.P, T=s.num_topics)
         final_checks = np.asarray(final_checks)
         if final_checks.any():
             bad = [n for n, c in zip(DEVICE_CHECKS, final_checks) if c]

@@ -45,27 +45,64 @@ class OptimizationOptions:
                 )
             object.__setattr__(self, f.name, arr)
 
+    @staticmethod
+    def _fit(mask: np.ndarray, n: int, name: str, *, n_real: int = 0) -> np.ndarray:
+        """Fit a mask built against REAL entity counts to a (possibly
+        shape-bucketed) padded axis: padding rows are never excluded /
+        requested, so [n_real, n) extends with False.  A mask SHORTER than
+        the real entity count is a stale/wrong-cluster mask (e.g. built
+        before a broker add) and fails loudly — silently un-excluding the
+        uncovered entities would defeat the operator's intent."""
+        mask = np.asarray(mask, bool)
+        if mask.size > n:
+            raise ValueError(f"{name} mask has {mask.size} entries for axis {n}")
+        if mask.size < n_real:
+            raise ValueError(
+                f"{name} mask covers {mask.size} of {n_real} real entities"
+            )
+        if mask.size < n:
+            mask = np.pad(mask, (0, n - mask.size))
+        return mask
+
     def dest_allowed(self, state: ClusterState) -> np.ndarray:
         B = state.shape.B
+        n_real = int(np.asarray(state.broker_valid).sum())
         allowed = np.ones(B, bool)
         if self.excluded_brokers_for_replica_move is not None:
-            allowed &= ~np.asarray(self.excluded_brokers_for_replica_move, bool)
+            allowed &= ~self._fit(
+                self.excluded_brokers_for_replica_move, B,
+                "excluded_brokers_for_replica_move", n_real=n_real,
+            )
         if self.requested_destination_brokers is not None:
-            allowed &= np.asarray(self.requested_destination_brokers, bool)
+            allowed &= self._fit(
+                self.requested_destination_brokers, B,
+                "requested_destination_brokers", n_real=n_real,
+            )
         return allowed
 
     def leadership_allowed(self, state: ClusterState) -> np.ndarray:
         B = state.shape.B
         allowed = np.ones(B, bool)
         if self.excluded_brokers_for_leadership is not None:
-            allowed &= ~np.asarray(self.excluded_brokers_for_leadership, bool)
+            allowed &= ~self._fit(
+                self.excluded_brokers_for_leadership, B,
+                "excluded_brokers_for_leadership",
+                n_real=int(np.asarray(state.broker_valid).sum()),
+            )
         return allowed
 
     def topic_movable(self, state: ClusterState) -> np.ndarray:
+        # no real-count floor here: the state carries no topic-validity
+        # axis to check against, and the service path rebuilds
+        # excluded_topics from the CURRENT catalog on every request
+        # (facade._build_options) — a short mask can only mean topics
+        # created since the mask was built, which stay movable exactly as
+        # the reference's evaluate-the-regex-at-request-time semantics
+        # would leave them.
         T = state.shape.num_topics
         movable = np.ones(T, bool)
         if self.excluded_topics is not None:
-            movable &= ~np.asarray(self.excluded_topics, bool)
+            movable &= ~self._fit(self.excluded_topics, T, "excluded_topics")
         return movable
 
 

@@ -119,6 +119,22 @@ def _analyzer_defs() -> ConfigDef:
              "multi-device strategy: single / sharded (model sharded over "
              "all devices) / grid:RxM (restart portfolio over model shards)",
              _valid_parallel_mode, group=g)
+    d.define("tpu.shape.bucket.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "round cluster-model shapes (replicas/brokers/partitions/"
+             "topics/racks/hosts) up to geometric buckets so compiled "
+             "engines survive topology churn — partition creates and "
+             "broker adds within a bucket rebind the cached engine with "
+             "zero recompilation", group=g)
+    d.define("tpu.shape.bucket.growth", T.DOUBLE, 1.25, I.MEDIUM,
+             "bucket growth factor between adjacent shape buckets; larger "
+             "values recompile less often but pad (and compute over) more",
+             in_range(lo=1.01), group=g)
+    d.define("tpu.shape.bucket.floor", T.INT, 8, I.LOW,
+             "smallest shape bucket (series base)", in_range(lo=1), group=g)
+    d.define("tpu.engine.cache.size", T.INT, 8, I.MEDIUM,
+             "max compiled engines kept per optimizer (LRU; evicted "
+             "engines' device buffers are released) — bounds HBM growth "
+             "across shape-bucket transitions", in_range(lo=1), group=g)
     d.define("tpu.compilation.cache.dir", T.STRING,
              "~/.cache/cruise_control_tpu/xla", I.LOW,
              "persistent XLA compilation cache directory; empty disables "
@@ -608,6 +624,15 @@ class CruiseControlConfig(AbstractConfig):
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")
+
+    def shape_bucket_policy(self):
+        from cruise_control_tpu.models.state import ShapeBucketPolicy
+
+        return ShapeBucketPolicy(
+            enabled=self.get("tpu.shape.bucket.enabled"),
+            growth=self.get("tpu.shape.bucket.growth"),
+            floor=self.get("tpu.shape.bucket.floor"),
+        )
 
 
 def load_properties(path: str) -> dict[str, str]:

@@ -15,7 +15,9 @@ Topic identity: partition samples are keyed by topic NAME on the wire —
 the in-memory dense topic ids are interned per process in first-seen order
 (monitor builder / reporter sampler), so a raw id persisted before a
 restart could point at a different topic afterwards.  `topic_name_fn` /
-`topic_id_fn` translate id <-> name at the store boundary.
+`topic_id_fn` translate id <-> name at the store boundary; the monitor
+catalog's `ClusterCatalog.topic_id` is the natural `topic_id_fn` (O(1),
+dict-backed — it is called once per replayed sample).
 
 Record layout (little-endian):
   kind u8 (0=partition, 1=broker) | id i32 | partition i32 | time_ms i64 |

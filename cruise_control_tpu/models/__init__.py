@@ -4,8 +4,15 @@ from cruise_control_tpu.models.builder import (
     ClusterModelBuilder,
     PartitionSpec,
     default_follower_load,
+    pad_state,
 )
-from cruise_control_tpu.models.state import ClusterShape, ClusterState, validate
+from cruise_control_tpu.models.state import (
+    DEFAULT_BUCKET_POLICY,
+    ClusterShape,
+    ClusterState,
+    ShapeBucketPolicy,
+    validate,
+)
 from cruise_control_tpu.models.stats import ClusterStats, compute_stats
 
 __all__ = [
@@ -15,10 +22,13 @@ __all__ = [
     "ClusterShape",
     "ClusterState",
     "ClusterStats",
+    "DEFAULT_BUCKET_POLICY",
     "PartitionSpec",
+    "ShapeBucketPolicy",
     "compute_aggregates",
     "compute_stats",
     "default_follower_load",
     "host_load",
+    "pad_state",
     "validate",
 ]

@@ -14,7 +14,7 @@ import dataclasses
 import numpy as np
 
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
-from cruise_control_tpu.models.state import ClusterShape, ClusterState
+from cruise_control_tpu.models.state import ClusterShape, ClusterState, ShapeBucketPolicy
 
 
 @dataclasses.dataclass
@@ -43,8 +43,17 @@ class ClusterCatalog:
     racks: tuple[str, ...] = ()
     hosts: tuple[str, ...] = ()
 
+    def __post_init__(self):
+        # name -> id dict built ONCE: topic_id() is called per stored sample
+        # by the sample-store boundary (kafka/sample_store.py topic_id_fn)
+        # and an O(T) tuple.index scan per call is quadratic over a store
+        # replay (frozen dataclass: bypass the setattr guard)
+        object.__setattr__(
+            self, "_topic_idx", {t: i for i, t in enumerate(self.topics)}
+        )
+
     def topic_id(self, name: str) -> int:
-        return self.topics.index(name)
+        return self._topic_idx[name]
 
     def partition_key(self, pid: int) -> tuple[str, int]:
         return self.partitions[pid]
@@ -145,6 +154,15 @@ def _broker_arrays(brokers: list[BrokerSpec]) -> _BrokerArrays:
     return out
 
 
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    """Zero/False-pad the leading axis of `a` out to n rows."""
+    if a.shape[0] == n:
+        return a
+    out = np.zeros((n,) + a.shape[1:], a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
 def _assemble_state(
     ba: _BrokerArrays,
     shape: ClusterShape,
@@ -153,27 +171,36 @@ def _assemble_state(
 ) -> ClusterState:
     import jax.numpy as jnp
 
-    B = ba.capacity.shape[0]
+    # shape may be a BUCKETED superset of the data (ShapeBucketPolicy):
+    # replica rows beyond the allocation and broker rows beyond the real
+    # broker count become padding — broker_valid=False brokers are never
+    # alive, carry zero capacity, and are masked out of every goal
+    # denominator and candidate-destination set downstream.
+    B_real = ba.capacity.shape[0]
+    B = shape.B
+    broker_valid = np.zeros(B, bool)
+    broker_valid[:B_real] = True
+    D = shape.max_disks_per_broker
     return ClusterState(
-        replica_broker=jnp.asarray(r_broker),
-        replica_partition=jnp.asarray(r_part),
-        replica_topic=jnp.asarray(r_topic),
-        replica_pos=jnp.asarray(r_pos),
-        replica_is_leader=jnp.asarray(r_leader),
-        replica_valid=jnp.asarray(r_valid),
-        replica_orig_broker=jnp.asarray(r_broker.copy()),
-        replica_offline=jnp.asarray(r_offline),
-        replica_disk=jnp.asarray(r_disk),
-        replica_load_leader=jnp.asarray(r_ll),
-        replica_load_follower=jnp.asarray(r_fl),
-        broker_capacity=jnp.asarray(ba.capacity),
-        broker_rack=jnp.asarray(ba.rack),
-        broker_host=jnp.asarray(ba.host),
-        broker_alive=jnp.asarray(ba.alive),
-        broker_new=jnp.asarray(ba.new),
-        broker_valid=jnp.ones(B, bool),
-        disk_capacity=jnp.asarray(ba.disk_capacity),
-        disk_alive=jnp.asarray(ba.disk_alive),
+        replica_broker=jnp.asarray(_pad_rows(r_broker, shape.R)),
+        replica_partition=jnp.asarray(_pad_rows(r_part, shape.R)),
+        replica_topic=jnp.asarray(_pad_rows(r_topic, shape.R)),
+        replica_pos=jnp.asarray(_pad_rows(r_pos, shape.R)),
+        replica_is_leader=jnp.asarray(_pad_rows(r_leader, shape.R)),
+        replica_valid=jnp.asarray(_pad_rows(r_valid, shape.R)),
+        replica_orig_broker=jnp.asarray(_pad_rows(r_broker.copy(), shape.R)),
+        replica_offline=jnp.asarray(_pad_rows(r_offline, shape.R)),
+        replica_disk=jnp.asarray(_pad_rows(r_disk, shape.R)),
+        replica_load_leader=jnp.asarray(_pad_rows(r_ll, shape.R)),
+        replica_load_follower=jnp.asarray(_pad_rows(r_fl, shape.R)),
+        broker_capacity=jnp.asarray(_pad_rows(ba.capacity, B)),
+        broker_rack=jnp.asarray(_pad_rows(ba.rack, B)),
+        broker_host=jnp.asarray(_pad_rows(ba.host, B)),
+        broker_alive=jnp.asarray(_pad_rows(ba.alive, B)),
+        broker_new=jnp.asarray(_pad_rows(ba.new, B)),
+        broker_valid=jnp.asarray(broker_valid),
+        disk_capacity=jnp.asarray(_pad_rows(ba.disk_capacity, B)[:, :D]),
+        disk_alive=jnp.asarray(_pad_rows(ba.disk_alive, B)[:, :D]),
         shape=shape,
     )
 
@@ -185,6 +212,7 @@ def build_state_columnar(
     follower_load: np.ndarray,
     *,
     replica_capacity: int | None = None,
+    bucket_policy: ShapeBucketPolicy | None = None,
 ) -> tuple[ClusterState, ClusterCatalog]:
     """Vectorized twin of ClusterModelBuilder.build for monitor-shaped input.
 
@@ -269,6 +297,8 @@ def build_state_columnar(
         num_hosts=max(len(ba.hosts), 1),
         max_disks_per_broker=ba.D,
     )
+    if bucket_policy is not None:
+        shape = bucket_policy.bucket_shape(shape)
     state = _assemble_state(
         ba, shape,
         r_broker, r_part, r_topic, r_pos, r_leader, r_valid, r_offline, r_disk,
@@ -277,12 +307,62 @@ def build_state_columnar(
     return state, catalog
 
 
+def pad_state(state: ClusterState, shape: ClusterShape) -> ClusterState:
+    """Pad an already-built ClusterState out to a (bucketed) superset shape.
+
+    Replica/broker rows beyond the current shape become masked padding
+    (replica_valid / broker_valid False); partition/topic/rack/host axes
+    grow shape-only (no replica references them).  Used by the service's
+    next-bucket engine pre-warm and by the exact-vs-bucketed parity tests.
+    """
+    s = state.shape
+    if shape == s:
+        return state
+    for f in dataclasses.fields(ClusterShape):
+        if getattr(shape, f.name) < getattr(s, f.name):
+            raise ValueError(f"pad_state cannot shrink {f.name}: {s} -> {shape}")
+    import jax
+    import jax.numpy as jnp
+
+    repl_fields = [
+        "replica_broker", "replica_partition", "replica_topic", "replica_pos",
+        "replica_is_leader", "replica_valid", "replica_orig_broker",
+        "replica_offline", "replica_disk", "replica_load_leader",
+        "replica_load_follower",
+    ]
+    brk_fields = [
+        "broker_capacity", "broker_rack", "broker_host", "broker_alive",
+        "broker_new", "broker_valid", "disk_capacity", "disk_alive",
+    ]
+    host = dict(zip(
+        repl_fields + brk_fields,
+        jax.device_get(tuple(getattr(state, f) for f in repl_fields + brk_fields)),
+    ))
+    kw = {f: jnp.asarray(_pad_rows(host[f], shape.R)) for f in repl_fields}
+    D = shape.max_disks_per_broker
+    for f in brk_fields:
+        a = _pad_rows(host[f], shape.B)
+        if f in ("disk_capacity", "disk_alive") and a.shape[1] < D:
+            wide = np.zeros((shape.B, D), a.dtype)
+            wide[:, : a.shape[1]] = a
+            a = wide
+        kw[f] = jnp.asarray(a)
+    return dataclasses.replace(state, shape=shape, **kw)
+
+
 class ClusterModelBuilder:
-    def __init__(self, *, replica_capacity: int | None = None, follower_cpu_fraction: float = 0.3):
+    def __init__(
+        self,
+        *,
+        replica_capacity: int | None = None,
+        follower_cpu_fraction: float = 0.3,
+        bucket_policy: ShapeBucketPolicy | None = None,
+    ):
         self._brokers: list[BrokerSpec] = []
         self._partitions: list[PartitionSpec] = []
         self._replica_capacity = replica_capacity
         self._follower_cpu_fraction = follower_cpu_fraction
+        self._bucket_policy = bucket_policy
 
     def add_broker(self, spec: BrokerSpec) -> "ClusterModelBuilder":
         self._brokers.append(spec)
@@ -357,6 +437,8 @@ class ClusterModelBuilder:
             num_hosts=max(len(hosts), 1),
             max_disks_per_broker=D,
         )
+        if self._bucket_policy is not None:
+            shape = self._bucket_policy.bucket_shape(shape)
         return _assemble_state(
             ba, shape,
             r_broker, r_part, r_topic, r_pos, r_leader, r_valid, r_offline,

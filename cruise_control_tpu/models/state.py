@@ -93,6 +93,93 @@ class ClusterShape:
         return self.num_partitions
 
 
+@dataclasses.dataclass(frozen=True)
+class ShapeBucketPolicy:
+    """Geometric shape-bucketing policy for engine-cache stability.
+
+    Engines compile per exact ClusterShape (analyzer/engine.py); a Kafka
+    cluster creates partitions and adds brokers continuously, so an exact
+    shape key makes nearly every model generation under churn a compile
+    miss.  Rounding each axis up to the next bucket of the geometric
+    series floor·growth^k (the batch/sequence-length bucketing of
+    inference serving) makes successive generations land in the SAME
+    padded shape: `Engine.rebind()` swaps in the fresh data with zero
+    recompilation, and only a bucket overflow (≥ growth× accumulated
+    churn) pays a compile.
+
+    The padding this introduces is masked everywhere — `replica_valid`
+    for replicas, `broker_valid` for brokers (never alive, zero capacity,
+    never a destination, excluded from every goal denominator), and
+    shape-only padding for partitions/topics/racks/hosts (no replicas
+    reference them) — pinned by the exact-vs-bucketed parity tests.
+    """
+
+    enabled: bool = True
+    #: bucket growth factor between adjacent buckets (> 1)
+    growth: float = 1.25
+    #: smallest bucket; also the series base
+    floor: int = 8
+
+    def __post_init__(self):
+        if self.growth <= 1.0:
+            raise ValueError(f"bucket growth must be > 1, got {self.growth}")
+        if self.floor < 1:
+            raise ValueError(f"bucket floor must be >= 1, got {self.floor}")
+
+    def bucket(self, n: int) -> int:
+        """Smallest bucket >= n in the series ceil(floor * growth^k)."""
+        if not self.enabled:
+            return int(n)
+        if n <= self.floor:
+            return self.floor
+        import math
+
+        # float log gets within one step of the right k; walk to the exact
+        # smallest bucket so the series is deterministic and monotone
+        k = max(0, int(math.log(n / self.floor) / math.log(self.growth)) - 1)
+        b = int(math.ceil(self.floor * self.growth**k))
+        while b < n:
+            k += 1
+            b = int(math.ceil(self.floor * self.growth**k))
+        return b
+
+    def bucket_shape(self, shape: ClusterShape) -> ClusterShape:
+        """Round every churn-prone axis up to its bucket (D stays exact:
+        logdir counts change only on hardware refresh)."""
+        if not self.enabled:
+            return shape
+        return ClusterShape(
+            num_replicas=self.bucket(shape.num_replicas),
+            num_brokers=self.bucket(shape.num_brokers),
+            num_partitions=self.bucket(shape.num_partitions),
+            num_topics=self.bucket(shape.num_topics),
+            num_racks=self.bucket(shape.num_racks),
+            num_hosts=self.bucket(shape.num_hosts),
+            max_disks_per_broker=shape.max_disks_per_broker,
+        )
+
+    def next_bucket_shape(self, shape: ClusterShape) -> ClusterShape:
+        """The shape one partition-churn overflow lands in: the replica and
+        partition axes bumped past their current bucket (other axes — topic,
+        broker, rack, host — stay at their current bucket; their churn is an
+        order of magnitude rarer than partition creates).  Used by the
+        service's precompute loop to pre-warm the next engine so a bucket
+        overflow hits a warm compile instead of a cold one."""
+        return ClusterShape(
+            num_replicas=self.bucket(self.bucket(shape.num_replicas) + 1),
+            num_brokers=self.bucket(shape.num_brokers),
+            num_partitions=self.bucket(self.bucket(shape.num_partitions) + 1),
+            num_topics=self.bucket(shape.num_topics),
+            num_racks=self.bucket(shape.num_racks),
+            num_hosts=self.bucket(shape.num_hosts),
+            max_disks_per_broker=shape.max_disks_per_broker,
+        )
+
+
+#: service-default policy (config keys tpu.shape.bucket.*)
+DEFAULT_BUCKET_POLICY = ShapeBucketPolicy()
+
+
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=[

@@ -86,6 +86,7 @@ class LoadMonitor:
         topic_filter=None,
         max_allowed_extrapolations: int = 5,
         cpu_weights: tuple[float, float, float] | None = None,
+        bucket_policy=None,
     ):
         from cruise_control_tpu.monitor.cpu_model import DEFAULT_CPU_WEIGHTS
 
@@ -109,6 +110,10 @@ class LoadMonitor:
         #: task runner's /train flow) it replaces the static-coefficient
         #: follower-CPU estimate (reference ModelUtils.java:84)
         self.regression = regression
+        #: optional models.state.ShapeBucketPolicy — built models are padded
+        #: to bucketed shapes so the analyzer's compiled engines survive
+        #: topology churn (config tpu.shape.bucket.*; None = exact shapes)
+        self.bucket_policy = bucket_policy
         self._state = MonitorState.NOT_STARTED
         # reference acquireForModelGeneration():390 — semaphore bounding
         # concurrent model generations
@@ -358,6 +363,7 @@ class LoadMonitor:
             leader_load,
             follower_load,
             replica_capacity=self._replica_capacity,
+            bucket_policy=self.bucket_policy,
         )
         self.last_catalog = catalog
         return state

@@ -67,6 +67,7 @@ class GridEngine(ShardedEngine):
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
+        bucket=None,
     ):
         if tuple(mesh.axis_names) != (RESTART_AXIS, MODEL_AXIS):
             raise ValueError(
@@ -77,7 +78,7 @@ class GridEngine(ShardedEngine):
         self.last_info: dict | None = None
         super().__init__(
             state, chain, mesh=mesh, constraint=constraint, options=options,
-            config=config,
+            config=config, bucket=bucket,
         )
 
     # ---- spec/stacking overrides: carry leaves are [r, m, ...] ----

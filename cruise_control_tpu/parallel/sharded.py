@@ -31,6 +31,14 @@ second placement exchange); relocations and leadership transfers are
 unrestricted, so cross-shard mass still moves freely — shards partition the
 *partition id space*, not brokers.
 
+Shape bucketing (models.state.ShapeBucketPolicy): when constructed with a
+`bucket` policy, the input model is padded to its shape bucket BEFORE the
+shard split, so the per-device shard shapes derive from the bucketed
+global shape and survive topology churn (rebind instead of recompile),
+and exact-vs-bucketed builds of the same cluster shard — and anneal —
+identically.  The optimized placement is always reassembled onto the
+caller's original (unpadded) replica axis.
+
 Reference analog: none — the reference's optimizer is a single-threaded Java
 loop over one in-heap model (analyzer/goals/AbstractGoal.java:66-107).  This
 is the TPU-native scale-out story for it.
@@ -58,7 +66,11 @@ from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOpt
 from cruise_control_tpu.common.resources import NUM_RESOURCES
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.aggregates import compute_aggregates
-from cruise_control_tpu.models.state import ClusterShape, ClusterState
+from cruise_control_tpu.models.state import (
+    ClusterShape,
+    ClusterState,
+    ShapeBucketPolicy,
+)
 
 MODEL_AXIS = "model"
 
@@ -111,12 +123,21 @@ class ShardLayout:
     local_states: list  # per-shard ClusterState (numpy-backed)
 
 
-def build_layout(state: ClusterState, n: int) -> ShardLayout:
+def build_layout(
+    state: ClusterState,
+    n: int,
+    *,
+    bucket: ShapeBucketPolicy | None = None,
+) -> ShardLayout:
     """Split `state` into n partition-aligned shards.
 
     Partitions [i*P_local, (i+1)*P_local) and every replica of those
     partitions land on shard i; each shard is padded to a uniform R_local so
-    the stacked arrays are rectangular.
+    the stacked arrays are rectangular.  R_local is data-dependent (the
+    fullest shard's replica count), so it is rounded up to a geometric
+    bucket: with the global shape itself bucketed at model-build time, the
+    per-device shard shapes then also stay stable under topology churn and
+    `rebind()` keeps hitting the compiled sharded programs.
     """
     s = state.shape
     P_local = -(-s.P // n)  # ceil
@@ -124,7 +145,10 @@ def build_layout(state: ClusterState, n: int) -> ShardLayout:
     part = np.asarray(state.replica_partition)
     shard_of = np.where(valid, part // P_local, -1)
     counts = np.bincount(shard_of[valid], minlength=n)
-    R_local = max(8, int(-(-int(counts.max()) // 8) * 8))  # pad to /8
+    R_local = max(8, int(counts.max()))
+    if bucket is not None and bucket.enabled:
+        R_local = bucket.bucket(R_local)
+    R_local = int(-(-R_local // 8) * 8)  # pad to /8
     counts_all = np.bincount(part[valid], minlength=s.P)
     max_rf = max(1, int(counts_all.max())) if counts_all.size else 1
 
@@ -187,13 +211,24 @@ class ShardedEngine:
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
+        bucket: ShapeBucketPolicy | None = None,
     ):
+        """bucket: optional ShapeBucketPolicy (the GoalOptimizer passes the
+        service policy).  When set, the input model is padded to its shape
+        bucket BEFORE the shard split, so (a) the per-device shard shapes
+        derive from the bucketed global shape and stay stable under
+        topology churn, and (b) an exact and a bucketed build of the same
+        cluster shard identically — the trajectory-parity guarantee of the
+        single-device engine carries over to the sharded path.  The final
+        placement is always reassembled onto the ORIGINAL (unpadded)
+        state."""
         self.mesh = mesh if mesh is not None else model_mesh()
         # number of MODEL shards — on a 2D (restart, model) mesh this is the
         # model-axis extent, not the device count
         self.n = int(self.mesh.shape[MODEL_AXIS])
+        self._bucket = bucket if bucket is not None and bucket.enabled else None
         self.global_state = state
-        self.layout = build_layout(state, self.n)
+        self.layout = build_layout(self._padded(state), self.n, bucket=self._bucket)
         self.P_total = self.layout.P_local * self.n
         # local-shape engine: candidate generation + apply run per shard
         self.engine = Engine(
@@ -201,6 +236,13 @@ class ShardedEngine:
         )
         self._bind(state, self.layout, options)
         self._build_jits()
+
+    def _padded(self, state: ClusterState) -> ClusterState:
+        if self._bucket is None:
+            return state
+        from cruise_control_tpu.models.builder import pad_state
+
+        return pad_state(state, self._bucket.bucket_shape(state.shape))
 
     def _bind(self, state: ClusterState, layout: ShardLayout,
               options: OptimizationOptions) -> None:
@@ -226,14 +268,31 @@ class ShardedEngine:
             statics_list.append(sx)
         self.statics = _tree_stack(statics_list)
 
+    def release(self) -> None:
+        """Drop device buffers on engine-cache eviction.
+
+        The inner Engine releases its engine-derived arrays; the shard-local
+        states and stacked statics are only DE-REFERENCED — their broker-axis
+        fields alias the caller's global ClusterState (and, unbucketed, the
+        replica fields too), so explicit delete() here would destroy arrays
+        the caller still holds (result.state_before, sibling engines).  The
+        engine-private shard arrays free via refcount as soon as these refs
+        drop.  The engine is unusable afterwards."""
+        self.engine.release()
+        self.statics = None
+        self.layout = None
+        self.global_state = None
+
     def rebind(self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS):
         """Swap in a new model generation without recompiling.
 
         The shard layout's local shapes (R_local/P_local/max_rf) are
         data-dependent; when they match the compiled ones the jitted
         programs are reused, otherwise a ValueError tells the caller to
-        build a fresh engine (mirrors Engine.rebind's shape check)."""
-        lay = build_layout(state, self.n)
+        build a fresh engine (mirrors Engine.rebind's shape check).  With
+        a bucket policy the layout derives from the BUCKETED global shape,
+        so generations inside a bucket always match."""
+        lay = build_layout(self._padded(state), self.n, bucket=self._bucket)
         old = self.layout
         if (lay.R_local, lay.P_local, lay.max_rf) != (
             old.R_local, old.P_local, old.max_rf

@@ -58,7 +58,10 @@ from cruise_control_tpu.service.progress import (
 @dataclasses.dataclass
 class _CachedResult:
     result: OptimizerResult
-    computed_ms: int
+    computed_ms: int  # wall clock, for reporting only
+    #: monotonic stamp for expiry — a backwards wall-clock step (NTP) must
+    #: not make cached proposals immortal (or instantly stale)
+    computed_mono: float
     model_generation: object
 
 
@@ -91,12 +94,18 @@ class CruiseControl:
             config.get("goal.balancedness.priority.weight"),
             config.get("goal.balancedness.strictness.weight"),
         )
+        #: shape-bucketing policy the monitor builds models under; the
+        #: precompute loop pre-warms the NEXT bucket through it
+        self.bucket_policy = config.shape_bucket_policy()
         self.optimizer = GoalOptimizer(
             chain=self.chain,
             constraint=self.constraint,
             config=config.optimizer_config(),
             parallel_mode=config.parallel_mode(),
             balancedness_weights=self.balancedness_weights,
+            engine_cache_size=config.get("tpu.engine.cache.size"),
+            sensors=self.sensors,
+            shape_bucket=self.bucket_policy,
         )
         from cruise_control_tpu.executor.strategy import resolve_strategy_chain
 
@@ -361,8 +370,39 @@ class CruiseControl:
                 )
             except Exception:  # noqa: BLE001 — precompute failures surface on demand
                 pass
+            try:
+                self._prewarm_next_bucket()
+            except Exception:  # noqa: BLE001 — prewarm is best-effort
+                pass
             if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
                 return
+
+    def _prewarm_next_bucket(self):
+        """Background-compile the engine for the NEXT shape bucket up.
+
+        Shape bucketing keeps the engine warm while churn stays inside the
+        current bucket; the generation that overflows it (enough partition
+        creates) would pay a cold compile exactly when the cluster is
+        busiest.  Pre-warming a zero-padded copy of the latest model at the
+        next bucket makes that overflow hit a compiled engine instead —
+        `Engine` programs never depend on the padding data, only the shape.
+        """
+        if not self.bucket_policy.enabled or self.optimizer.parallel_mode != "single":
+            return
+        with self._cache_lock:
+            cached = self._cache
+        if cached is None:
+            return
+        state = cached.result.state_before
+        nxt = self.bucket_policy.next_bucket_shape(state.shape)
+        # cheap checks BEFORE materializing the padded model: pad_state is
+        # a full device->host->device round trip of every model array, and
+        # this loop re-runs every proposal_expiration/2 seconds
+        if nxt == state.shape or self.optimizer.has_engine_for(nxt):
+            return
+        from cruise_control_tpu.models.builder import pad_state
+
+        self.optimizer.prewarm(pad_state(state, nxt))
 
     # ------------------------------------------------------------------
     # proposal computation + cache (reference optimizations():276-324,493)
@@ -402,6 +442,9 @@ class CruiseControl:
             constraint=self.constraint,
             config=cfg,
             balancedness_weights=self.balancedness_weights,
+            engine_cache_size=self.config.get("tpu.engine.cache.size"),
+            sensors=self.sensors,
+            shape_bucket=self.bucket_policy,
         )
 
     def proposals(
@@ -445,7 +488,10 @@ class CruiseControl:
         if storable:
             with self._cache_lock:
                 self._cache = _CachedResult(
-                    result, int(time.time() * 1000), self.monitor.model_generation()
+                    result,
+                    int(time.time() * 1000),
+                    time.monotonic(),
+                    self.monitor.model_generation(),
                 )
         return result
 
@@ -455,8 +501,8 @@ class CruiseControl:
             if c is None:
                 return None
             expired = (
-                int(time.time() * 1000) - c.computed_ms > self._proposal_expiration_ms
-            )
+                time.monotonic() - c.computed_mono
+            ) * 1000.0 > self._proposal_expiration_ms
             stale = c.model_generation != self.monitor.model_generation()
             if expired or stale:
                 self._cache = None
@@ -649,20 +695,29 @@ class CruiseControl:
         excluded_topics only ever widens the exclusion)."""
         import re
 
+        bvalid = np.asarray(state.broker_valid)
+        n_real = int(bvalid.sum())
+
         def _mask(ids, *, strict: bool):
             # strict (explicitly requested brokers, e.g. add_broker
             # destinations): an unknown id must FAIL the request — silently
             # dropping it would degrade add_broker into an unconstrained
-            # full-cluster rebalance.  Non-strict (history-derived
-            # exclusions): the recently-removed history legitimately
-            # retains brokers the shrunken model no longer has — drop those.
-            unknown = [b for b in (ids or ()) if not 0 <= b < state.shape.B]
+            # full-cluster rebalance.  With shape bucketing the model's
+            # broker axis carries padding rows past the real brokers, so
+            # "known" means broker_valid, not merely in-range.  Non-strict
+            # (history-derived exclusions): the recently-removed history
+            # legitimately retains brokers the shrunken model no longer
+            # has — drop those.
+            unknown = [
+                b for b in (ids or ())
+                if not (0 <= b < state.shape.B and bvalid[b])
+            ]
             if strict and unknown:
                 raise ValueError(
                     f"broker ids {unknown} are not in the cluster model "
-                    f"(brokers 0..{state.shape.B - 1})"
+                    f"(brokers 0..{n_real - 1})"
                 )
-            ids = [b for b in (ids or ()) if 0 <= b < state.shape.B]
+            ids = [b for b in (ids or ()) if 0 <= b < state.shape.B and bvalid[b]]
             if not ids:
                 return None
             m = np.zeros(state.shape.B, bool)

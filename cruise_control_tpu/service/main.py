@@ -115,6 +115,7 @@ def build_service(
     monitor = LoadMonitor(
         metadata, capacity_resolver, partition_agg,
         regression=regression, topic_filter=topic_filter,
+        bucket_policy=config.shape_bucket_policy(),
         max_allowed_extrapolations=config.get(
             "max.allowed.extrapolations.per.partition"
         ),

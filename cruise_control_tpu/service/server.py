@@ -470,6 +470,7 @@ class CruiseControlApp:
             load = np.asarray(agg.broker_load)
             cap = np.asarray(state.broker_capacity)
             alive = np.asarray(state.broker_alive)
+            bvalid = np.asarray(state.broker_valid)
             hosts = (
                 self.cc.monitor.last_catalog.hosts
                 if self.cc.monitor.last_catalog and self.cc.monitor.last_catalog.hosts
@@ -477,6 +478,8 @@ class CruiseControlApp:
             )
             brokers = []
             for b in range(state.shape.B):
+                if not bvalid[b]:
+                    continue  # shape-bucket padding rows are not brokers
                 row = {
                     "Broker": b,
                     "BrokerState": "ALIVE" if alive[b] else "DEAD",

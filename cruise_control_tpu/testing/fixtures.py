@@ -81,6 +81,26 @@ def dead_broker_cluster() -> ClusterState:
     return b.build()
 
 
+def jbod_cluster() -> ClusterState:
+    """4 JBOD brokers (2 logdirs each, one failed) with skewed disk load —
+    exercises the intra-broker disk axes (D > 1, bad_disks) that the
+    single-disk fixtures never touch."""
+    b = ClusterModelBuilder()
+    cap = np.array([100.0, 1000.0, 1000.0, 3000.0], np.float32)
+    b.add_broker(BrokerSpec(0, rack="r0", capacity=cap, disk_capacities=[1000.0, 2000.0]))
+    b.add_broker(BrokerSpec(1, rack="r0", capacity=cap,
+                            disk_capacities=[1500.0, 1500.0], bad_disks=[1]))
+    b.add_broker(BrokerSpec(2, rack="r1", capacity=cap, disk_capacities=[2000.0, 1000.0]))
+    b.add_broker(BrokerSpec(3, rack="r1", capacity=cap, disk_capacities=[1500.0, 1500.0]))
+    load = np.array([5.0, 40.0, 50.0, 400.0], np.float32)
+    for p in range(6):
+        brokers = [p % 4, (p + 1) % 4]
+        b.add_partition(PartitionSpec(
+            "T1", p, brokers, load, replica_disks=[p % 2, 0]
+        ))
+    return b.build()
+
+
 @dataclasses.dataclass
 class RandomClusterSpec:
     """Knobs of the random generator (reference common/ClusterProperty.java)."""

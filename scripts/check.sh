@@ -36,6 +36,10 @@ echo "== check.sh: bench.py --smoke (fused vs legacy perf path, CPU) =="
 GRAFT_FORCE_CPU=1 python bench.py --smoke
 smoke_rc=$?
 
+echo "== check.sh: bench.py --churn --smoke (shape-bucketed serving, CPU) =="
+GRAFT_FORCE_CPU=1 python bench.py --churn --smoke
+churn_rc=$?
+
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ]

@@ -62,7 +62,7 @@ def main():
     _, d_val = t("validate(state)", validate, state)
     (out, d_rep) = t("report(state)", lambda: jax.block_until_ready(opt._report(state)))
 
-    engine = opt._engine_for(state, __import__("cruise_control_tpu.analyzer.options", fromlist=["DEFAULT_OPTIONS"]).DEFAULT_OPTIONS, opt.config)
+    engine, _ = opt._engine_for(state, __import__("cruise_control_tpu.analyzer.options", fromlist=["DEFAULT_OPTIONS"]).DEFAULT_OPTIONS, opt.config)
     cfg = engine.config
     sx = engine.statics
     t0 = time.monotonic()

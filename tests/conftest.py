@@ -20,6 +20,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (common/compilation_cache.py): the suite
+# is compile-dominated — dozens of Engine instances re-compile structurally
+# identical programs (jit caches are per-instance, the disk cache is keyed
+# by HLO fingerprint) — so both repeat suite runs and same-shape engines
+# within one run load executables in ~ms instead of seconds.  Override the
+# location with TEST_COMPILE_CACHE=; set it empty to disable.
+from cruise_control_tpu.common.compilation_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(
+    os.environ.get("TEST_COMPILE_CACHE", "~/.cache/cruise_control_tpu/xla")
+)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -91,7 +91,7 @@ def test_optimizer_raises_on_corrupt_result(state, monkeypatch):
                 state, replica_broker=jnp.asarray(brk)
             ), []
 
-    monkeypatch.setattr(opt, "_engine_for", lambda *a, **k: _BadEngine())
+    monkeypatch.setattr(opt, "_engine_for", lambda *a, **k: (_BadEngine(), {}))
     # the device check flags the corrupt result, then the host validator
     # raises with the detailed per-invariant message
     with pytest.raises(ValueError, match="sanity check"):
