@@ -79,6 +79,7 @@ import numpy as np
 
 from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.aggregates import compute_aggregates
@@ -2024,6 +2025,7 @@ class Engine:
     # driver
     # ------------------------------------------------------------------
 
+    @device_op("engine.run")
     def run(self, *, verbose: bool = False):
         """Execute the annealing schedule; returns (final_state, history).
 

@@ -74,6 +74,14 @@ class OptimizerResult:
             return ps.data_to_move
         return sum(p.inter_broker_data_to_move for p in ps)
 
+    @property
+    def degraded(self) -> bool:
+        """True when this result came from the CPU greedy fallback because
+        the device path was unavailable (supervisor breaker open) or
+        failed with a classified device fault — the history carries a
+        `degraded` record with the reason and failure class."""
+        return any(h.get("degraded") for h in self.history)
+
     def violated_goals_after(self, tol: float = 1e-6) -> list[str]:
         """Default tol matches balancedness_score's goal-satisfied epsilon
         (analyzer/objective.py) — a response must not claim balancedness 100
@@ -91,6 +99,7 @@ class OptimizerResult:
             "objectiveAfter": self.objective_after,
             "violatedGoalsAfter": self.violated_goals_after(),
             "wallSeconds": self.wall_seconds,
+            "degraded": self.degraded,
         }
 
 
@@ -135,6 +144,8 @@ class GoalOptimizer:
         engine_cache_size: int = 8,
         sensors=None,
         shape_bucket=None,
+        supervisor=None,
+        degraded_budget_s: float = 30.0,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (model sharded over every device,
@@ -155,7 +166,17 @@ class GoalOptimizer:
         derive from bucketed shapes and exact-vs-bucketed builds shard
         identically.  Defaults to the service default policy; the
         single-device path needs no padding here because model builds are
-        already bucketed upstream and the engine masks padding anyway."""
+        already bucketed upstream and the engine masks padding anyway.
+
+        supervisor (config keys tpu.supervisor.*): DeviceSupervisor every
+        device-path invocation runs under — bounded budget, failure
+        classification, retry, circuit breaker (common/device_watchdog.py).
+        While the breaker is open (or when a call fails with a classified
+        device failure) `optimize` transparently serves a CPU greedy
+        result tagged degraded=True instead of hanging or failing; None
+        (the default, offline/test usage) keeps the direct path with zero
+        behavior change.  degraded_budget_s caps the greedy fallback's
+        wall clock (config tpu.supervisor.degraded.greedy.budget.s)."""
         import threading
 
         import jax
@@ -193,6 +214,13 @@ class GoalOptimizer:
         self._cache_capacity = engine_cache_size
         self._cache_lock = threading.Lock()
         self.sensors = sensors
+        self.supervisor = supervisor
+        self.degraded_budget_s = degraded_budget_s
+        #: breaker open-epoch last seen — caches are purged once per open
+        #: transition (pull-based: no callback registration to leak across
+        #: the facade's short-lived per-request optimizers)
+        self._breaker_epoch = supervisor.open_epoch if supervisor is not None else 0
+        self._report_cpu = None  # lazy CPU twin of _report (degraded path)
         from cruise_control_tpu.models.state import DEFAULT_BUCKET_POLICY
 
         self.shape_bucket = (
@@ -376,9 +404,40 @@ class GoalOptimizer:
         already exists — including one a foreground request inserted while
         we were building — it is left untouched, because rebinding it to
         this (possibly stale, zero-padded) snapshot could swap statics
-        under a live run.  Does not touch the hit/miss counters."""
+        under a live run.  Does not touch the hit/miss counters.
+
+        Supervised like optimize: with a breaker open nothing is built
+        (pre-warming a wedged device only queues more hangs), and a hang
+        or device failure during the build is bounded + classified instead
+        of wedging the facade's precompute thread forever.  Degradation
+        here has no fallback — a skipped prewarm just means the next
+        bucket overflow pays its compile."""
         if self.parallel_mode != "single":
             return  # parallel engines compile on use; no async warm path
+        sup = self.supervisor
+        if sup is None:
+            self._prewarm_on_device(state, options, config=config)
+            return
+        from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
+
+        self._maybe_purge_after_open()
+        if not sup.available():
+            return
+        try:
+            sup.call(
+                lambda: self._prewarm_on_device(state, options, config=config),
+                op="prewarm",
+            )
+        except DeviceDegradedError:
+            self._maybe_purge_after_open()
+
+    def _prewarm_on_device(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        *,
+        config: OptimizerConfig | None = None,
+    ) -> None:
         cfg = config or self.config
         key = (state.shape, cfg)
         with self._cache_lock:
@@ -415,6 +474,68 @@ class GoalOptimizer:
         )
 
     def optimize(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        *,
+        verbose: bool = False,
+        config: OptimizerConfig | None = None,
+    ) -> OptimizerResult:
+        """Run the goal chain; supervised when a DeviceSupervisor is wired.
+
+        Unsupervised (offline/test default) this IS `_optimize_on_device`.
+        Supervised, the whole device body — input checks, engine build/
+        rebind, compile, anneal, report, extraction — runs inside one
+        bounded, classified supervisor call; a breaker already open skips
+        the device entirely.  Classified failures (hang / compile / OOM /
+        exhausted transient retries) degrade to the CPU greedy path;
+        application errors (bad states, bad option masks) propagate
+        unchanged so a malformed request can neither degrade the service
+        nor get silently served a greedy answer."""
+        cfg = config or self.config
+        sup = self.supervisor
+        if sup is None:
+            return self._optimize_on_device(state, options, verbose=verbose, config=cfg)
+        from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
+
+        self._maybe_purge_after_open()
+        if not sup.available():
+            return self._optimize_degraded(state, options, cfg, reason="breaker-open")
+        try:
+            return sup.call(
+                lambda: self._optimize_on_device(
+                    state, options, verbose=verbose, config=cfg
+                ),
+                op="optimize",
+            )
+        except DeviceDegradedError as e:
+            self._maybe_purge_after_open()
+            return self._optimize_degraded(
+                state, options, cfg,
+                reason=e.failure_class.value, cause=e,
+            )
+
+    def _maybe_purge_after_open(self) -> None:
+        """Drop every cached engine once per breaker-open transition: a
+        device that just wedged/OOMed owns buffers of unknown integrity,
+        and recovery should rebuild engines fresh rather than rebind onto
+        them.  Pinned engines (a hung run still references one from its
+        abandoned thread) are dropped from the cache but left to GC."""
+        sup = self.supervisor
+        if sup is None or sup.open_epoch == self._breaker_epoch:
+            return
+        self._breaker_epoch = sup.open_epoch
+        released = []
+        with self._cache_lock:
+            for cache in (self._engines, self._parallel_engines):
+                released.extend(cache.values())
+                cache.clear()
+        for e in released:
+            if not getattr(e, "_cc_busy", 0):
+                _release_engine(e)
+        self._record(False, count=False)  # refresh the size gauge
+
+    def _optimize_on_device(
         self,
         state: ClusterState,
         options: OptimizationOptions = DEFAULT_OPTIONS,
@@ -527,5 +648,108 @@ class GoalOptimizer:
             objective_before=float(obj_b),
             objective_after=float(obj_a),
             wall_seconds=wall,
+            history=history,
+        )
+
+    # ------------------------------------------------------------------
+    # degraded mode (CPU greedy fallback under an open breaker)
+    # ------------------------------------------------------------------
+
+    def _optimize_degraded(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        cfg: OptimizerConfig,
+        *,
+        reason: str,
+        cause=None,
+    ) -> OptimizerResult:
+        """Serve a proposal set WITHOUT the accelerator: the CPU greedy
+        oracle (analyzer/greedy.py) under a wall-clock budget, with the
+        report programs pinned to the host CPU backend.
+
+        The result is a real OptimizerResult — same extraction semantics,
+        same stats/violations/balancedness surface — tagged with a
+        `degraded` history record so callers (and the /state endpoint) can
+        tell a greedy answer from a TPU answer.  Model arrays are pulled
+        to host first; a model already materialized on a wedged device
+        cannot be rescued here (the monitor rebuilds from host-side
+        samples on the next generation), which is why the facade's model
+        build path keeps host copies of every churn-prone array.
+        """
+        import jax
+
+        from cruise_control_tpu.analyzer.greedy import greedy_optimize
+        from cruise_control_tpu.analyzer.proposals import extract_proposals as _extract
+
+        t0 = time.monotonic()
+        cpu = jax.local_devices(backend="cpu")[0]
+        host_state = jax.tree.map(np.asarray, state)
+        # same input contract as the device path: a rejected state raises
+        # with per-invariant detail instead of being greedily "optimized"
+        validate(host_state)
+        final, info = greedy_optimize(
+            host_state,
+            self.chain,
+            self.constraint,
+            seed=cfg.seed,
+            time_budget_s=self.degraded_budget_s,
+            return_info=True,
+            device=cpu,
+            options=options,  # degraded fixes keep their exclusion contract
+        )
+        final = jax.tree.map(np.asarray, final)
+        if self._report_cpu is None:
+            self._report_cpu = jax.jit(
+                lambda s: (
+                    self.chain.evaluate(s, constraint=self.constraint)[:2],
+                    compute_stats(s),
+                )
+            )
+        with jax.default_device(cpu):
+            (obj_b, viol_b), stats_b = self._report_cpu(host_state)
+            (obj_a, viol_a), stats_a = self._report_cpu(final)
+        t_extract = time.monotonic()
+        proposals = _extract(host_state, final)
+        s = host_state.shape
+        history = [
+            dict(
+                timing=True,
+                degraded=True,
+                reason=reason,
+                failure=(repr(cause) if cause is not None else None),
+                greedy=info,
+                host_extract_s=round(time.monotonic() - t_extract, 6),
+                bucket=dict(R=s.R, B=s.B, P=s.P, T=s.num_topics),
+            )
+        ]
+        if self.sensors is not None:
+            self.sensors.counter("analyzer.degraded-proposals").inc()
+        viol_b = np.asarray(viol_b)
+        viol_a = np.asarray(viol_a)
+        return OptimizerResult(
+            proposals=proposals,
+            state_before=host_state,
+            state_after=final,
+            stats_before=stats_b,
+            stats_after=stats_a,
+            goal_names=self.chain.names(),
+            violations_before=viol_b,
+            violations_after=viol_a,
+            balancedness_before=balancedness_score(
+                viol_b,
+                self.chain,
+                priority_weight=self.balancedness_weights[0],
+                strictness_weight=self.balancedness_weights[1],
+            ),
+            balancedness_after=balancedness_score(
+                viol_a,
+                self.chain,
+                priority_weight=self.balancedness_weights[0],
+                strictness_weight=self.balancedness_weights[1],
+            ),
+            objective_before=float(obj_b),
+            objective_after=float(obj_a),
+            wall_seconds=time.monotonic() - t0,
             history=history,
         )

@@ -1,15 +1,97 @@
-"""Accelerator liveness probe with a hard timeout.
+"""Supervised device runtime: watchdog probe, failure taxonomy, breaker.
 
 The tunneled TPU can wedge (observed: every device op hangs indefinitely,
-MULTICHIP_r05: bare rc=124 driver kill).  Any entry point that is about to
-touch the backend — bench ladder, dryrun_multichip, ad-hoc scripts — runs
-this gate first so a wedged runtime produces a diagnosable error record
-within a bounded budget instead of an opaque process timeout.
+MULTICHIP_r05: bare rc=124 driver kill).  PR 1 added `device_watchdog` so
+OFFLINE entry points (bench ladder, dryrun_multichip) fail diagnosably;
+this module grows it into the supervision layer the SERVICE path runs
+under — a wedged device must degrade the rebalancer, not hang
+`proposals()` and every self-healing action behind it forever (the same
+graceful-degradation stance the online rack-placement literature takes
+toward solver failures, PAPERS.md arXiv:2501.12725 / 2504.00277):
+
+  * `device_op`  — marker/seam every engine dispatch routes through; the
+    deterministic fault harness (testing/faults.py) injects hangs and
+    raised errors here instead of monkeypatching N engine classes.
+  * `classify_failure` — maps an exception from a supervised call onto the
+    failure taxonomy (HANG / COMPILE / OOM / TRANSIENT); application
+    errors (bad request masks, invalid states) classify as None and
+    propagate untouched.
+  * `CircuitBreaker` — CLOSED -> (N classified failures) -> OPEN ->
+    (half-open probe healthy) -> CLOSED.
+  * `DeviceSupervisor` — bounded-budget call (daemon-thread deadline),
+    jittered-backoff retry for transient classes, breaker bookkeeping,
+    half-open probing via the trivial-op watchdog, and the sensor surface
+    (`analyzer.supervisor.*`) the `/state` endpoint snapshots.
+
+`GoalOptimizer` consults the supervisor around every engine invocation and
+falls back to the CPU greedy path while the breaker is open
+(analyzer/optimizer.py); the facade builds one supervisor per service from
+the `tpu.supervisor.*` config keys.
 """
 
 from __future__ import annotations
 
+import enum
+import random
 import threading
+import time
+
+
+def _trivial_device_op() -> None:
+    """The watchdog's probe payload: one tiny reduction through the
+    backend.  A module-level seam (wrapped by `device_op`) so the fault
+    harness can wedge the probe exactly like the engine ops — a hung
+    device hangs EVERY dispatch, including this one."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.arange(8).sum())
+
+
+# ----------------------------------------------------------------------
+# fault-injection seam
+# ----------------------------------------------------------------------
+
+#: (op_name, fn, args, kwargs) -> result.  The default just dispatches;
+#: testing/faults.py swaps it to inject hangs / raised XLA errors / OOMs
+#: keyed by op name and call count.  Kept deliberately tiny: one indirect
+#: call per ENGINE INVOCATION (not per step), unmeasurable next to a run.
+_DEVICE_OP_HOOK = None
+_HOOK_LOCK = threading.Lock()
+
+
+def set_device_op_hook(hook) -> None:
+    """Install (or with None, remove) the device-op interception hook."""
+    global _DEVICE_OP_HOOK
+    with _HOOK_LOCK:
+        _DEVICE_OP_HOOK = hook
+
+
+def device_op(name: str):
+    """Mark a function/method as a device-dispatching entry point.
+
+    Every supervised engine invocation (Engine.run, ShardedEngine.run,
+    GridEngine.run, portfolio_run, the watchdog probe) carries this marker
+    so fault injection targets ops BY NAME, uniformly, without knowing the
+    class layout."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            hook = _DEVICE_OP_HOOK
+            if hook is not None:
+                return hook(name, fn, args, kwargs)
+            return fn(*args, **kwargs)
+
+        wrapper._device_op_name = name
+        return wrapper
+
+    return deco
+
+
+_probe_op = device_op("probe")(_trivial_device_op)
 
 
 def device_watchdog(timeout_s: float = 180.0) -> str | None:
@@ -27,10 +109,7 @@ def device_watchdog(timeout_s: float = 180.0) -> str | None:
 
     def probe():
         try:
-            import jax
-            import jax.numpy as jnp
-
-            jax.block_until_ready(jnp.arange(8).sum())
+            _probe_op()
             result["ok"] = True
         except BaseException as e:  # noqa: BLE001 — diagnosis, not control flow
             result["error"] = f"device probe failed: {e!r}"
@@ -45,3 +124,451 @@ def device_watchdog(timeout_s: float = 180.0) -> str | None:
     return result.get(
         "error", f"device unresponsive: trivial op did not complete in {timeout_s:.0f}s"
     )
+
+
+# ----------------------------------------------------------------------
+# failure taxonomy
+# ----------------------------------------------------------------------
+
+
+class FailureClass(enum.Enum):
+    """How a supervised device call failed; drives retry + breaker policy."""
+
+    HANG = "hang"  # deadline exhausted; the dispatch never returned
+    COMPILE = "compile"  # XLA compilation rejected the program
+    OOM = "oom"  # RESOURCE_EXHAUSTED / out of device memory
+    TRANSIENT = "transient"  # runtime-layer error expected to clear (retried)
+
+
+class DeviceHangError(TimeoutError):
+    """A supervised call did not complete within its budget."""
+
+    def __init__(self, op: str, timeout_s: float):
+        super().__init__(
+            f"device op {op!r} did not complete within {timeout_s:.1f}s"
+        )
+        self.op = op
+        self.timeout_s = timeout_s
+
+
+class DeviceDegradedError(RuntimeError):
+    """A supervised call failed with a CLASSIFIED device failure (after any
+    retries).  Carries the class + original cause so the optimizer can
+    route to the degraded CPU path and report why."""
+
+    def __init__(self, op: str, failure_class: FailureClass, cause: BaseException):
+        super().__init__(f"device op {op!r} failed ({failure_class.value}): {cause!r}")
+        self.op = op
+        self.failure_class = failure_class
+        self.__cause__ = cause
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory", "OOM")
+_COMPILE_MARKERS = ("compilation", "Compilation", "UNIMPLEMENTED", "while compiling")
+_RUNTIME_MARKERS = (
+    "XLA", "xla", "jaxlib", "PJRT", "pjrt", "DEADLINE_EXCEEDED", "INTERNAL",
+    "UNAVAILABLE", "ABORTED", "device",
+)
+
+
+def classify_failure(exc: BaseException) -> FailureClass | None:
+    """Map an exception from a supervised call onto the failure taxonomy.
+
+    None means "not a device failure": application errors (ValueError from
+    input validation, bad request masks) must propagate to the caller
+    untouched — counting them toward the breaker would let a malformed
+    request degrade the service for everyone.
+
+    Classification is structural (type) first, textual (well-known
+    runtime-layer markers) second: jaxlib's XlaRuntimeError is a single
+    type whose status code only appears in the message, and the fault
+    harness injects lookalike RuntimeErrors with the same shape.
+    """
+    if isinstance(exc, DeviceHangError):
+        return FailureClass.HANG
+    if isinstance(exc, MemoryError):
+        return FailureClass.OOM
+    name = type(exc).__name__
+    msg = str(exc)
+    runtime_typed = "XlaRuntimeError" in name or "JaxRuntimeError" in name
+    if not runtime_typed and not isinstance(exc, RuntimeError):
+        return None
+    if any(m in msg for m in _OOM_MARKERS):
+        return FailureClass.OOM
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return FailureClass.COMPILE
+    if runtime_typed or any(m in msg for m in _RUNTIME_MARKERS):
+        return FailureClass.TRANSIENT
+    # a plain RuntimeError with no runtime-layer markers: application code
+    return None
+
+
+def jittered_backoff_s(
+    attempt: int,
+    *,
+    base_s: float,
+    cap_s: float,
+    rng: random.Random | None = None,
+) -> float:
+    """Full-jitter exponential backoff: uniform in (0, min(cap, base*2^n)].
+
+    Shared by the supervisor's transient retries and the Kafka transport's
+    reroute/reconnect retries; `rng` is injectable so tests pin the draw.
+    """
+    if attempt < 1:
+        attempt = 1
+    ceiling = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    draw = (rng or random).random()
+    # never 0: a zero sleep turns "backoff" into a hot retry loop
+    return ceiling * max(draw, 0.05)
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+# ----------------------------------------------------------------------
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Failure-count breaker with timed half-open probing.
+
+    CLOSED counts consecutive operation-level failures; at
+    `failure_threshold` it OPENs.  While OPEN, `probe_due()` turns true
+    every `probe_interval_s`; the owner runs its health probe between
+    `begin_probe()` and `probe_succeeded()`/`probe_failed()` (HALF_OPEN in
+    between, so /state can show a probe in flight).  All transitions are
+    lock-serialized — request threads and the precompute thread share one
+    breaker."""
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 3,
+        probe_interval_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got {failure_threshold}")
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.failure_threshold = failure_threshold
+        self.probe_interval_s = probe_interval_s
+        self._state = BreakerState.CLOSED
+        self._consecutive = 0
+        self._next_probe_at = 0.0
+        self._opened_at: float | None = None
+        #: transitions into OPEN so far — consumers detect "opened again"
+        #: by epoch comparison instead of registering callbacks
+        self.open_epoch = 0
+
+    @property
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    def record_failure(self) -> bool:
+        """Count one operation-level classified failure; True exactly when
+        this failure transitions the breaker to OPEN."""
+        with self._lock:
+            self._consecutive += 1
+            if self._state is BreakerState.CLOSED and (
+                self._consecutive >= self.failure_threshold
+            ):
+                self._open_locked()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state is BreakerState.CLOSED:
+                self._consecutive = 0
+
+    def _open_locked(self) -> None:
+        self._state = BreakerState.OPEN
+        self.open_epoch += 1
+        self._opened_at = self._clock()
+        self._next_probe_at = self._opened_at + self.probe_interval_s
+
+    def probe_due(self) -> bool:
+        with self._lock:
+            return (
+                self._state is BreakerState.OPEN
+                and self._clock() >= self._next_probe_at
+            )
+
+    def begin_probe(self) -> bool:
+        """OPEN + due -> HALF_OPEN; False when another thread won the race
+        (it is running the probe — this caller just sees OPEN)."""
+        with self._lock:
+            if self._state is not BreakerState.OPEN:
+                return False
+            if self._clock() < self._next_probe_at:
+                return False
+            self._state = BreakerState.HALF_OPEN
+            return True
+
+    def probe_succeeded(self) -> None:
+        with self._lock:
+            self._state = BreakerState.CLOSED
+            self._consecutive = 0
+            self._opened_at = None
+
+    def probe_failed(self) -> None:
+        with self._lock:
+            if self._state is BreakerState.HALF_OPEN:
+                self._state = BreakerState.OPEN
+            self._next_probe_at = self._clock() + self.probe_interval_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state.value,
+                "consecutiveFailures": self._consecutive,
+                "failureThreshold": self.failure_threshold,
+                "openEpoch": self.open_epoch,
+                "openForSeconds": (
+                    round(self._clock() - self._opened_at, 1)
+                    if self._opened_at is not None
+                    else None
+                ),
+            }
+
+
+# ----------------------------------------------------------------------
+# supervisor
+# ----------------------------------------------------------------------
+
+
+class DeviceSupervisor:
+    """Bounded, classified, breaker-guarded execution of device ops.
+
+    One instance per service (the facade builds it from `tpu.supervisor.*`
+    keys) shared by every optimizer the facade creates, so ad-hoc
+    per-request optimizers and the precompute thread all feed the same
+    breaker.  Thread-safe throughout.
+    """
+
+    def __init__(
+        self,
+        *,
+        op_timeout_s: float = 300.0,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.25,
+        retry_backoff_cap_s: float = 5.0,
+        breaker_failure_threshold: int = 3,
+        probe_interval_s: float = 30.0,
+        probe_timeout_s: float = 20.0,
+        sensors=None,
+        probe=None,
+        rng: random.Random | None = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        """probe: () -> str | None (None = healthy) — defaults to the
+        trivial-op watchdog under `probe_timeout_s`; injectable for tests.
+        rng feeds the retry jitter; clock/sleep are injectable so breaker
+        timing tests run without wall-clock waits."""
+        if op_timeout_s <= 0:
+            raise ValueError(f"op_timeout_s must be > 0, got {op_timeout_s}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.op_timeout_s = op_timeout_s
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failure_threshold,
+            probe_interval_s=probe_interval_s,
+            clock=clock,
+        )
+        self.probe_timeout_s = probe_timeout_s
+        self._probe = probe or (lambda: device_watchdog(self.probe_timeout_s))
+        self._probe_lock = threading.Lock()
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self.sensors = sensors
+        self._failure_counts: dict[FailureClass, int] = {c: 0 for c in FailureClass}
+        self.last_failure: dict | None = None
+        self.num_retries = 0
+        self.num_probes = 0
+        self.num_probe_failures = 0
+        if sensors is not None:
+            # 0 closed / 0.5 probing / 1 open — scrapeable from /state
+            sensors.gauge(
+                "analyzer.supervisor.breaker-state",
+                lambda: {"closed": 0.0, "half_open": 0.5, "open": 1.0}[
+                    self.breaker.state.value
+                ],
+            )
+
+    # -- classification-side bookkeeping --------------------------------
+
+    def _count(self, cls: FailureClass, op: str, exc: BaseException) -> None:
+        with self._lock:
+            self._failure_counts[cls] += 1
+            self.last_failure = {
+                "op": op,
+                "class": cls.value,
+                "error": repr(exc),
+                "ms": int(time.time() * 1000),
+            }
+        if self.sensors is not None:
+            self.sensors.counter(f"analyzer.supervisor.failures.{cls.value}").inc()
+
+    # -- bounded call ---------------------------------------------------
+
+    def _bounded(self, fn, op: str, timeout_s: float):
+        """Run fn on a daemon thread with a hard deadline.
+
+        The deadline fires DeviceHangError on the caller; the worker (and
+        whatever device dispatch it is stuck in) is abandoned — a wedged
+        runtime cannot be interrupted, only outlived.  Any engine it holds
+        pinned stays exempt from hard buffer release (optimizer pin
+        semantics), so an eventual late completion cannot touch freed
+        memory."""
+        done = threading.Event()
+        box: dict = {}
+
+        def worker():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=worker, daemon=True, name=f"supervised-{op}"
+        )
+        t.start()
+        if not done.wait(timeout_s):
+            raise DeviceHangError(op, timeout_s)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def call(self, fn, *, op: str = "optimize", timeout_s: float | None = None):
+        """Run fn under the supervision contract.
+
+        Success resets the breaker's consecutive count.  Classified
+        failures: TRANSIENT retries up to `max_retries` with full-jitter
+        backoff; exhausted/unretryable failures count one operation-level
+        failure toward the breaker and raise DeviceDegradedError.
+        Unclassified exceptions propagate unchanged and touch nothing.
+        """
+        budget = timeout_s if timeout_s is not None else self.op_timeout_s
+        attempt = 0
+        while True:
+            try:
+                result = self._bounded(fn, op, budget)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                cls = classify_failure(e)
+                if cls is None:
+                    raise
+                self._count(cls, op, e)
+                if cls is FailureClass.TRANSIENT and attempt < self.max_retries:
+                    attempt += 1
+                    with self._lock:
+                        self.num_retries += 1
+                    if self.sensors is not None:
+                        self.sensors.counter("analyzer.supervisor.retries").inc()
+                    self._sleep(
+                        jittered_backoff_s(
+                            attempt,
+                            base_s=self.retry_backoff_s,
+                            cap_s=self.retry_backoff_cap_s,
+                            rng=self._rng,
+                        )
+                    )
+                    continue
+                if self.breaker.record_failure() and self.sensors is not None:
+                    self.sensors.counter("analyzer.supervisor.breaker-opened").inc()
+                raise DeviceDegradedError(op, cls, e) from e
+            self.breaker.record_success()
+            return result
+
+    # -- availability / half-open probing -------------------------------
+
+    @property
+    def is_degraded(self) -> bool:
+        return self.breaker.state is not BreakerState.CLOSED
+
+    def available(self) -> bool:
+        """True when the device path should be attempted.
+
+        While the breaker is OPEN this is where recovery happens: once per
+        `probe_interval_s` ONE caller runs the trivial-op watchdog
+        (HALF_OPEN during the probe); a healthy probe closes the breaker
+        and the call proceeds on the device, a failed one re-arms the
+        probe timer and the caller stays degraded.  Lazy probing keeps the
+        supervisor threadless — the service's own traffic (requests + the
+        precompute loop) provides the cadence."""
+        if self.breaker.state is BreakerState.CLOSED:
+            return True
+        if not self._probe_lock.acquire(blocking=False):
+            return False  # another thread is probing right now
+        try:
+            if not self.breaker.begin_probe():
+                return False
+            with self._lock:
+                self.num_probes += 1
+            if self.sensors is not None:
+                self.sensors.counter("analyzer.supervisor.probes").inc()
+            try:
+                diagnosis = self._probe()
+            except BaseException as e:  # noqa: BLE001 — a raising probe is a failed probe
+                diagnosis = repr(e)
+            if diagnosis is None:
+                self.breaker.probe_succeeded()
+                if self.sensors is not None:
+                    self.sensors.counter("analyzer.supervisor.probe-successes").inc()
+                return True
+            self.breaker.probe_failed()
+            with self._lock:
+                self.num_probe_failures += 1
+                self.last_failure = {
+                    "op": "probe",
+                    "class": FailureClass.HANG.value,
+                    "error": diagnosis,
+                    "ms": int(time.time() * 1000),
+                }
+            if self.sensors is not None:
+                self.sensors.counter("analyzer.supervisor.probe-failures").inc()
+            return False
+        finally:
+            self._probe_lock.release()
+
+    @property
+    def open_epoch(self) -> int:
+        return self.breaker.open_epoch
+
+    def state_json(self) -> dict:
+        """The /state `AnalyzerState.supervisor` block."""
+        with self._lock:
+            counts = {c.value: n for c, n in self._failure_counts.items()}
+            last = dict(self.last_failure) if self.last_failure else None
+            retries, probes, probe_failures = (
+                self.num_retries, self.num_probes, self.num_probe_failures,
+            )
+        out = self.breaker.snapshot()
+        out["breaker"] = out.pop("state")
+        out.update(
+            opTimeoutSeconds=self.op_timeout_s,
+            failureCounts=counts,
+            lastFailure=last,
+            numRetries=retries,
+            numProbes=probes,
+            numProbeFailures=probe_failures,
+        )
+        return out

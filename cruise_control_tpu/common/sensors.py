@@ -14,6 +14,12 @@ Headline sensors (same semantics as the reference catalog):
     (Executor.java:118-125,257)
   * anomaly-detector per-type rates + mean-time-between-anomalies
     (detector/AnomalyMetrics.java:1, MeanTimeBetweenAnomaliesMs.java:1)
+  * analyzer.supervisor.* — supervised optimizer runtime: breaker-state
+    gauge (0 closed / 0.5 half-open / 1 open), per-class device failure
+    counters (hang/compile/oom/transient), retry + probe counters; plus
+    analyzer.degraded-proposals for CPU-greedy-served results (no
+    reference analog — the reference has no accelerator to lose; see
+    docs/sensors.md "Ops note: degraded-mode gauges")
 """
 
 from __future__ import annotations

@@ -139,6 +139,42 @@ def _analyzer_defs() -> ConfigDef:
              "~/.cache/cruise_control_tpu/xla", I.LOW,
              "persistent XLA compilation cache directory; empty disables "
              "(compiled programs survive service restarts)", group=g)
+    # --- supervised optimizer runtime (common/device_watchdog.py) ---
+    g = "analyzer.tpu.supervisor"
+    d.define("tpu.supervisor.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "run every service-path engine invocation under the device "
+             "supervisor: bounded budget, failure classification "
+             "(hang/compile/OOM/transient), retry, circuit breaker with "
+             "CPU-greedy degraded mode while the breaker is open", group=g)
+    d.define("tpu.supervisor.op.timeout.s", T.DOUBLE, 300.0, I.MEDIUM,
+             "hard wall-clock budget per supervised engine invocation; a "
+             "call not finished by then is classified as a device HANG "
+             "(observed MULTICHIP_r05: a wedged runtime hangs every op)",
+             in_range(lo=0.001), group=g)
+    d.define("tpu.supervisor.max.retries", T.INT, 2, I.LOW,
+             "retries (with jittered backoff) for TRANSIENT-classified "
+             "failures before one operation-level failure is counted "
+             "toward the breaker", in_range(lo=0), group=g)
+    d.define("tpu.supervisor.retry.backoff.ms", T.LONG, 250, I.LOW,
+             "base of the full-jitter exponential retry backoff",
+             in_range(lo=1), group=g)
+    d.define("tpu.supervisor.retry.backoff.max.ms", T.LONG, 5_000, I.LOW,
+             "cap of the retry backoff", in_range(lo=1), group=g)
+    d.define("tpu.supervisor.breaker.failure.threshold", T.INT, 3, I.MEDIUM,
+             "consecutive classified operation failures that open the "
+             "circuit breaker (degraded CPU-greedy serving starts)",
+             in_range(lo=1), group=g)
+    d.define("tpu.supervisor.probe.interval.s", T.DOUBLE, 30.0, I.MEDIUM,
+             "while the breaker is open, one half-open recovery probe "
+             "(the trivial-op watchdog) runs at most this often",
+             in_range(lo=0.0), group=g)
+    d.define("tpu.supervisor.probe.timeout.s", T.DOUBLE, 20.0, I.LOW,
+             "budget for the half-open recovery probe",
+             in_range(lo=0.001), group=g)
+    d.define("tpu.supervisor.degraded.greedy.budget.s", T.DOUBLE, 30.0, I.MEDIUM,
+             "wall-clock budget for the CPU greedy fallback that serves "
+             "proposals while the breaker is open", in_range(lo=0.001),
+             group=g)
     return d
 
 
@@ -624,6 +660,28 @@ class CruiseControlConfig(AbstractConfig):
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")
+
+    def device_supervisor(self, *, sensors=None, probe=None):
+        """DeviceSupervisor from the tpu.supervisor.* keys; None when
+        supervision is disabled (offline tools, parity benchmarks)."""
+        if not self.get("tpu.supervisor.enabled"):
+            return None
+        from cruise_control_tpu.common.device_watchdog import DeviceSupervisor
+
+        return DeviceSupervisor(
+            op_timeout_s=self.get("tpu.supervisor.op.timeout.s"),
+            max_retries=self.get("tpu.supervisor.max.retries"),
+            retry_backoff_s=self.get("tpu.supervisor.retry.backoff.ms") / 1000.0,
+            retry_backoff_cap_s=self.get("tpu.supervisor.retry.backoff.max.ms")
+            / 1000.0,
+            breaker_failure_threshold=self.get(
+                "tpu.supervisor.breaker.failure.threshold"
+            ),
+            probe_interval_s=self.get("tpu.supervisor.probe.interval.s"),
+            probe_timeout_s=self.get("tpu.supervisor.probe.timeout.s"),
+            sensors=sensors,
+            probe=probe,
+        )
 
     def shape_bucket_policy(self):
         from cruise_control_tpu.models.state import ShapeBucketPolicy

@@ -23,6 +23,10 @@ class AnomalyType(enum.Enum):
     METRIC_ANOMALY = 2
     GOAL_VIOLATION = 3
     TOPIC_ANOMALY = 4
+    #: the optimizer's device supervisor opened its circuit breaker —
+    #: proposals are being served by the CPU greedy fallback (no reference
+    #: analog: the reference has no accelerator to lose)
+    OPTIMIZER_DEGRADED = 5
 
     @property
     def priority(self) -> int:
@@ -111,6 +115,28 @@ class TopicReplicationFactorAnomaly(Anomaly):
 
     def description(self) -> str:
         return f"TopicReplicationFactorAnomaly({self.bad_topics} -> rf={self.target_rf})"
+
+
+@dataclasses.dataclass
+class OptimizerDegraded(Anomaly):
+    """The device supervisor's circuit breaker opened: the optimizer is
+    serving CPU-greedy proposals (common/device_watchdog.DeviceSupervisor).
+
+    Not self-healable by this detector — recovery is the supervisor's
+    half-open probe closing the breaker — so fixable=False: the notifier
+    alerts operators and the anomaly is recorded, nothing is 'fixed'."""
+
+    anomaly_type: AnomalyType = AnomalyType.OPTIMIZER_DEGRADED
+    failure_class: str = "unknown"  # hang / compile / oom / transient
+    last_error: str = ""
+    open_epoch: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"OptimizerDegraded(class={self.failure_class}, "
+            f"epoch={self.open_epoch}, last_error={self.last_error!r})"
+        )
 
 
 @dataclasses.dataclass

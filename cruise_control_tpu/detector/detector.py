@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import logging
 import threading
 import time
 from collections import deque
@@ -32,6 +33,8 @@ from cruise_control_tpu.detector.anomalies import (
     TopicReplicationFactorAnomaly,
 )
 from cruise_control_tpu.detector.notifier import Action, AnomalyNotifier
+
+log = logging.getLogger(__name__)
 
 
 class SelfHealingActions(Protocol):
@@ -296,8 +299,17 @@ class AnomalyDetector:
         tick = min([interval_s] + [i for _, i, _ in self._detectors])
 
         def loop():
+            # individual detector exceptions are already contained inside
+            # run_once; this catch covers the HANDLING side (notifier, fix
+            # dispatch, state recording) — an exception escaping there used
+            # to kill the thread silently and end anomaly detection for
+            # the life of the process
             while not self._stop.wait(tick):
-                self.run_once(respect_intervals=True)
+                try:
+                    self.run_once(respect_intervals=True)
+                except Exception:  # noqa: BLE001 — the loop must keep ticking
+                    self.sensors.counter("detector.loop-failures").inc()
+                    log.warning("anomaly detection round failed", exc_info=True)
 
         self._thread = threading.Thread(target=loop, daemon=True, name="anomaly-detector")
         self._thread.start()
@@ -308,4 +320,10 @@ class AnomalyDetector:
             self._thread.join(timeout=5)
 
     def detector_state(self) -> dict:
-        return self.state.to_json(self.notifier)
+        out = self.state.to_json(self.notifier)
+        # why the last self-healing fix did not start, when the actions
+        # implementation tracks it (service/facade.SelfHealingAdapter)
+        info = getattr(self.actions, "fix_failure_info", None)
+        if info:
+            out["lastSelfHealingFixFailure"] = dict(info)
+        return out

@@ -16,7 +16,12 @@ import enum
 import time
 from typing import Callable, Protocol
 
-from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType, BrokerFailures
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    OptimizerDegraded,
+)
 
 
 class Action(enum.Enum):
@@ -92,6 +97,12 @@ class SelfHealingNotifier:
     def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
         if isinstance(anomaly, BrokerFailures):
             return self._on_broker_failure(anomaly)
+        if isinstance(anomaly, OptimizerDegraded):
+            # nothing to fix (the supervisor's half-open probe is the
+            # recovery path) but operators must hear about degraded
+            # serving immediately — alert, then ignore
+            self._send_alert(anomaly, False)
+            return AnomalyNotificationResult.ignore()
         if not self._enabled.get(anomaly.anomaly_type, False) or not anomaly.fixable:
             return AnomalyNotificationResult.ignore()
         self._send_alert(anomaly, True)

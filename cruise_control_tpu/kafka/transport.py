@@ -18,8 +18,11 @@ KafkaAdminClient connection pool.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 
+from cruise_control_tpu.common.device_watchdog import jittered_backoff_s
 from cruise_control_tpu.kafka import protocol as proto
 from cruise_control_tpu.kafka.client import KafkaAdminClient, KafkaProtocolError, NONE
 from cruise_control_tpu.kafka.records import decode_batches, encode_batch
@@ -63,18 +66,29 @@ class KafkaMetricsTransport:
         acks: int = 1,
         flush_every: int = 1000,
         now_ms=None,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_cap_s: float = 0.5,
+        rng: random.Random | None = None,
+        sleep=time.sleep,
     ):
+        """retry_backoff_s/cap: full-jitter backoff base/cap applied before
+        the NOT_LEADER reroute retry and the transient-connection retry —
+        a metadata-lagging or restarting broker answered the instant retry
+        with the same error.  rng/sleep injectable for deterministic tests."""
         self.client = client
         self.topic = topic
         self.acks = acks
         self.flush_every = flush_every
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self._rng = rng or random.Random()
+        self._sleep = sleep
         self._router = _TopicRouter(client, topic)
         self._buffer: list[bytes] = []
         self._rr = 0  # round-robin partition cursor
         self._lock = threading.Lock()
-        import time as _time
 
-        self._now = now_ms or (lambda: int(_time.time() * 1000))
+        self._now = now_ms or (lambda: int(time.time() * 1000))
 
     def send(self, payload: bytes) -> None:
         with self._lock:
@@ -111,9 +125,19 @@ class KafkaMetricsTransport:
                 self._buffer[:0] = records  # restore, preserving order
             raise
 
+    def _backoff(self, attempt: int = 1) -> None:
+        self._sleep(
+            jittered_backoff_s(
+                attempt,
+                base_s=self.retry_backoff_s,
+                cap_s=self.retry_backoff_cap_s,
+                rng=self._rng,
+            )
+        )
+
     def _produce(self, partition: int, node: int, batch: bytes, *,
                  retry_route: bool) -> None:
-        resp = self.client.broker_request(node, proto.PRODUCE, {
+        request = {
             "transactional_id": None,
             "acks": self.acks,
             "timeout_ms": 30_000,
@@ -121,7 +145,19 @@ class KafkaMetricsTransport:
                 "name": self.topic,
                 "partition_data": [{"index": partition, "records": batch}],
             }],
-        })
+        }
+        try:
+            resp = self.client.broker_request(node, proto.PRODUCE, request)
+        except (ConnectionError, TimeoutError, OSError):
+            # transient transport error (broker restarting, socket dropped):
+            # retry ONCE after a short jittered pause, against fresh routing
+            # — the leader may have moved with the restart.  A second
+            # failure surfaces to flush(), which restores the buffer.
+            if not retry_route:
+                raise
+            self._backoff()
+            node = self._router.refresh().get(partition, node)
+            resp = self.client.broker_request(node, proto.PRODUCE, request)
         for t in resp["responses"] or []:
             for p in t["partition_responses"] or []:
                 if p["error_code"] == NONE:
@@ -129,7 +165,10 @@ class KafkaMetricsTransport:
                 if p["error_code"] == 6 and retry_route:
                     # NOT_LEADER_OR_FOLLOWER: re-route ONCE, then surface
                     # whatever the retry returns (a silently-dropped batch is
-                    # silent metric loss)
+                    # silent metric loss).  Backoff first — the cluster is
+                    # mid-election and instant metadata often still names
+                    # the old leader.
+                    self._backoff()
                     new_leader = self._router.refresh().get(partition)
                     if new_leader is None:
                         raise KafkaProtocolError(

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cruise_control_tpu.analyzer.engine import OptimizerConfig
+from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.analyzer.objective import GoalChain
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
@@ -142,6 +143,7 @@ class GridEngine(ShardedEngine):
 
     # ---- host-side driver ----
 
+    @device_op("grid.run")
     def run(self, *, verbose: bool = False):
         self.last_info = None  # never report a previous run's diagnostics
         cfg = self.engine.config

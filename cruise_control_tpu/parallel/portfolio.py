@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cruise_control_tpu.analyzer.engine import Engine, EngineCarry
+from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.models.state import ClusterState
 
 RESTART_AXIS = "restart"
@@ -37,6 +38,7 @@ def default_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (RESTART_AXIS,))
 
 
+@device_op("portfolio.run")
 def portfolio_run(
     engine: Engine,
     mesh: Mesh,

@@ -63,6 +63,7 @@ from cruise_control_tpu.analyzer.engine import (
 )
 from cruise_control_tpu.analyzer.objective import GoalChain
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.common.resources import NUM_RESOURCES
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.aggregates import compute_aggregates
@@ -551,6 +552,7 @@ class ShardedEngine:
             temps[rnd] = t0_obj * (cfg.temperature_decay**rnd)
         return temps
 
+    @device_op("sharded.run")
     def run(self, *, verbose: bool = False):
         """Execute the annealing schedule over the sharded model.
 

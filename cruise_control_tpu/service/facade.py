@@ -15,6 +15,7 @@ fix == RebalanceRunnable self-healing constructor).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 
@@ -53,6 +54,8 @@ from cruise_control_tpu.service.progress import (
     OperationProgress,
     WaitingForClusterModel,
 )
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -97,6 +100,11 @@ class CruiseControl:
         #: shape-bucketing policy the monitor builds models under; the
         #: precompute loop pre-warms the NEXT bucket through it
         self.bucket_policy = config.shape_bucket_policy()
+        #: ONE supervisor for every optimizer this facade builds (default +
+        #: ad-hoc per-request ones + the precompute thread): they all feed
+        #: the same circuit breaker, so a wedged device degrades the whole
+        #: analyzer surface coherently instead of per-optimizer
+        self.supervisor = config.device_supervisor(sensors=self.sensors)
         self.optimizer = GoalOptimizer(
             chain=self.chain,
             constraint=self.constraint,
@@ -106,6 +114,8 @@ class CruiseControl:
             engine_cache_size=config.get("tpu.engine.cache.size"),
             sensors=self.sensors,
             shape_bucket=self.bucket_policy,
+            supervisor=self.supervisor,
+            degraded_budget_s=config.get("tpu.supervisor.degraded.greedy.budget.s"),
         )
         from cruise_control_tpu.executor.strategy import resolve_strategy_chain
 
@@ -173,9 +183,35 @@ class CruiseControl:
         #: LoadMonitorTaskRunner attached by build_service (bootstrap/train)
         self.task_runner = None
 
+    def _detect_optimizer_degraded(self):
+        """OPTIMIZER_DEGRADED anomaly, once per breaker-open episode.
+
+        Edge-triggered on the supervisor's open epoch: the breaker staying
+        open across detection rounds is ONE degradation event, not a new
+        anomaly per round (the /state supervisor block carries the live
+        state); a close-then-reopen bumps the epoch and reports again."""
+        sup = self.supervisor
+        if sup is None or not sup.is_degraded:
+            return None
+        epoch = sup.open_epoch
+        if epoch == self._degraded_reported_epoch:
+            return None
+        self._degraded_reported_epoch = epoch
+        from cruise_control_tpu.detector.anomalies import OptimizerDegraded
+
+        last = sup.last_failure or {}
+        return OptimizerDegraded(
+            failure_class=last.get("class", "unknown"),
+            last_error=str(last.get("error", "")),
+            open_epoch=epoch,
+        )
+
     def _wire_detectors(self):
         """Reference AnomalyDetector.java:63-68 wiring."""
         from cruise_control_tpu.detector.detectors import SlowBrokerFinder
+
+        #: last breaker-open epoch reported as an anomaly (edge trigger)
+        self._degraded_reported_epoch = 0
 
         req = ModelCompletenessRequirements(min_required_num_windows=1)
         # the violation detector watches its own (usually smaller) goal list
@@ -332,6 +368,8 @@ class CruiseControl:
             )
             reg(psf.detect, interval_s=_interval("topic.anomaly.detection.interval.ms"))
         reg(slow_detect, interval_s=_interval("metric.anomaly.detection.interval.ms"))
+        # supervisor breaker watch: every round (cheap property reads)
+        reg(self._detect_optimizer_degraded)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp():162)
@@ -361,6 +399,8 @@ class CruiseControl:
         request pays cache-hit latency instead of the cold trace+compile+
         optimize warmup."""
         allow_est = self.config.get("allow.capacity.estimation.on.proposal.precompute")
+        streak_gauge = self.sensors.gauge("analyzer.precompute-consecutive-failures")
+        consecutive = 0
         while True:
             try:
                 self.proposals(
@@ -368,12 +408,27 @@ class CruiseControl:
                     ignore_cache=True,
                     allow_capacity_estimation=allow_est,
                 )
-            except Exception:  # noqa: BLE001 — precompute failures surface on demand
-                pass
+                consecutive = 0
+                streak_gauge.set(0)
+            except Exception:  # noqa: BLE001 — the loop must keep ticking,
+                # but a permanently broken precompute must be VISIBLE:
+                # every failure counts, and three in a row start WARN
+                # logging (one line per cycle, cycles are minutes apart).
+                # Gauge before counter: a reader observing the counter must
+                # never see a stale (smaller) streak.
+                consecutive += 1
+                streak_gauge.set(consecutive)
+                self.sensors.counter("analyzer.precompute-failures").inc()
+                if consecutive >= 3:
+                    log.warning(
+                        "proposal precompute failed %d times in a row",
+                        consecutive,
+                        exc_info=True,
+                    )
             try:
                 self._prewarm_next_bucket()
             except Exception:  # noqa: BLE001 — prewarm is best-effort
-                pass
+                self.sensors.counter("analyzer.prewarm-failures").inc()
             if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
                 return
 
@@ -445,6 +500,10 @@ class CruiseControl:
             engine_cache_size=self.config.get("tpu.engine.cache.size"),
             sensors=self.sensors,
             shape_bucket=self.bucket_policy,
+            supervisor=self.supervisor,
+            degraded_budget_s=self.config.get(
+                "tpu.supervisor.degraded.greedy.budget.s"
+            ),
         )
 
     def proposals(
@@ -931,7 +990,14 @@ class CruiseControl:
                 "isProposalReady": cache is not None,
                 "readyGoals": self.chain.names() if cache is not None else [],
                 "goalReadiness": self.chain.names(),
+                # degraded-serving surface (supervised optimizer runtime):
+                # degraded=true means proposals are currently CPU-greedy
+                # because the device breaker is not closed
+                "degraded": self.supervisor is not None
+                and self.supervisor.is_degraded,
             }
+            if self.supervisor is not None:
+                out["AnalyzerState"]["supervisor"] = self.supervisor.state_json()
         if "anomaly_detector" in substates:
             out["AnomalyDetectorState"] = self.anomaly_detector.detector_state()
         return out
@@ -944,14 +1010,34 @@ class SelfHealingAdapter:
 
     def __init__(self, cc: CruiseControl):
         self.cc = cc
+        #: last non-busy fix failure: surfaced by detector_state() so an
+        #: operator reading /state sees WHY self-healing is not healing
+        self.last_fix_failure: dict | None = None
 
-    def _guarded(self, fn) -> bool:
+    @property
+    def fix_failure_info(self) -> dict | None:
+        return self.last_fix_failure
+
+    def _guarded(self, fn, *, op: str) -> bool:
+        """Run one self-healing fix; False means it did not start.
+
+        Busy executor is the EXPECTED no (the detector re-checks later)
+        and stays silent.  Everything else used to be swallowed
+        indistinguishably — now it is logged with the traceback, counted
+        (`self-healing.fix-failed`), and kept as last-failure info."""
         try:
             fn()
             return True
         except OngoingExecutionError:
             return False
-        except Exception:  # noqa: BLE001 — fix failure is reported, not fatal
+        except Exception as e:  # noqa: BLE001 — fix failure is reported, not fatal
+            self.cc.sensors.counter("self-healing.fix-failed").inc()
+            self.last_fix_failure = {
+                "operation": op,
+                "error": repr(e),
+                "ms": int(time.time() * 1000),
+            }
+            log.warning("self-healing fix %s failed to start", op, exc_info=True)
             return False
 
     def _healing_kwargs(self) -> dict:
@@ -979,7 +1065,8 @@ class SelfHealingAdapter:
         return self._guarded(
             lambda: self.cc.rebalance(
                 OperationProgress(), dryrun=False, **self._healing_kwargs()
-            )
+            ),
+            op="rebalance",
         )
 
     def remove_brokers(self, broker_ids, reason: str) -> bool:
@@ -1000,24 +1087,28 @@ class SelfHealingAdapter:
         ):
             return False
         return self._guarded(
-            lambda: self.cc.remove_brokers(OperationProgress(), ids, dryrun=False)
+            lambda: self.cc.remove_brokers(OperationProgress(), ids, dryrun=False),
+            op="remove_brokers",
         )
 
     def demote_brokers(self, broker_ids, reason: str) -> bool:
         return self._guarded(
-            lambda: self.cc.demote_brokers(OperationProgress(), list(broker_ids), dryrun=False)
+            lambda: self.cc.demote_brokers(OperationProgress(), list(broker_ids), dryrun=False),
+            op="demote_brokers",
         )
 
     def fix_offline_replicas(self, reason: str) -> bool:
         return self._guarded(
-            lambda: self.cc.fix_offline_replicas(OperationProgress(), dryrun=False)
+            lambda: self.cc.fix_offline_replicas(OperationProgress(), dryrun=False),
+            op="fix_offline_replicas",
         )
 
     def fix_topic_replication_factor(self, topics, target_rf: int, reason: str) -> bool:
         return self._guarded(
             lambda: self.cc.update_topic_replication_factor(
                 OperationProgress(), {t: target_rf for t in topics}, dryrun=False
-            )
+            ),
+            op="fix_topic_replication_factor",
         )
 
     @property

@@ -60,6 +60,9 @@ OPTIMIZATION_RESULT = Schema((
     Field("objectiveAfter", NUM),
     Field("violatedGoalsAfter", LIST),
     Field("wallSeconds", NUM),
+    # true when the supervisor breaker routed this answer through the CPU
+    # greedy fallback (docs/architecture.md "Degraded mode")
+    Field("degraded", BOOL),
     # per-phase execution ETA derived from data-to-move over the active
     # caps/throttle (facade._execution_eta); absent on demote (leader-only)
     Field("estimatedExecutionTime", DICT, required=False),

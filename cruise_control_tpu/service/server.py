@@ -402,6 +402,20 @@ class CruiseControlApp:
             return 500, {"errorMessage": str(e), "_userTaskId": task.task_id}
 
     def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
+        def wrapped(progress, _op=fn):
+            out = _op(progress)
+            # degraded serving must be visible in the ops audit trail, not
+            # only in the payload: the analyzer's device breaker is open
+            # and this answer came from the CPU greedy fallback
+            if isinstance(out, dict) and out.get("degraded"):
+                OPERATION_LOGGER.warning(
+                    "%s served DEGRADED (CPU greedy fallback; "
+                    "see /state AnalyzerState.supervisor)",
+                    endpoint,
+                )
+            return out
+
+        fn = wrapped
         key = getattr(self._local, "session_key", None)
         client = getattr(self._local, "client", "") or ""
         if key is None:

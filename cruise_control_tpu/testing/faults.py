@@ -1,0 +1,331 @@
+"""Deterministic fault injection for the supervised optimizer runtime.
+
+Every breaker transition, retry schedule, and degraded-mode proposal must
+be pinned by tests rather than by hoping the TPU misbehaves on cue.  This
+module injects the failures the supervisor classifies — engine hangs,
+raised XLA-shaped errors, OOMs — plus Kafka transport and admin faults,
+all keyed by CALL COUNT (or a seeded pseudo-random rate), so a test can
+say "the second engine invocation OOMs" and mean exactly that.
+
+Two injection surfaces:
+
+  * device ops — everything marked `@device_op` (Engine.run,
+    ShardedEngine.run, GridEngine.run, portfolio_run, and the watchdog's
+    trivial-op probe) routes through ONE process-wide hook
+    (common/device_watchdog.set_device_op_hook).  `device_fault` installs
+    an interceptor on that seam; `device_wedged` is the composite that
+    models the observed failure (MULTICHIP_r05): EVERY device op —
+    including the recovery probe — blocks until the context exits.
+  * arbitrary methods — `method_fault` (with the `slow` / `hanging` /
+    `raising` / `dropping` effects) patches a bound method on any object
+    or class: the simulated ClusterAdmin, the Kafka wire client, a
+    notifier.
+
+All context managers yield an `InjectionLog` (total calls seen, faults
+fired) so tests assert the fault actually hit.  Hooks nest: an inner
+injector delegates non-matching calls to whatever was installed before
+it.  Everything is restored on exit, and hang injectors release their
+blocked threads so abandoned supervisor workers finish instead of leaking
+into the next test.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+
+from cruise_control_tpu.common import device_watchdog as _watchdog_mod
+from cruise_control_tpu.common.device_watchdog import set_device_op_hook
+
+#: every engine-invocation op name (the probe is separate on purpose:
+#: error-class injectors must not break the recovery probe, only
+#: `device_wedged` models a device that fails the probe too)
+ENGINE_OPS = ("engine.run", "sharded.run", "grid.run", "portfolio.run")
+PROBE_OP = "probe"
+ALL_DEVICE_OPS = ENGINE_OPS + (PROBE_OP,)
+
+
+class FaultSchedule:
+    """Which call indices (0-based, per op / per method) a fault fires on.
+
+    calls: explicit indices ("fail calls 0 and 2").  after/limit: a
+    contiguous window ("fail everything from call 3", "the first 2
+    calls").  rate+seed: seeded pseudo-random firing, deterministic per
+    (seed, index) — reproducible chaos for soak-style tests.  Default
+    fires on EVERY call.
+    """
+
+    def __init__(
+        self,
+        calls=None,
+        *,
+        after: int = 0,
+        limit: int | None = None,
+        rate: float | None = None,
+        seed: int = 0,
+    ):
+        self.calls = frozenset(calls) if calls is not None else None
+        self.after = after
+        self.limit = limit
+        self.rate = rate
+        self.seed = seed
+
+    def fires(self, n: int) -> bool:
+        if self.calls is not None:
+            return n in self.calls
+        if n < self.after:
+            return False
+        if self.limit is not None and n >= self.after + self.limit:
+            return False
+        if self.rate is not None:
+            # deterministic per (seed, index); int-mixed because tuple
+            # seeding is deprecated
+            return random.Random(self.seed * 1_000_003 + n).random() < self.rate
+        return True
+
+
+ALWAYS = FaultSchedule()
+
+
+def first(n: int) -> FaultSchedule:
+    """The first n calls fail, the rest succeed — the transient-recovery
+    shape (retry tests)."""
+    return FaultSchedule(limit=n)
+
+
+class InjectionLog:
+    """What an injector observed: total intercepted calls and fired
+    faults, per op/method name.  Thread-safe — supervised ops run on
+    worker threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls: dict[str, int] = {}
+        self.fired: dict[str, int] = {}
+
+    def _record(self, name: str) -> int:
+        """Count one call; returns its 0-based index for the schedule."""
+        with self._lock:
+            n = self.calls.get(name, 0)
+            self.calls[name] = n + 1
+            return n
+
+    def _mark_fired(self, name: str) -> None:
+        with self._lock:
+            self.fired[name] = self.fired.get(name, 0) + 1
+
+    @property
+    def total_calls(self) -> int:
+        with self._lock:
+            return sum(self.calls.values())
+
+    @property
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+
+# ----------------------------------------------------------------------
+# effects
+# ----------------------------------------------------------------------
+
+
+class InjectedXlaError(RuntimeError):
+    """Stand-in for jaxlib's XlaRuntimeError (same shape the classifier
+    reads: RuntimeError carrying a gRPC-style status message)."""
+
+
+def transient_error(op: str = "?") -> InjectedXlaError:
+    return InjectedXlaError(
+        f"INTERNAL: injected fault in {op}: Failed to execute XLA runtime program"
+    )
+
+
+def oom_error(op: str = "?") -> InjectedXlaError:
+    return InjectedXlaError(
+        f"RESOURCE_EXHAUSTED: injected fault in {op}: "
+        "Out of memory allocating 9437184000 bytes"
+    )
+
+
+def compile_error(op: str = "?") -> InjectedXlaError:
+    return InjectedXlaError(
+        f"INVALID_ARGUMENT: injected fault in {op}: XLA compilation failure"
+    )
+
+
+# ----------------------------------------------------------------------
+# device-op injection (the @device_op seam)
+# ----------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def device_fault(effect, *, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS):
+    """Intercept device ops: when `schedule` fires for that op's call
+    index, run `effect(op_name, fn, args, kwargs)` (raise to inject an
+    error; block to inject a hang; call fn for a late real completion);
+    otherwise dispatch normally.  Non-targeted ops (and non-firing calls)
+    fall through to any previously installed hook, so injectors nest."""
+    log = InjectionLog()
+    prev = _watchdog_mod._DEVICE_OP_HOOK
+
+    def hook(name, fn, args, kwargs):
+        if name in ops:
+            n = log._record(name)
+            if schedule.fires(n):
+                log._mark_fired(name)
+                return effect(name, fn, args, kwargs)
+        if prev is not None:
+            return prev(name, fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    set_device_op_hook(hook)
+    try:
+        yield log
+    finally:
+        set_device_op_hook(prev)
+
+
+def _raising(factory):
+    def effect(op, fn, args, kwargs):
+        raise factory(op)
+
+    return effect
+
+
+def xla_errors(*, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS):
+    """Engine invocations raise transient XLA-shaped runtime errors."""
+    return device_fault(_raising(transient_error), ops=ops, schedule=schedule)
+
+
+def device_oom(*, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS):
+    """Engine invocations raise RESOURCE_EXHAUSTED (device OOM)."""
+    return device_fault(_raising(oom_error), ops=ops, schedule=schedule)
+
+
+def compile_failures(*, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS):
+    """Engine invocations raise XLA compilation failures."""
+    return device_fault(_raising(compile_error), ops=ops, schedule=schedule)
+
+
+@contextlib.contextmanager
+def device_wedged(*, ops=ALL_DEVICE_OPS, schedule: FaultSchedule = ALWAYS):
+    """The observed MULTICHIP_r05 failure: every device op — engine runs
+    AND the recovery probe — hangs until the context exits ("the fault
+    clears").  Abandoned supervisor threads unblock at exit and complete
+    against the real device, so nothing leaks into the next test."""
+    release = threading.Event()
+
+    def effect(op, fn, args, kwargs):
+        # block until "the fault clears" (context exit), then return a
+        # nothing-result WITHOUT running the real op: the supervisor
+        # already abandoned this call, and re-running real device work on
+        # an orphaned thread would race interpreter teardown
+        release.wait()
+        return None
+
+    with device_fault(effect, ops=ops, schedule=schedule) as log:
+        try:
+            yield log
+        finally:
+            release.set()
+
+
+# ----------------------------------------------------------------------
+# arbitrary-method injection (admin backends, Kafka wire client, ...)
+# ----------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def method_fault(target, name: str, effect, *, schedule: FaultSchedule = ALWAYS):
+    """Patch `target.name` (object or class attribute): calls whose index
+    fires per `schedule` run `effect(orig_bound, *args, **kwargs)`;
+    others pass through.  effect receives the ORIGINAL callable so slow/
+    wrapping effects can still do the real work."""
+    log = InjectionLog()
+    orig = getattr(target, name)
+    # an instance patch must not leave a shadowing attribute behind when
+    # the method originally lived on the class
+    had_own = isinstance(target, type) or name in vars(target)
+
+    def wrapper(*args, **kwargs):
+        n = log._record(name)
+        if schedule.fires(n):
+            log._mark_fired(name)
+            return effect(orig, *args, **kwargs)
+        return orig(*args, **kwargs)
+
+    setattr(target, name, wrapper)
+    try:
+        yield log
+    finally:
+        if had_own:
+            setattr(target, name, orig)
+        else:
+            delattr(target, name)
+
+
+def slow(delay_s: float):
+    """Effect: the call succeeds, after delay_s (slow admin/broker)."""
+
+    def effect(orig, *args, **kwargs):
+        time.sleep(delay_s)
+        return orig(*args, **kwargs)
+
+    return effect
+
+
+def dropping(result=None):
+    """Effect: the call is swallowed — nothing happens on the backend
+    (a controller that accepts and forgets, an election that never runs)."""
+
+    def effect(orig, *args, **kwargs):
+        return result
+
+    return effect
+
+
+def raising(exc_factory):
+    """Effect: the call raises exc_factory() (e.g. ConnectionError for
+    transient Kafka transport faults)."""
+
+    def effect(orig, *args, **kwargs):
+        raise exc_factory()
+
+    return effect
+
+
+def hanging(release: threading.Event):
+    """Effect: the call blocks until `release` is set, then completes for
+    real — a hung admin/broker response.  The caller owns the event (set
+    it in test teardown, or use `hung_method` which does both)."""
+
+    def effect(orig, *args, **kwargs):
+        release.wait()
+        return orig(*args, **kwargs)
+
+    return effect
+
+
+@contextlib.contextmanager
+def hung_method(target, name: str, *, schedule: FaultSchedule = ALWAYS):
+    """method_fault + hanging with the release tied to context exit."""
+    release = threading.Event()
+    with method_fault(target, name, hanging(release), schedule=schedule) as log:
+        try:
+            yield log
+        finally:
+            release.set()
+
+
+def kafka_connection_errors(client, *, schedule: FaultSchedule = ALWAYS):
+    """Transient transport faults: `client.broker_request` raises
+    ConnectionError on scheduled calls (broker restart / dropped socket)."""
+    return method_fault(
+        client,
+        "broker_request",
+        raising(lambda: ConnectionError("injected: connection reset by peer")),
+        schedule=schedule,
+    )

@@ -40,6 +40,14 @@ echo "== check.sh: bench.py --churn --smoke (shape-bucketed serving, CPU) =="
 GRAFT_FORCE_CPU=1 python bench.py --churn --smoke
 churn_rc=$?
 
+echo "== check.sh: fault supervision gate (degraded mode, breaker, harness) =="
+# named gate: every breaker transition / degraded proposal is pinned by
+# deterministic fault injection (testing/faults.py), never by a real TPU
+# misbehaving on cue.  Runs standalone so a fault-supervision regression
+# is named in the summary even when the full suite was skipped via args.
+python -m pytest tests/test_faults.py -q
+faults_rc=$?
+
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc faults=$faults_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ]

@@ -435,3 +435,80 @@ def test_graceful_stop_drains_in_flight(sim):
         1 for t in ex.tracker.tasks(state=TaskState.COMPLETED)
         if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION
     )
+
+
+# ---------------------------------------------------------------- fault-
+# injection coverage (testing/faults.py): dead-task timeout paths and
+# force-stop under a misbehaving admin
+
+
+def test_leader_movement_timeout_declares_dead(sim):
+    """A leadership election the controller accepts but never performs
+    (injected drop) must go DEAD at leader.movement.timeout.ms instead of
+    spinning the confirmation loop until max_ticks."""
+    from cruise_control_tpu.common.sensors import SensorRegistry
+    from cruise_control_tpu.testing import faults
+
+    sensors = SensorRegistry()
+    ex = Executor(sim, topic_names={0: "T0"}, sensors=sensors)
+    # leadership-only move: replicas unchanged, leader 0 -> 1
+    props = [proposal(0, 0, [0, 1], [0, 1], old_leader=0, new_leader=1)]
+    with faults.method_fault(sim, "elect_leaders", faults.dropping()) as log:
+        res = ex.execute_proposals(
+            props,
+            ExecutionOptions(
+                leader_movement_timeout_s=3.0, progress_check_interval_s=1.0
+            ),
+        )
+    assert log.fired["elect_leaders"] == 1
+    assert res.dead == 1 and res.completed == 0
+    assert sensors.counter("executor.leader-movement-timeout").count == 1
+    # simulated clock: the wait burned the timeout window, not max_ticks
+    assert res.ticks <= 10
+
+
+def test_force_stop_with_slow_and_hung_admin(sim):
+    """stop_execution(force=True) mid-flight while the admin answers
+    slowly (every progress probe injected +50ms) still aborts promptly:
+    in-flight reassignments are cancelled, nothing stays IN_PROGRESS, and
+    the executor returns well before the un-stopped execution would."""
+    import threading
+    import time as _time
+
+    from cruise_control_tpu.testing import faults
+
+    ex = Executor(sim, topic_names={0: "T0"})
+    # slow enough (200 B/s link, 100 MB each) that the execution cannot
+    # finish on its own within the test
+    props = [proposal(0, i, [0, 1], [2, 1], data=100e6) for i in range(4)]
+    started = threading.Event()
+
+    def progress_probe(orig, *a, **k):
+        started.set()
+        _time.sleep(0.05)
+        return orig(*a, **k)
+
+    box = {}
+
+    def run():
+        try:
+            box["res"] = ex.execute_proposals(
+                props, ExecutionOptions(progress_check_interval_s=0.01)
+            )
+        except Exception as e:  # pragma: no cover - surfaced by the assert below
+            box["err"] = e
+
+    with faults.method_fault(sim, "in_progress_reassignments", progress_probe):
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(10.0)
+        ex.stop_execution(force=True)
+        t.join(timeout=30.0)
+    assert not t.is_alive(), "force stop did not terminate the execution"
+    assert "err" not in box, box.get("err")
+    res = box["res"]
+    assert res.stopped
+    assert sim.in_progress_reassignments() == set()  # cancelled on the wire
+    assert not ex.tracker.tasks(state=TaskState.IN_PROGRESS)
+    assert res.completed + res.aborted + res.dead == len(ex.tracker.tasks())
+    assert not ex.has_ongoing_execution

@@ -1,0 +1,540 @@
+"""Supervised optimizer runtime: failure classification, circuit breaker,
+degraded CPU-greedy serving, and the deterministic fault-injection harness.
+
+Every breaker transition, retry schedule, and degraded proposal asserted
+here is driven by injected faults (cruise_control_tpu/testing/faults.py) —
+nothing depends on real device misbehavior.  The acceptance test at the
+bottom pins the full story: a permanent engine hang degrades `proposals()`
+to a bounded greedy answer, /state reports the open breaker, an
+OPTIMIZER_DEGRADED anomaly is recorded, and clearing the fault lets the
+half-open probe close the breaker and TPU serving resume.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.engine import OptimizerConfig
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.common.device_watchdog import (
+    BreakerState,
+    CircuitBreaker,
+    DeviceDegradedError,
+    DeviceHangError,
+    DeviceSupervisor,
+    FailureClass,
+    classify_failure,
+    device_watchdog,
+    jittered_backoff_s,
+)
+from cruise_control_tpu.common.sensors import SensorRegistry
+from cruise_control_tpu.config import CruiseControlConfig
+from cruise_control_tpu.service.progress import OperationProgress
+from cruise_control_tpu.testing import faults
+from cruise_control_tpu.testing.fixtures import small_cluster
+
+FAST_CFG = OptimizerConfig(
+    num_candidates=64, leadership_candidates=16, swap_candidates=16,
+    steps_per_round=8, num_rounds=2,
+)
+
+
+# ------------------------------------------------------------ classification
+
+
+def test_classification_taxonomy():
+    assert classify_failure(ValueError("bad mask")) is None
+    assert classify_failure(KeyError("x")) is None
+    assert classify_failure(DeviceHangError("optimize", 1.0)) is FailureClass.HANG
+    assert classify_failure(MemoryError()) is FailureClass.OOM
+    assert classify_failure(faults.transient_error("op")) is FailureClass.TRANSIENT
+    assert classify_failure(faults.oom_error("op")) is FailureClass.OOM
+    assert classify_failure(faults.compile_error("op")) is FailureClass.COMPILE
+    # a plain RuntimeError with no runtime-layer markers is application code
+    assert classify_failure(RuntimeError("business logic broke")) is None
+
+
+def test_jittered_backoff_bounds_and_determinism():
+    rng = random.Random(7)
+    draws = [
+        jittered_backoff_s(a, base_s=0.1, cap_s=1.0, rng=rng) for a in (1, 2, 3, 8)
+    ]
+    ceilings = [0.1, 0.2, 0.4, 1.0]
+    for d, c in zip(draws, ceilings):
+        assert 0.0 < d <= c
+    # seeded rng pins the exact sequence
+    rng2 = random.Random(7)
+    assert draws == [
+        jittered_backoff_s(a, base_s=0.1, cap_s=1.0, rng=rng2) for a in (1, 2, 3, 8)
+    ]
+
+
+def test_fault_schedule_keying():
+    s = faults.FaultSchedule(calls=(0, 2))
+    assert [s.fires(n) for n in range(4)] == [True, False, True, False]
+    w = faults.FaultSchedule(after=1, limit=2)
+    assert [w.fires(n) for n in range(4)] == [False, True, True, False]
+    r1 = faults.FaultSchedule(rate=0.5, seed=3)
+    r2 = faults.FaultSchedule(rate=0.5, seed=3)
+    pattern = [r1.fires(n) for n in range(64)]
+    assert pattern == [r2.fires(n) for n in range(64)]  # seeded: reproducible
+    assert any(pattern) and not all(pattern)
+    assert [faults.first(2).fires(n) for n in range(3)] == [True, True, False]
+
+
+# ------------------------------------------------------------ circuit breaker
+
+
+def test_breaker_transitions():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=2, probe_interval_s=10.0, clock=lambda: clock[0])
+    assert b.state is BreakerState.CLOSED
+    assert not b.record_failure()
+    b.record_success()  # success resets the consecutive count
+    assert not b.record_failure()
+    assert b.record_failure()  # second consecutive -> opens
+    assert b.state is BreakerState.OPEN and b.open_epoch == 1
+    assert not b.probe_due()  # interval not elapsed
+    clock[0] = 11.0
+    assert b.probe_due() and b.begin_probe()
+    assert b.state is BreakerState.HALF_OPEN
+    b.probe_failed()
+    assert b.state is BreakerState.OPEN
+    assert not b.probe_due()  # re-armed
+    clock[0] = 22.0
+    assert b.begin_probe()
+    b.probe_succeeded()
+    assert b.state is BreakerState.CLOSED and b.consecutive_failures == 0
+    # reopen bumps the epoch (edge-trigger for anomaly reporting)
+    assert b.record_failure() is False and b.record_failure() is True
+    assert b.open_epoch == 2
+
+
+# ------------------------------------------------------------ supervisor
+
+
+def test_supervised_hang_is_bounded():
+    sup = DeviceSupervisor(op_timeout_s=0.2, breaker_failure_threshold=1)
+    t0 = time.monotonic()
+    with pytest.raises(DeviceDegradedError) as ei:
+        sup.call(lambda: time.sleep(30), op="optimize")
+    assert time.monotonic() - t0 < 5.0  # nowhere near the 30s hang
+    assert ei.value.failure_class is FailureClass.HANG
+    assert sup.breaker.state is BreakerState.OPEN
+
+
+def test_transient_retries_with_backoff_then_success():
+    sleeps: list[float] = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise faults.transient_error("flaky")
+        return "ok"
+
+    sensors = SensorRegistry()
+    sup = DeviceSupervisor(
+        op_timeout_s=5.0, max_retries=2, retry_backoff_s=0.01,
+        sensors=sensors, sleep=sleeps.append, rng=random.Random(0),
+    )
+    assert sup.call(flaky, op="optimize") == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert all(0 < s <= 0.02 * 2 for s in sleeps)
+    assert sup.breaker.state is BreakerState.CLOSED  # success reset it
+    assert sensors.counter("analyzer.supervisor.retries").count == 2
+    assert sensors.counter("analyzer.supervisor.failures.transient").count == 2
+
+
+def test_transient_retries_exhausted_counts_one_breaker_failure():
+    sup = DeviceSupervisor(
+        op_timeout_s=5.0, max_retries=1, retry_backoff_s=0.001,
+        breaker_failure_threshold=2, sleep=lambda s: None,
+    )
+
+    def always_transient():
+        raise faults.transient_error("x")
+
+    with pytest.raises(DeviceDegradedError):
+        sup.call(always_transient, op="optimize")
+    # two raises (original + retry) but ONE operation-level breaker count
+    assert sup.breaker.consecutive_failures == 1
+    assert sup.breaker.state is BreakerState.CLOSED
+
+
+def test_unclassified_errors_propagate_untouched():
+    sup = DeviceSupervisor(op_timeout_s=5.0, breaker_failure_threshold=1)
+
+    def bad_request():
+        raise ValueError("broker ids [99] are not in the cluster model")
+
+    with pytest.raises(ValueError):
+        sup.call(bad_request, op="optimize")
+    assert sup.breaker.state is BreakerState.CLOSED
+    assert sup.breaker.consecutive_failures == 0
+
+
+def test_probe_recovery_closes_breaker():
+    probe_results = ["wedged", "wedged", None]  # two failed probes, then healthy
+    sup = DeviceSupervisor(
+        op_timeout_s=0.1, breaker_failure_threshold=1, probe_interval_s=0.0,
+        probe=lambda: probe_results.pop(0),
+    )
+    with pytest.raises(DeviceDegradedError):
+        sup.call(lambda: time.sleep(5), op="optimize")
+    assert sup.is_degraded
+    assert not sup.available()  # probe 1 fails
+    assert not sup.available()  # probe 2 fails
+    assert sup.available()  # probe 3 heals -> closed
+    assert sup.breaker.state is BreakerState.CLOSED
+    assert sup.num_probes == 3 and sup.num_probe_failures == 2
+    js = sup.state_json()
+    assert js["breaker"] == "closed" and js["numProbeFailures"] == 2
+
+
+def test_device_watchdog_wedges_under_harness():
+    with faults.device_wedged(ops=(faults.PROBE_OP,)):
+        diagnosis = device_watchdog(timeout_s=0.1)
+    assert diagnosis is not None and "did not complete" in diagnosis
+    assert device_watchdog(timeout_s=30.0) is None  # fault cleared
+
+
+# ------------------------------------------------------------ supervised optimizer
+
+
+def _supervised_optimizer(**sup_kwargs):
+    # op_timeout generous: a post-purge rebuild pays a real trace+compile,
+    # which must never be misclassified as a hang in these tests
+    defaults = dict(
+        op_timeout_s=120.0, max_retries=0, breaker_failure_threshold=1,
+        probe_interval_s=0.0, probe=lambda: None,
+    )
+    defaults.update(sup_kwargs)
+    sensors = SensorRegistry()
+    sup = DeviceSupervisor(sensors=sensors, **defaults)
+    opt = GoalOptimizer(
+        config=FAST_CFG, supervisor=sup, degraded_budget_s=10.0, sensors=sensors,
+    )
+    return opt, sup, sensors
+
+
+def test_injected_oom_degrades_and_recovery_restores_device_path():
+    opt, sup, sensors = _supervised_optimizer()
+    state = small_cluster()
+    with faults.device_oom(schedule=faults.first(1)) as log:
+        r = opt.optimize(state)
+        assert r.degraded and sup.is_degraded
+        assert log.fired["engine.run"] == 1
+    rec = r.history[0]
+    assert rec["degraded"] and rec["reason"] == "oom"
+    assert sensors.counter("analyzer.supervisor.failures.oom").count == 1
+    assert sensors.counter("analyzer.degraded-proposals").count == 1
+    # the greedy answer is a usable proposal set over the same model
+    assert r.summary()["degraded"] is True
+    assert r.balancedness_after >= r.balancedness_before - 1e-6
+    # fault gone: probe heals on the next call, device path resumes
+    r2 = opt.optimize(state)
+    assert not r2.degraded and not sup.is_degraded
+
+
+def test_breaker_open_skips_device_entirely():
+    opt, sup, _ = _supervised_optimizer(probe=lambda: "still wedged")
+    state = small_cluster()
+    with faults.xla_errors(schedule=faults.first(1)) as log:
+        assert opt.optimize(state).degraded
+        fired_during_fault = log.total_fired
+        # breaker is open and the probe keeps failing: no engine invocation
+        assert opt.optimize(state).degraded
+        assert log.calls.get("engine.run", 0) == fired_during_fault == 1
+
+
+def test_engine_cache_purged_on_breaker_open():
+    opt, sup, _ = _supervised_optimizer()
+    state = small_cluster()
+    assert not opt.optimize(state).degraded
+    assert opt.has_engine_for(state.shape, config=FAST_CFG)
+    with faults.xla_errors(schedule=faults.first(1)):
+        assert opt.optimize(state).degraded
+    # open transition dropped the compiled engines (wedged-device buffers)
+    assert not opt.has_engine_for(state.shape, config=FAST_CFG)
+    assert not opt.optimize(state).degraded  # rebuilt fresh after recovery
+    assert opt.has_engine_for(state.shape, config=FAST_CFG)
+
+
+def test_degraded_mode_honors_exclusion_masks():
+    """A DEGRADED self-healing fix keeps its exclusion contract: the
+    greedy fallback never lands replicas or leadership on excluded
+    brokers (recently removed/demoted)."""
+    from cruise_control_tpu.analyzer.options import OptimizationOptions
+
+    opt, sup, _ = _supervised_optimizer()
+    state = small_cluster()
+    excl = np.zeros(state.shape.B, bool)
+    excl[2] = True
+    options = OptimizationOptions(
+        excluded_brokers_for_replica_move=excl,
+        excluded_brokers_for_leadership=excl,
+    )
+    r = opt._optimize_degraded(state, options, FAST_CFG, reason="test")
+    assert r.degraded
+    for p in list(r.proposals):
+        assert 2 not in set(p.new_replicas) - set(p.old_replicas)
+        if p.new_leader != p.old_leader:
+            assert p.new_leader != 2
+
+
+def test_application_error_propagates_not_degraded():
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    opt, sup, _ = _supervised_optimizer()
+    state = small_cluster()
+    bad_broker = np.asarray(state.replica_broker).copy()
+    bad_broker[0] = state.shape.B + 7  # out of range: host validator rejects
+    bad = dataclasses.replace(state, replica_broker=jnp.asarray(bad_broker))
+    with pytest.raises(ValueError):
+        opt.optimize(bad)
+    assert not sup.is_degraded  # malformed input must not trip the breaker
+
+
+# ------------------------------------------------------------ satellites
+
+
+def test_detector_loop_survives_handler_exceptions():
+    from cruise_control_tpu.detector import AnomalyDetector
+    from cruise_control_tpu.detector.anomalies import GoalViolations
+
+    class ExplodingNotifier:
+        def on_anomaly(self, anomaly):
+            raise RuntimeError("notifier crashed")
+
+        def self_healing_enabled(self):
+            return {}
+
+    class Actions:
+        is_busy = False
+
+    sensors = SensorRegistry()
+    det = AnomalyDetector(ExplodingNotifier(), Actions(), sensors=sensors)
+    det.register_detector(lambda: GoalViolations(fixable_violations=["DiskUsage"]))
+    det.start(interval_s=0.01)
+    try:
+        deadline = time.monotonic() + 5.0
+        while (
+            sensors.counter("detector.loop-failures").count < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+    finally:
+        det.shutdown()
+    # the loop kept ticking across >= 2 failing rounds instead of dying
+    assert sensors.counter("detector.loop-failures").count >= 2
+
+
+def test_kafka_transport_backoff_and_connection_retry():
+    from cruise_control_tpu.kafka import protocol as proto
+    from cruise_control_tpu.kafka.client import KafkaProtocolError
+    from cruise_control_tpu.kafka.transport import KafkaMetricsTransport
+
+    class FakeClient:
+        """Scripted broker: responses[i] is an error_code or an exception
+        for the i-th Produce."""
+
+        def __init__(self, script):
+            self.script = list(script)
+            self.produces = 0
+
+        def metadata(self, topics):
+            return {"topics": [{
+                "name": topics[0], "error_code": 0,
+                "partitions": [
+                    {"partition_index": 0, "leader_id": 1, "error_code": 0}
+                ],
+            }]}
+
+        def broker_request(self, node, api, body):
+            assert api is proto.PRODUCE
+            self.produces += 1
+            step = self.script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            return {"responses": [{"partition_responses": [
+                {"error_code": step, "index": 0}
+            ]}]}
+
+    sleeps: list[float] = []
+
+    def make(script):
+        client = FakeClient(script)
+        t = KafkaMetricsTransport(
+            client, flush_every=1, rng=random.Random(1), sleep=sleeps.append,
+        )
+        return client, t
+
+    # NOT_LEADER -> jittered backoff -> reroute succeeds
+    client, t = make([6, 0])
+    t.send(b"m1")
+    assert client.produces == 2 and len(sleeps) == 1
+    assert 0 < sleeps[0] <= 0.5
+
+    # transient connection error -> backoff -> retry succeeds
+    sleeps.clear()
+    client, t = make([ConnectionError("reset"), 0])
+    t.send(b"m2")
+    assert client.produces == 2 and len(sleeps) == 1
+
+    # double failure surfaces AND the buffer is restored (contract)
+    sleeps.clear()
+    client, t = make([ConnectionError("reset"), ConnectionError("reset")])
+    with pytest.raises(ConnectionError):
+        t.send(b"m3")
+    assert t._buffer == [b"m3"]
+
+    # hard protocol errors do not retry
+    client, t = make([3])
+    with pytest.raises(KafkaProtocolError):
+        t.send(b"m4")
+    assert client.produces == 1 and t._buffer == [b"m4"]
+
+
+# ------------------------------------------------------------ service-level
+
+
+@pytest.fixture(scope="module")
+def supervised_service():
+    """In-process facade with aggressive supervisor timings so breaker
+    stories run in seconds (no HTTP listener needed)."""
+    from cruise_control_tpu.service.main import build_simulated_service
+
+    config = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "min.samples.per.partition.metrics.window": 1,
+        "num.partition.metrics.windows": 3,
+        "execution.progress.check.interval.ms": 100,
+        "webserver.http.port": 0,
+        "tpu.num.candidates": 128,
+        "tpu.leadership.candidates": 32,
+        "tpu.steps.per.round": 16,
+        "tpu.num.rounds": 2,
+        # generous: real compiles on a loaded CI box must never classify
+        # as hangs (the acceptance test tightens it around the wedge only)
+        "tpu.supervisor.op.timeout.s": 300.0,
+        "tpu.supervisor.probe.timeout.s": 0.2,
+        "tpu.supervisor.probe.interval.s": 0.0,
+        "tpu.supervisor.breaker.failure.threshold": 1,
+        "tpu.supervisor.max.retries": 0,
+        "tpu.supervisor.degraded.greedy.budget.s": 20.0,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config)
+    return app.cc
+
+
+def test_acceptance_permanent_hang_degrades_then_probe_recovers(supervised_service):
+    """ISSUE 3 acceptance: injected permanent engine hang =>
+    * proposals() returns a valid greedy proposal set within the budget,
+    * /state shows analyzer.degraded=true, breaker open,
+    * an OPTIMIZER_DEGRADED anomaly is recorded,
+    * after the fault clears the probe closes the breaker and the next
+      proposal is TPU-backed again."""
+    cc = supervised_service
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+
+    # healthy warmup: TPU-backed proposals (generous budget — a slow cold
+    # compile on a loaded box is not a hang)
+    r0 = cc.proposals(OperationProgress(), ignore_cache=True)
+    assert not r0.degraded
+
+    # tight budget ONLY while the hang is injected: the wedge fires on the
+    # first engine dispatch, so the bounded wait is exactly this budget
+    cc.supervisor.op_timeout_s = 5.0
+    try:
+        with faults.device_wedged():
+            t0 = time.monotonic()
+            r1 = cc.proposals(OperationProgress(), ignore_cache=True)
+            elapsed = time.monotonic() - t0
+            # bounded: op budget (5s) + greedy fallback, nowhere near a hang
+            assert elapsed < 90.0
+            assert r1.degraded
+            # a valid proposal set over the live model: every proposal
+            # diffs the before placement, and the summary is servable
+            summary = r1.summary()
+            assert summary["degraded"] is True
+            for p in list(r1.proposals)[:10]:
+                assert p.old_replicas != p.new_replicas or p.old_leader != p.new_leader
+            st = cc.state(["analyzer"])
+            assert st["AnalyzerState"]["degraded"] is True
+            assert st["AnalyzerState"]["supervisor"]["breaker"] == "open"
+            assert st["AnalyzerState"]["supervisor"]["failureCounts"]["hang"] >= 1
+            # the detector records the degradation anomaly (edge-triggered)
+            records = cc.anomaly_detector.run_once()
+            assert any(
+                r.anomaly.anomaly_type is AnomalyType.OPTIMIZER_DEGRADED
+                for r in records
+            )
+            # ... once per open episode, not once per round
+            assert not any(
+                r.anomaly.anomaly_type is AnomalyType.OPTIMIZER_DEGRADED
+                for r in cc.anomaly_detector.run_once()
+            )
+            # still degraded while wedged: the half-open probe fails too
+            r2 = cc.proposals(OperationProgress(), ignore_cache=True)
+            assert r2.degraded
+    finally:
+        # recovery pays a fresh trace+compile (caches were purged on open):
+        # back to the generous budget
+        cc.supervisor.op_timeout_s = 300.0
+
+    # fault cleared: the next call's half-open probe heals the breaker
+    r3 = cc.proposals(OperationProgress(), ignore_cache=True)
+    assert not r3.degraded
+    st = cc.state(["analyzer"])
+    assert st["AnalyzerState"]["degraded"] is False
+    assert st["AnalyzerState"]["supervisor"]["breaker"] == "closed"
+
+
+def test_self_healing_fix_failure_is_visible(supervised_service):
+    cc = supervised_service
+    before = cc.sensors.counter("self-healing.fix-failed").count
+    with faults.method_fault(
+        cc, "rebalance", faults.raising(lambda: RuntimeError("boom"))
+    ):
+        assert cc.actions.rebalance("test-reason") is False
+    assert cc.sensors.counter("self-healing.fix-failed").count == before + 1
+    info = cc.anomaly_detector.detector_state()["lastSelfHealingFixFailure"]
+    assert info["operation"] == "rebalance" and "boom" in info["error"]
+
+
+def test_precompute_loop_counts_consecutive_failures(supervised_service):
+    cc = supervised_service
+    saved_expiration = cc._proposal_expiration_ms
+    cc._proposal_expiration_ms = 20  # 10ms cycle
+    cc._stop_precompute.clear()
+    t = None
+    try:
+        with faults.method_fault(
+            cc, "proposals", faults.raising(lambda: RuntimeError("model build broke"))
+        ), faults.method_fault(cc, "_prewarm_next_bucket", faults.dropping()):
+            t = threading.Thread(target=cc._precompute_loop, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while (
+                cc.sensors.counter("analyzer.precompute-failures").count < 3
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert cc.sensors.counter("analyzer.precompute-failures").count >= 3
+            assert (
+                cc.sensors.gauge("analyzer.precompute-consecutive-failures").value >= 3
+            )
+            cc._stop_precompute.set()
+            t.join(timeout=5)
+            assert not t.is_alive()
+    finally:
+        cc._stop_precompute.set()
+        if t is not None:
+            t.join(timeout=5)
+        cc._proposal_expiration_ms = saved_expiration
