@@ -20,6 +20,13 @@ Headline sensors (same semantics as the reference catalog):
     analyzer.degraded-proposals for CPU-greedy-served results (no
     reference analog — the reference has no accelerator to lose; see
     docs/sensors.md "Ops note: degraded-mode gauges")
+  * executor.recovery.* — crash-safe execution: journal reconciliation
+    counters (executions-recovered, tasks-{completed,readopted,
+    resubmitted}, throttles-swept, reservations-restored)
+  * executor.reaper.stuck-task / .rollback — stuck-move reaper actions
+  * executor.adaptive.{backoff,recovery} counters +
+    executor.adaptive.inter-broker-cap gauge — load-aware adaptive
+    concurrency (reference ConcurrencyAdjuster)
 """
 
 from __future__ import annotations

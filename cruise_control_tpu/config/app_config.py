@@ -337,6 +337,63 @@ def _executor_defs() -> ConfigDef:
     d.define("demotion.history.retention.time.ms", T.LONG, 1_209_600_000, I.LOW,
              "how long demoted brokers stay in the recently-demoted set",
              in_range(lo=1), group=g)
+    # --- crash-safe execution (executor/journal.py) ---
+    g = "executor.journal"
+    d.define("executor.journal.dir", T.STRING, None, I.MEDIUM,
+             "directory of the durable execution journal (append-only "
+             "JSONL); a restarted executor replays it, reconciles any "
+             "in-flight execution against the live cluster and resumes it "
+             "(RECOVERING state).  Unset disables journaling — a crash "
+             "mid-rebalance then strands in-flight reassignments and leaks "
+             "throttles, exactly what the reference's persisted executor "
+             "state prevents", group=g)
+    d.define("executor.journal.fsync.batch.size", T.INT, 1, I.LOW,
+             "journal records buffered before flush+fsync; 1 makes every "
+             "record durable before the next cluster mutation (execution "
+             "start, throttle and reaper records always fsync regardless)",
+             in_range(lo=1), group=g)
+    # --- stuck-move reaper ---
+    g = "executor.reaper"
+    d.define("executor.reaper.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "enforce the slow-task signal: a replica move whose progress "
+             "watermark stalls past the timeout is cancelled (rolled back "
+             "to the original replica set where the controller supports "
+             "per-partition cancellation, else declared DEAD) and an "
+             "EXECUTION_STUCK anomaly is raised — the rest of the batch "
+             "keeps flowing", group=g)
+    d.define("executor.reaper.stuck.timeout.s", T.DOUBLE, 900.0, I.MEDIUM,
+             "seconds without observable progress (remaining-bytes "
+             "decrease, or completion for admins that cannot report "
+             "per-move bytes) before an in-flight move is reaped",
+             in_range(lo=1.0), group=g)
+    # --- load-aware adaptive concurrency (reference ConcurrencyAdjuster) ---
+    g = "executor.adaptive"
+    d.define("executor.adaptive.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "AIMD the per-broker and cluster-wide movement caps each "
+             "progress tick: multiplicative backoff while the cluster "
+             "shows stress (under-replicated partitions above the "
+             "execution-start baseline, or task throughput collapse), "
+             "additive recovery toward the configured caps once it clears",
+             group=g)
+    d.define("executor.adaptive.min", T.INT, 1, I.MEDIUM,
+             "floor of the adaptive per-broker movement cap",
+             in_range(lo=1), group=g)
+    d.define("executor.adaptive.max", T.INT, 64, I.MEDIUM,
+             "ceiling of the adaptive per-broker movement cap",
+             in_range(lo=1), group=g)
+    d.define("executor.adaptive.backoff.factor", T.DOUBLE, 0.5, I.LOW,
+             "multiplicative decrease applied to the caps on a stressed "
+             "tick", in_range(lo=0.05, hi=0.95), group=g)
+    d.define("executor.adaptive.recover.step", T.INT, 1, I.LOW,
+             "additive per-tick cap recovery once stress clears",
+             in_range(lo=1), group=g)
+    d.define("executor.adaptive.urp.slack", T.INT, 0, I.LOW,
+             "under-replicated partitions above the execution-start "
+             "baseline tolerated before backoff", in_range(lo=0), group=g)
+    d.define("executor.adaptive.stall.ticks", T.INT, 16, I.LOW,
+             "consecutive progress ticks without a single task completion "
+             "(while moves are in flight) that count as cluster stress; "
+             "0 disables the throughput signal", in_range(lo=0), group=g)
     return d
 
 

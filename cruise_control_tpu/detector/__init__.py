@@ -9,6 +9,7 @@ from cruise_control_tpu.detector.anomalies import (
     AnomalyType,
     BrokerFailures,
     DiskFailures,
+    ExecutionStuck,
     GoalViolations,
     SlowBrokers,
     TopicPartitionSizeAnomaly,

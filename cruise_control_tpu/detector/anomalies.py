@@ -27,6 +27,9 @@ class AnomalyType(enum.Enum):
     #: proposals are being served by the CPU greedy fallback (no reference
     #: analog: the reference has no accelerator to lose)
     OPTIMIZER_DEGRADED = 5
+    #: the executor's stuck-move reaper cancelled a reassignment whose
+    #: progress watermark stalled past executor.reaper.stuck.timeout.s
+    EXECUTION_STUCK = 6
 
     @property
     def priority(self) -> int:
@@ -136,6 +139,36 @@ class OptimizerDegraded(Anomaly):
         return (
             f"OptimizerDegraded(class={self.failure_class}, "
             f"epoch={self.open_epoch}, last_error={self.last_error!r})"
+        )
+
+
+@dataclasses.dataclass
+class ExecutionStuck(Anomaly):
+    """The executor's stuck-move reaper cancelled a reassignment that made
+    no progress for executor.reaper.stuck.timeout.s (executor/executor.py
+    _reap_stuck_move).
+
+    Not self-healable: the reaper already acted (rollback via per-partition
+    cancellation, or DEAD when the controller cannot cancel) — the anomaly
+    exists so operators hear about the wedged move through the notifier and
+    it lands in the /state anomaly history."""
+
+    anomaly_type: AnomalyType = AnomalyType.EXECUTION_STUCK
+    topic: str = ""
+    partition: int = -1
+    execution_id: int = -1
+    uuid: str = ""
+    stalled_s: float = 0.0
+    #: True when the controller rolled the partition back to its original
+    #: replica set; False means the task was declared DEAD
+    rolled_back: bool = False
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"ExecutionStuck({self.topic}-{self.partition}, "
+            f"task={self.execution_id}, stalled={self.stalled_s:.0f}s, "
+            f"{'rolled back' if self.rolled_back else 'DEAD'})"
         )
 
 

@@ -234,7 +234,12 @@ class AnomalyDetector:
     def _handle(self, anomaly: Anomaly) -> AnomalyRecord:
         """Reference AnomalyHandlerTask:318."""
         now = self._now()
-        if self.actions.is_busy:
+        # only FIXABLE anomalies wait for the executor: an alert-only one
+        # (EXECUTION_STUCK, OPTIMIZER_DEGRADED) never touches it, and
+        # EXECUTION_STUCK in particular is raised DURING an execution —
+        # parking it for busy re-checks would delay the operator alert
+        # exactly while the wedged move is news
+        if self.actions.is_busy and anomaly.fixable:
             # executor busy: re-check later (reference handleAnomalyInProgress);
             # NOT counted in the rate sensors — a busy-delayed anomaly cycling
             # through _handle is one event, not many

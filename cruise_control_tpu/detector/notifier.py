@@ -20,6 +20,7 @@ from cruise_control_tpu.detector.anomalies import (
     Anomaly,
     AnomalyType,
     BrokerFailures,
+    ExecutionStuck,
     OptimizerDegraded,
 )
 
@@ -97,10 +98,10 @@ class SelfHealingNotifier:
     def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
         if isinstance(anomaly, BrokerFailures):
             return self._on_broker_failure(anomaly)
-        if isinstance(anomaly, OptimizerDegraded):
-            # nothing to fix (the supervisor's half-open probe is the
-            # recovery path) but operators must hear about degraded
-            # serving immediately — alert, then ignore
+        if isinstance(anomaly, (OptimizerDegraded, ExecutionStuck)):
+            # nothing to fix (the supervisor's half-open probe / the
+            # executor's reaper already IS the recovery path) but operators
+            # must hear about it immediately — alert, then ignore
             self._send_alert(anomaly, False)
             return AnomalyNotificationResult.ignore()
         if not self._enabled.get(anomaly.anomaly_type, False) or not anomaly.fixable:

@@ -12,6 +12,7 @@ from cruise_control_tpu.executor.admin import (
     SimulatedClusterAdmin,
 )
 from cruise_control_tpu.executor.executor import (
+    ConcurrencyAdjuster,
     ExecutionOptions,
     ExecutionResult,
     Executor,
@@ -19,6 +20,7 @@ from cruise_control_tpu.executor.executor import (
     NoOngoingExecutionError,
     OngoingExecutionError,
 )
+from cruise_control_tpu.executor.journal import ExecutionJournal
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import (
     STRATEGIES_BY_NAME,

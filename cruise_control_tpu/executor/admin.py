@@ -71,6 +71,17 @@ class ClusterAdmin(Protocol):
     def topology(self) -> ClusterTopology:
         ...
 
+    # Optional capabilities the executor probes with hasattr():
+    #
+    #   reassignment_remaining_bytes() -> dict[(topic, part), float]
+    #       per-reassignment bytes still to copy — feeds the stuck-move
+    #       reaper's progress watermark (a KIP-455 admin derives this from
+    #       replica log-end offsets vs the leader)
+    #   cancel_partition_reassignments(keys: list[(topic, part)]) -> None
+    #       cancel INDIVIDUAL reassignments, rolling each partition back to
+    #       its original replica set (KIP-455 supports per-partition
+    #       cancellation; cancel_reassignments above nukes everything)
+
 
 @dataclasses.dataclass
 class _Inflight:
@@ -127,6 +138,28 @@ class SimulatedClusterAdmin:
     def cancel_reassignments(self) -> None:
         # reference force-stop deletes the ZK node (Executor.java:1145)
         self._inflight.clear()
+
+    def cancel_partition_reassignments(self, keys) -> None:
+        """Per-partition cancellation (KIP-455): the move is dropped and
+        the partition keeps its ORIGINAL replica set (the simulated
+        topology was never touched mid-flight, so dropping the in-flight
+        entry IS the rollback)."""
+        for key in keys:
+            self._inflight.pop(tuple(key), None)
+
+    def reassignment_remaining_bytes(self) -> dict[tuple[str, int], float]:
+        """Bytes still to copy per in-flight reassignment — the reaper's
+        progress watermark source."""
+        return {k: fl.remaining_bytes for k, fl in self._inflight.items()}
+
+    def stall(self, *keys: tuple[str, int]) -> None:
+        """Freeze the given reassignments: they stay in-progress but stop
+        making byte progress (a wedged follower / saturated link)."""
+        self._fail.update(keys)
+
+    def unstall(self, *keys: tuple[str, int]) -> None:
+        for key in keys:
+            self._fail.discard(key)
 
     def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
         self.election_calls += 1

@@ -12,6 +12,24 @@ finished reassignments from the ClusterAdmin, transitions tasks, and
 drains new ones within concurrency caps.  `execute_proposals` runs the
 loop synchronously (simulation advances via admin.tick) or in a
 background thread against a real cluster.
+
+Crash safety (this file + executor/journal.py): when a journal is
+attached, every execution start, task transition, throttle change and
+reservation change is durably recorded.  A fresh Executor replays the
+journal on construction; an execution the predecessor left in flight puts
+the executor in RECOVERING state — reservations restored, leaked
+throttles swept, every journaled task reconciled against the live
+topology (landed -> COMPLETED, still moving -> re-adopted, vanished ->
+re-submitted or DEAD) — and `resume_recovered_execution()` drives the
+remainder to completion with zero duplicate submissions.
+
+Two in-loop guardians (reference ConcurrencyAdjuster + stuck-task
+handling): the stuck-move reaper cancels reassignments whose progress
+watermark stalls past `executor.reaper.stuck.timeout.s` (rollback via
+per-partition cancellation where the controller supports it, else DEAD)
+and raises an EXECUTION_STUCK anomaly; the ConcurrencyAdjuster samples
+cluster stress (under-replicated partitions, task throughput) every tick
+and AIMD-adjusts the movement caps between `executor.adaptive.{min,max}`.
 """
 
 from __future__ import annotations
@@ -23,6 +41,10 @@ import time
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.executor.admin import ClusterAdmin, LeadershipSpec, ReassignmentSpec
+from cruise_control_tpu.executor.journal import (
+    ExecutionJournal,
+    task_to_journal,
+)
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
 from cruise_control_tpu.executor.tasks import (
@@ -33,12 +55,17 @@ from cruise_control_tpu.executor.tasks import (
 )
 from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
 
+_TERMINAL = (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD)
+
 
 class ExecutorState(enum.Enum):
-    """Reference executor/ExecutorState.java states."""
+    """Reference executor/ExecutorState.java states (+ RECOVERING: journal
+    replay reconciled an execution a crashed predecessor left in flight
+    and the remainder has not resumed/finished yet)."""
 
     NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
     STARTING_EXECUTION = "STARTING_EXECUTION"
+    RECOVERING = "RECOVERING"
     INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
         "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
     )
@@ -90,6 +117,26 @@ class ExecutionOptions:
     #: UNVERIFIABLE (unreachable broker) before its task is declared DEAD
     max_intra_verify_failures: int = 8
     max_ticks: int = 10_000  # simulation safety bound
+    #: stuck-move reaper (executor.reaper.stuck.timeout.s): an inter-broker
+    #: move whose progress watermark (remaining bytes, when the admin can
+    #: report them, else any completion) has not advanced for this long is
+    #: cancelled — rolled back to the original replica set where the
+    #: controller supports per-partition cancellation, DEAD otherwise —
+    #: and an EXECUTION_STUCK anomaly is raised.  None disables.
+    reaper_stuck_timeout_s: float | None = None
+    #: load-aware adaptive concurrency (reference ConcurrencyAdjuster):
+    #: AIMD on the per-broker + cluster-wide movement caps, driven by
+    #: under-replicated partitions and task throughput
+    adaptive_enabled: bool = False
+    adaptive_min_concurrency: int = 1
+    adaptive_max_concurrency: int = 64
+    adaptive_backoff_factor: float = 0.5
+    adaptive_recover_step: int = 1
+    #: URPs above the execution-start baseline tolerated before backoff
+    adaptive_urp_slack: int = 0
+    #: consecutive no-completion ticks (with moves in flight) that count as
+    #: stress — the throughput half of the stress signal
+    adaptive_stall_ticks: int = 16
 
 
 @dataclasses.dataclass
@@ -112,6 +159,137 @@ class NoOngoingExecutionError(Exception):
     (reference rejects ChangeExecutionConcurrency in that case)."""
 
 
+class ConcurrencyAdjuster:
+    """Load-aware movement-cap control (reference executor/ConcurrencyAdjuster):
+    multiplicative backoff while the cluster shows stress, additive
+    recovery toward the configured cap once it clears.
+
+    Stress per progress tick = under-replicated partitions above the
+    execution-start baseline (replicas or leaders on dead brokers — the
+    metadata-level URP proxy every ClusterAdmin can serve), OR zero task
+    completions for `stall_ticks` consecutive ticks while moves are in
+    flight (throughput collapse).  The cluster-wide cap scales with the
+    per-broker cap so both back off together.
+    """
+
+    def __init__(
+        self,
+        *,
+        base_inter: int,
+        base_cluster: int,
+        min_cap: int = 1,
+        max_cap: int = 64,
+        backoff_factor: float = 0.5,
+        recover_step: int = 1,
+        urp_slack: int = 0,
+        stall_ticks: int = 16,
+        initial: int | None = None,
+        sensors=None,
+        journal: ExecutionJournal | None = None,
+    ):
+        self.base_inter = max(1, int(base_inter))
+        self.base_cluster = max(1, int(base_cluster))
+        self.min_cap = max(1, int(min_cap))
+        self.max_cap = max(self.min_cap, int(max_cap))
+        self.backoff_factor = backoff_factor
+        self.recover_step = max(1, int(recover_step))
+        self.urp_slack = max(0, int(urp_slack))
+        self.stall_ticks = max(0, int(stall_ticks))
+        self.sensors = sensors
+        self.journal = journal
+        self.inter_cap = self._clamp(
+            initial if initial is not None else self.base_inter
+        )
+        self.baseline_urps: int | None = None
+        self.last_urps = 0
+        self.num_backoffs = 0
+        self.num_recoveries = 0
+        self._idle_ticks = 0
+
+    def _clamp(self, cap: int) -> int:
+        return max(self.min_cap, min(int(cap), self.max_cap))
+
+    def caps(self) -> tuple[int, int]:
+        """(per-broker inter cap, cluster-wide movement cap)."""
+        cluster = max(
+            1, round(self.base_cluster * self.inter_cap / self.base_inter)
+        )
+        return self.inter_cap, min(cluster, self.base_cluster)
+
+    @staticmethod
+    def urp_count(topo) -> int:
+        """Metadata-level under-replication proxy: partitions whose leader
+        or any replica sits on a dead broker."""
+        alive = topo.alive_broker_ids()
+        return sum(
+            1
+            for p in topo.partitions
+            if p.leader not in alive or any(b not in alive for b in p.replicas)
+        )
+
+    def observe(
+        self, topo, *, completed: int, in_flight: int, base_inter: int | None = None
+    ) -> tuple[int, int]:
+        """One progress tick: sample stress, adjust, return active caps."""
+        if base_inter is not None and int(base_inter) != self.base_inter:
+            # the operator moved the base mid-execution (requested
+            # concurrency override) — recover toward the NEW target
+            self.base_inter = max(1, int(base_inter))
+        urps = self.urp_count(topo)
+        self.last_urps = urps
+        if self.baseline_urps is None:
+            # first tick: the cluster's pre-existing URPs are not this
+            # execution's fault and must not trigger immediate backoff
+            self.baseline_urps = urps
+        if completed > 0 or in_flight == 0:
+            self._idle_ticks = 0
+        else:
+            self._idle_ticks += 1
+        stressed = urps > self.baseline_urps + self.urp_slack or (
+            self.stall_ticks > 0 and self._idle_ticks >= self.stall_ticks
+        )
+        prev = self.inter_cap
+        if stressed:
+            self.inter_cap = max(
+                self.min_cap, int(self.inter_cap * self.backoff_factor)
+            )
+            if self.inter_cap < prev:
+                self.num_backoffs += 1
+                if self.sensors is not None:
+                    self.sensors.counter("executor.adaptive.backoff").inc()
+            # one stall episode is one backoff, not one per subsequent tick
+            self._idle_ticks = 0
+        else:
+            ceiling = self._clamp(self.base_inter)
+            if self.inter_cap < ceiling:
+                self.inter_cap = min(ceiling, self.inter_cap + self.recover_step)
+                self.num_recoveries += 1
+                if self.sensors is not None:
+                    self.sensors.counter("executor.adaptive.recovery").inc()
+        if self.inter_cap != prev:
+            inter, cluster = self.caps()
+            if self.sensors is not None:
+                self.sensors.gauge("executor.adaptive.inter-broker-cap").set(inter)
+            if self.journal is not None:
+                self.journal.append(
+                    {"t": "concurrency", "inter": inter, "cluster": cluster,
+                     "urps": urps}
+                )
+        return self.caps()
+
+    def state_json(self) -> dict:
+        inter, cluster = self.caps()
+        return {
+            "interBrokerCap": inter,
+            "clusterMovementCap": cluster,
+            "baseInterBrokerCap": self.base_inter,
+            "underReplicatedPartitions": self.last_urps,
+            "baselineUnderReplicatedPartitions": self.baseline_urps or 0,
+            "numBackoffs": self.num_backoffs,
+            "numRecoveries": self.num_recoveries,
+        }
+
+
 class Executor:
     def __init__(
         self,
@@ -124,16 +302,31 @@ class Executor:
         removal_history_retention_ms: int = 1_209_600_000,
         demotion_history_retention_ms: int = 1_209_600_000,
         notifier=None,
+        journal: ExecutionJournal | None = None,
+        clock=None,
+        anomaly_sink=None,
     ):
         """notifier (reference ExecutorConfig executor.notifier.class): an
         object with on_execution_finished(result, uuid), called after every
-        execution — success, stop or abort."""
+        execution — success, stop or abort.
+
+        journal: durable execution journal (executor/journal.py); an
+        unfinished execution found in it is reconciled immediately (see
+        class docstring) and the executor starts in RECOVERING state.
+        clock: ms-epoch callable — reservation retention and wall
+        timestamps ride it, so simulated runs and tests control time.
+        anomaly_sink: callable(Anomaly) the stuck-move reaper reports
+        EXECUTION_STUCK through (the facade wires the anomaly detector's
+        add_anomaly here)."""
         from cruise_control_tpu.common.sensors import REGISTRY
 
         self.sensors = sensors if sensors is not None else REGISTRY
         self.admin = admin
         self.strategy = strategy
         self.notifier = notifier
+        self.journal = journal
+        self.anomaly_sink = anomaly_sink
+        self._clock = clock or (lambda: int(time.time() * 1000))
         self.topic_names = topic_names or {}
         #: ClusterCatalog resolving global partition ids -> (topic, partition)
         self.catalog = catalog
@@ -141,7 +334,7 @@ class Executor:
         self._stop_requested = False
         self._force_stop = False
         self._lock = threading.RLock()
-        self.tracker = ExecutionTaskTracker()
+        self.tracker = ExecutionTaskTracker(observer=self._journal_task)
         self._planner: ExecutionTaskPlanner | None = None
         # reference Executor recentlyRemovedBrokers / recentlyDemotedBrokers,
         # timestamped so entries expire after the configured retention
@@ -163,6 +356,241 @@ class Executor:
         #: POST /admin.  Consulted every tick; cleared when a new
         #: execution starts so submitted options apply fresh.
         self._requested: dict[str, float | int] = {}
+        #: journal-recovered (topic_id, partition_id) -> (name, number)
+        #: key mapping — a fresh process has no catalog for proposals it
+        #: did not plan itself
+        self._key_override: dict[tuple[int, int], tuple[str, int]] = {}
+        #: live ConcurrencyAdjuster of the ongoing execution (None outside)
+        self._adjuster: ConcurrencyAdjuster | None = None
+        #: recovery report of the last journal reconciliation (see
+        #: executor_state()["recovery"]); None when the journal was clean
+        self._recovery: dict | None = None
+        #: stashed remainder of a reconciled execution, consumed by
+        #: resume_recovered_execution()
+        self._resume_state: tuple | None = None
+        if journal is not None:
+            self._reconcile_journal()
+
+    # ------------------------------------------------------------------
+    # journal hooks
+
+    def _journal_task(self, task: ExecutionTask, state: TaskState, now_ms: int):
+        if self.journal is not None:
+            self.journal.append(
+                {"t": "task", "id": task.execution_id, "state": state.value,
+                 "ms": now_ms}
+            )
+
+    def _journal_reservations(self):
+        if self.journal is not None:
+            self.journal.append({
+                "t": "reservation",
+                "removed": {str(b): ms for b, ms in self._removed_history.items()},
+                "demoted": {str(b): ms for b, ms in self._demoted_history.items()},
+            })
+
+    # ------------------------------------------------------------------
+    # restart reconciliation (journal replay)
+
+    def _reconcile_journal(self):
+        """Replay the journal; reconcile an unfinished execution against
+        the live cluster.  Runs on construction — cheap (one topology
+        fetch + one in-progress listing); the long part (driving the
+        remainder) is resume_recovered_execution()."""
+        je = self.journal.unfinished_execution()
+        if je is None:
+            return
+        rec_c = lambda name: self.sensors.counter(f"executor.recovery.{name}")  # noqa: E731
+        rec_c("executions-recovered").inc()
+        now = self._clock()
+        self._uuid = je.uuid
+        # 1. reservations: removed/demoted broker history survives the crash
+        self._removed_history.update(je.removed)
+        self._demoted_history.update(je.demoted)
+        restored = len(je.removed) + len(je.demoted)
+        if restored:
+            rec_c("reservations-restored").inc(restored)
+        # 2. throttle sweep: a crashed predecessor cannot have cleared its
+        # replication throttle — remove it before resuming (or finishing)
+        swept = False
+        if je.throttle_active:
+            try:
+                self.admin.clear_replication_throttle()
+                swept = True
+                rec_c("throttles-swept").inc()
+            except Exception:  # noqa: BLE001 — an unreachable admin must not
+                # kill construction; the journal keeps showing the throttle
+                # active so the NEXT restart retries the sweep
+                pass
+            if swept:
+                # journal only a sweep that actually reached the brokers —
+                # recording a failed one would make the leak permanently
+                # invisible to future recoveries
+                self.journal.append({"t": "throttle_cleared"})
+        # 3. task reconciliation against live topology + controller state
+        topo = self.admin.topology()
+        placement = {
+            (p.topic, p.partition): set(p.replicas) for p in topo.partitions
+        }
+        leaders = {(p.topic, p.partition): p.leader for p in topo.partitions}
+        in_prog = self.admin.in_progress_reassignments()
+        logdir_pending = (
+            self.admin.in_progress_logdir_moves()
+            if hasattr(self.admin, "in_progress_logdir_moves")
+            else set()
+        )
+        self.tracker = ExecutionTaskTracker(observer=self._journal_task)
+        adopted: dict[tuple[str, int], ExecutionTask] = {}
+        adopted_intra: dict[int, tuple[ExecutionTask, dict]] = {}
+        pending: list[ExecutionTask] = []
+        counts = {"completed": 0, "readopted": 0, "resubmitted": 0}
+        for task, key in je.tasks.values():
+            self._key_override[(task.proposal.topic, task.proposal.partition)] = key
+            self.topic_names.setdefault(task.proposal.topic, key[0])
+            if task.state in _TERMINAL:
+                self.tracker.add(task)
+                continue
+            if task.state == TaskState.ABORTING:
+                # the reaper / a forced stop was cancelling this move when
+                # the process died: finalize the cancellation — whether or
+                # not the move landed meanwhile, it must NOT be resubmitted
+                # (and COMPLETED is not a legal transition out of ABORTING)
+                task.aborted(now)
+                self.tracker.add(task)
+                continue
+            if task.task_type == TaskType.INTER_BROKER_REPLICA_ACTION:
+                if placement.get(key) == set(task.proposal.new_replicas):
+                    self._force_complete(task, now)
+                    counts["completed"] += 1
+                elif key in in_prog:
+                    # still moving on the controller: re-adopt, never
+                    # resubmit.  The CONTROLLER is the authority here, not
+                    # the journaled state — crash truncation may have torn
+                    # off the IN_PROGRESS record of a move that did reach
+                    # the wire, and resubmitting it would double-submit
+                    if task.state != TaskState.IN_PROGRESS:
+                        task.state = TaskState.IN_PROGRESS
+                        task.start_time_ms = now
+                    adopted[key] = task
+                    counts["readopted"] += 1
+                else:
+                    # vanished (controller dropped it) or never submitted:
+                    # back to PENDING; the resumed loop re-submits it — and
+                    # its dead-broker sweep DEAD-marks it if the
+                    # destination died while we were down
+                    task.state = TaskState.PENDING
+                    pending.append(task)
+                    if task.start_time_ms >= 0:
+                        counts["resubmitted"] += 1
+            elif task.task_type == TaskType.LEADER_ACTION:
+                if leaders.get(key) == task.proposal.new_leader:
+                    self._force_complete(task, now)
+                    counts["completed"] += 1
+                else:
+                    task.state = TaskState.PENDING
+                    pending.append(task)
+            else:  # intra-broker logdir copy
+                keys3 = {
+                    (key[0], key[1], b): d_new
+                    for (b, _d_old, d_new) in task.proposal.disk_moves
+                }
+                verify = getattr(self.admin, "logdir_of", None)
+                still = {k3: d for k3, d in keys3.items() if k3 in logdir_pending}
+                if still:
+                    # copies live on the broker win over the journaled
+                    # state (same torn-record reasoning as inter-broker)
+                    if task.state != TaskState.IN_PROGRESS:
+                        task.state = TaskState.IN_PROGRESS
+                        task.start_time_ms = now
+                    adopted_intra[task.execution_id] = (task, dict(keys3))
+                    counts["readopted"] += 1
+                elif (
+                    task.state == TaskState.IN_PROGRESS
+                    and verify is not None
+                    and all(verify(*k3) == d for k3, d in keys3.items())
+                ):
+                    self._force_complete(task, now)
+                    counts["completed"] += 1
+                else:
+                    task.state = TaskState.PENDING
+                    pending.append(task)
+                    if task.start_time_ms >= 0:
+                        counts["resubmitted"] += 1
+            self.tracker.add(task)
+        for name, n in counts.items():
+            if n:
+                rec_c(f"tasks-{name}").inc(n)
+        self._recovery = {
+            "uuid": je.uuid,
+            "recoveredMs": now,
+            "sweptThrottle": swept,
+            "restoredReservations": restored,
+            "tasksCompletedWhileDown": counts["completed"],
+            "tasksReadopted": counts["readopted"],
+            "tasksResubmitted": counts["resubmitted"],
+            "tasksPending": len(pending),
+        }
+        options = ExecutionOptions(**{
+            k: v
+            for k, v in je.options.items()
+            if k in {f.name for f in dataclasses.fields(ExecutionOptions)}
+        })
+        if pending or adopted or adopted_intra:
+            self.state = ExecutorState.RECOVERING
+            self._resume_state = (options, adopted, adopted_intra, je.adaptive)
+        else:
+            # everything landed (or died) while we were down: finish the
+            # recovered execution right here
+            self._finish_execution(self._result(ticks=0), je.uuid)
+
+    def _force_complete(self, task: ExecutionTask, now: int):
+        """Reconciliation found the task's target already live."""
+        if task.state == TaskState.PENDING:
+            task.in_progress(now)
+        task.completed(now)
+
+    @property
+    def has_recovered_execution(self) -> bool:
+        """True while a reconciled execution awaits resume_recovered_execution."""
+        with self._lock:
+            return self._resume_state is not None
+
+    def recovery_info(self) -> dict | None:
+        with self._lock:
+            return dict(self._recovery) if self._recovery else None
+
+    def resume_recovered_execution(self) -> ExecutionResult | None:
+        """Drive the reconciled remainder of a recovered execution to
+        completion: re-adopted moves keep progressing without re-submission,
+        pending/vanished ones flow through the normal drain.  Returns None
+        when there is nothing to resume."""
+        with self._lock:
+            if self._resume_state is None:
+                return None
+            options, adopted, adopted_intra, adaptive = self._resume_state
+            self._resume_state = None
+            # do NOT reset _stop_requested/_force_stop: an operator stop
+            # issued while the executor sat RECOVERING must be honored —
+            # the loop below then drains (or force-cancels) the adopted
+            # moves instead of driving the recovery to completion
+            self.num_executions_started += 1
+            self.sensors.counter("executor.execution-started").inc()
+            planner = ExecutionTaskPlanner(self.strategy)
+            planner.adopt_tasks(self.tracker.tasks(state=TaskState.PENDING))
+            self._planner = planner
+            self._reexecutions = {}
+            self._intra_unknown = {}
+        live_proposals = [
+            t.proposal for t in self.tracker.tasks() if t.state not in _TERMINAL
+        ]
+        result = self._run_guarded(
+            options,
+            live_proposals,
+            in_flight=adopted,
+            intra_in_flight=adopted_intra,
+            adaptive_initial=(adaptive or {}).get("inter"),
+        )
+        return result
 
     # ------------------------------------------------------------------
     # mid-execution concurrency control (reference Executor.java:485-510,
@@ -245,7 +673,7 @@ class Executor:
         # readers run on HTTP/detector threads while the execution thread
         # inserts under the lock — prune must take it too
         with self._lock:
-            cutoff = int(time.time() * 1000) - retention_ms
+            cutoff = self._clock() - retention_ms
             for b in [b for b, ts in history.items() if ts < cutoff]:
                 del history[b]
             return set(history)
@@ -265,11 +693,13 @@ class Executor:
         with self._lock:
             for b in broker_ids:
                 self._removed_history.pop(b, None)
+            self._journal_reservations()
 
     def drop_demoted_brokers(self, broker_ids):
         with self._lock:
             for b in broker_ids:
                 self._demoted_history.pop(b, None)
+            self._journal_reservations()
 
     @property
     def has_ongoing_execution(self) -> bool:
@@ -314,37 +744,103 @@ class Executor:
             self.num_executions_started += 1
             # reference Executor execution-started sensor (:118-125)
             self.sensors.counter("executor.execution-started").inc()
-            now = int(time.time() * 1000)
+            now = self._clock()
             for b in removed_brokers or ():
                 self._removed_history[b] = now
             for b in demoted_brokers or ():
                 self._demoted_history[b] = now
-            self.tracker = ExecutionTaskTracker()
+            self.tracker = ExecutionTaskTracker(observer=self._journal_task)
             self._reexecutions = {}
             self._intra_unknown = {}
             self._requested = {}  # overrides die with the previous execution
+            self._recovery = None
             self._planner = ExecutionTaskPlanner(strategy or self.strategy)
             tasks = self._planner.add_execution_proposals(proposals, strategy_context)
             for t in tasks:
                 self.tracker.add(t)
+            if self.journal is not None:
+                # durable BEFORE the first cluster mutation: a crash at any
+                # later point finds every task + reservation in the journal
+                self.journal.start_execution({
+                    "uuid": uuid,
+                    "ms": now,
+                    "options": dataclasses.asdict(options),
+                    "tasks": [
+                        task_to_journal(t, self._partition_key(t.proposal))
+                        for t in tasks
+                    ],
+                    "removed": {
+                        str(b): ms for b, ms in self._removed_history.items()
+                    },
+                    "demoted": {
+                        str(b): ms for b, ms in self._demoted_history.items()
+                    },
+                })
+        return self._run_guarded(options, proposals)
 
+    def _run_guarded(
+        self,
+        options: ExecutionOptions,
+        proposals,
+        *,
+        in_flight=None,
+        intra_in_flight=None,
+        adaptive_initial: int | None = None,
+    ) -> ExecutionResult:
+        """Throttle lifecycle + state reset around the execution loop, in
+        try/finally so no exit path — exception included — leaks a
+        replication throttle onto the brokers or wedges the executor state."""
         throttle = ReplicationThrottleHelper(
-            self.admin, options.replication_throttle_bytes_per_s
+            self.admin, options.replication_throttle_bytes_per_s,
+            journal=self.journal,
         )
-        throttle.set_throttles(proposals, self.topic_names)
+        uuid = self._uuid
         try:
-            result = self._run(options)
+            throttle.set_throttles(proposals, self.topic_names)
+            result = self._run(
+                options, in_flight=in_flight, intra_in_flight=intra_in_flight,
+                adaptive_initial=adaptive_initial,
+            )
         finally:
-            throttle.clear_throttles()
-            with self._lock:
-                self.state = ExecutorState.NO_TASK_IN_PROGRESS
-                self._planner = None
+            try:
+                throttle.clear_throttles()
+            finally:
+                with self._lock:
+                    self.state = ExecutorState.NO_TASK_IN_PROGRESS
+                    self._planner = None
+                    self._adjuster = None
+        self._finish_execution(result, uuid)
+        return result
+
+    def _result(self, *, ticks: int) -> ExecutionResult:
+        return ExecutionResult(
+            completed=self.tracker.count(state=TaskState.COMPLETED),
+            aborted=self.tracker.count(state=TaskState.ABORTED),
+            dead=self.tracker.count(state=TaskState.DEAD),
+            ticks=ticks,
+            stopped=self._stop_requested,
+            tracker_status=self.tracker.status(),
+        )
+
+    def _finish_execution(self, result: ExecutionResult, uuid: str | None):
+        if self.journal is not None:
+            self.journal.append({
+                "t": "finished",
+                "ms": self._clock(),
+                "result": {
+                    "completed": result.completed,
+                    "aborted": result.aborted,
+                    "dead": result.dead,
+                    "stopped": result.stopped,
+                },
+            })
+        with self._lock:
+            self.state = ExecutorState.NO_TASK_IN_PROGRESS
         if self.notifier is not None:
             try:
                 self.notifier.on_execution_finished(result, uuid)
             except Exception:  # noqa: BLE001 — a broken notifier must not fail the execution
                 pass
-        return result
 
     # ------------------------------------------------------------------
 
@@ -367,25 +863,114 @@ class Executor:
             except Exception:  # noqa: BLE001 — a broken notifier must not fail the execution
                 pass
 
-    def _run(self, options: ExecutionOptions) -> ExecutionResult:
+    def _reap_stuck_move(
+        self, task, key, in_flight, watermark, now: int, stalled_ms: int
+    ):
+        """Stuck-move reaper enforcement: cancel the wedged reassignment —
+        per-partition rollback where the controller supports it, DEAD
+        otherwise — journal it, raise EXECUTION_STUCK, and let the rest of
+        the batch keep flowing."""
+        cancel = getattr(self.admin, "cancel_partition_reassignments", None)
+        rolled_back = False
+        if cancel is not None:
+            try:
+                cancel([key])
+                rolled_back = True
+            except Exception:  # noqa: BLE001 — an uncancellable move still
+                # must not wedge the batch; fall through to DEAD
+                rolled_back = False
+        if rolled_back:
+            task.aborting(now)
+            task.aborted(now)
+            self.sensors.counter("executor.reaper.rollback").inc()
+        else:
+            task.kill(now)
+        del in_flight[key]
+        watermark.pop(key, None)
+        self.sensors.counter("executor.reaper.stuck-task").inc()
+        if self.journal is not None:
+            self.journal.append({
+                "t": "reaped",
+                "id": task.execution_id,
+                "mode": "rollback" if rolled_back else "dead",
+                "ms": now,
+            })
+        if self.anomaly_sink is not None:
+            from cruise_control_tpu.detector.anomalies import ExecutionStuck
+
+            try:
+                self.anomaly_sink(ExecutionStuck(
+                    topic=key[0],
+                    partition=key[1],
+                    execution_id=task.execution_id,
+                    uuid=self._uuid or "",
+                    stalled_s=stalled_ms / 1000.0,
+                    rolled_back=rolled_back,
+                ))
+            except Exception:  # noqa: BLE001 — anomaly delivery is best-effort
+                pass
+
+    def _run(
+        self,
+        options: ExecutionOptions,
+        *,
+        in_flight: dict[tuple[str, int], ExecutionTask] | None = None,
+        intra_in_flight: dict | None = None,
+        adaptive_initial: int | None = None,
+    ) -> ExecutionResult:
         """The proposal execution loop (reference ProposalExecutionRunnable.run:749):
-        phase 1 — inter/intra-broker replica moves; phase 2 — leadership."""
+        phase 1 — inter/intra-broker replica moves; phase 2 — leadership.
+
+        in_flight / intra_in_flight: moves re-adopted by restart
+        reconciliation — tracked to completion without re-submission.
+        adaptive_initial: journaled adaptive cap a resumed execution picks
+        back up — a cluster that was stressed moments before the crash
+        must not be re-hit at full base concurrency."""
         planner = self._planner
         assert planner is not None
-        in_flight: dict[tuple[str, int], ExecutionTask] = {}
+        in_flight = in_flight if in_flight is not None else {}
         #: intra-broker tasks still copying between logdirs:
         #: execution id -> (task, {(topic, partition, broker): target disk})
-        intra_in_flight: dict[
-            int, tuple[ExecutionTask, dict[tuple[str, int, int], int]]
-        ] = {}
+        intra_in_flight = intra_in_flight if intra_in_flight is not None else {}
         ticks = 0
         simulated = hasattr(self.admin, "tick")
         # admins that cannot report logdir-copy progress complete intra
         # moves on submit (the pre-KIP-113 behavior)
         track_intra = hasattr(self.admin, "in_progress_logdir_moves")
+        # stuck-move reaper state: key -> (last observed remaining bytes,
+        # last progress ms).  remaining-bytes sampling is an optional admin
+        # capability; without it the watermark only advances on completion.
+        reap_timeout_ms = (
+            int(options.reaper_stuck_timeout_s * 1000)
+            if options.reaper_stuck_timeout_s
+            else None
+        )
+        remaining_fn = getattr(self.admin, "reassignment_remaining_bytes", None)
+        watermark: dict[tuple[str, int], tuple[float | None, int]] = {}
+        adjuster = None
+        if options.adaptive_enabled:
+            adjuster = ConcurrencyAdjuster(
+                base_inter=self._inter_cap(options),
+                base_cluster=options.max_num_cluster_movements,
+                min_cap=options.adaptive_min_concurrency,
+                max_cap=options.adaptive_max_concurrency,
+                backoff_factor=options.adaptive_backoff_factor,
+                recover_step=options.adaptive_recover_step,
+                urp_slack=options.adaptive_urp_slack,
+                stall_ticks=options.adaptive_stall_ticks,
+                initial=adaptive_initial,
+                sensors=self.sensors,
+                journal=self.journal,
+            )
+            self._adjuster = adjuster
 
         def now_ms() -> int:
-            return int(time.time() * 1000) if not simulated else ticks * 1000
+            return self._clock() if not simulated else ticks * 1000
+
+        # intra-broker completions land AFTER the adjuster's observe() in
+        # the tick that collects them — carried into the next tick so an
+        # intra-heavy execution is not falsely judged throughput-stalled
+        carried_completions = 0
 
         # --- phase 1: replica movements ---
         self.state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
@@ -419,6 +1004,8 @@ class Executor:
             # is a wire Metadata round trip)
             topo = self.admin.topology()
             placement = None
+            completed_this_tick = carried_completions
+            carried_completions = 0
             for key, task in list(in_flight.items()):
                 if key not in in_progress:
                     if placement is None:
@@ -429,11 +1016,14 @@ class Executor:
                     if placement.get(key) == set(task.proposal.new_replicas):
                         task.completed(now_ms())
                         del in_flight[key]
+                        watermark.pop(key, None)
+                        completed_this_tick += 1
                         continue
                     n = self._reexecutions.get(key, 0)
                     if n >= options.max_reexecution_attempts:
                         task.kill(now_ms())
                         del in_flight[key]
+                        watermark.pop(key, None)
                         continue
                     self._reexecutions[key] = n + 1
                     # reference Executor sensor analog for re-executed tasks
@@ -454,12 +1044,32 @@ class Executor:
                         options,
                         now_ms(),
                     )
+            # stuck-move reaper: a move whose progress watermark stalls past
+            # the timeout is cancelled (rollback where supported, else DEAD)
+            # instead of holding its concurrency slots until max_ticks
+            if reap_timeout_ms is not None and in_flight:
+                rem_bytes = remaining_fn() if remaining_fn is not None else {}
+                for key, task in list(in_flight.items()):
+                    if key not in in_progress:
+                        continue
+                    rem = rem_bytes.get(key)
+                    last_rem, last_ms = watermark.get(key, (None, now_ms()))
+                    if key not in watermark:
+                        watermark[key] = (rem, now_ms())
+                    elif rem is not None and (last_rem is None or rem < last_rem):
+                        watermark[key] = (rem, now_ms())  # progress observed
+                    elif now_ms() - last_ms >= reap_timeout_ms:
+                        self._reap_stuck_move(
+                            task, key, in_flight, watermark,
+                            now_ms(), now_ms() - last_ms,
+                        )
             # mark tasks dead when a destination broker died mid-move
             alive = topo.alive_broker_ids()
             for key, task in list(in_flight.items()):
                 if not set(task.proposal.new_replicas) <= alive:
                     task.kill(now_ms())
                     del in_flight[key]
+                    watermark.pop(key, None)
             # same sweep for logdir copies: a copy on a dead broker can
             # never confirm — without this the phase-1 loop would spin on
             # it until max_ticks
@@ -468,18 +1078,27 @@ class Executor:
                     t.kill(now_ms())
                     del intra_in_flight[eid]
 
+            # load-aware adaptive caps: sample stress, adjust (AIMD)
+            inter_cap = self._inter_cap(options)
+            cluster_cap = options.max_num_cluster_movements
+            if adjuster is not None:
+                inter_cap, cluster_cap = adjuster.observe(
+                    topo,
+                    completed=completed_this_tick,
+                    in_flight=len(in_flight) + len(intra_in_flight),
+                    base_inter=self._inter_cap(options),
+                )
+
             # drain new tasks within caps (per-broker AND the global
             # max.num.cluster.movements budget) — unless a graceful stop is
             # draining the in-flight set
             if self._stop_requested:
                 new_tasks, intra = [], []
             else:
-                ready = self._ready_brokers(options, in_flight, topo)
+                ready = self._ready_brokers(options, in_flight, topo, cap=inter_cap)
                 budget = max(
                     0,
-                    options.max_num_cluster_movements
-                    - len(in_flight)
-                    - len(intra_in_flight),
+                    cluster_cap - len(in_flight) - len(intra_in_flight),
                 )
                 new_tasks = planner.get_inter_broker_replica_movement_tasks(
                     ready, set(in_flight), max_total=budget
@@ -529,6 +1148,7 @@ class Executor:
                     })
                 else:
                     t.completed(now_ms())
+                    carried_completions += 1
             # intra-broker copy progress (reference ExecutorAdminUtils
             # DescribeLogDirs future replicas): a task completes when none
             # of its (t, p, broker) copies are still in flight; long slow
@@ -593,6 +1213,7 @@ class Executor:
                     if not pending:
                         t.completed(now_ms())
                         del intra_in_flight[eid]
+                        carried_completions += 1
                         continue
                     intra_in_flight[eid] = (t, pending)
                     self._maybe_alert_slow_task(
@@ -698,14 +1319,7 @@ class Executor:
             t.aborting(now_ms())
             t.aborted(now_ms())
 
-        return ExecutionResult(
-            completed=self.tracker.count(state=TaskState.COMPLETED),
-            aborted=self.tracker.count(state=TaskState.ABORTED),
-            dead=self.tracker.count(state=TaskState.DEAD),
-            ticks=ticks,
-            stopped=self._stop_requested,
-            tracker_status=self.tracker.status(),
-        )
+        return self._result(ticks=ticks)
 
     def _handle_stop(self, in_flight, now: int):
         """Graceful stop finishes nothing new; forced stop cancels in-flight
@@ -718,9 +1332,10 @@ class Executor:
             in_flight.clear()
 
     def _ready_brokers(
-        self, options: ExecutionOptions, in_flight, topo=None
+        self, options: ExecutionOptions, in_flight, topo=None, cap: int | None = None
     ) -> dict[int, int]:
-        cap = self._inter_cap(options)
+        if cap is None:
+            cap = self._inter_cap(options)
         if topo is None:
             topo = self.admin.topology()
         alive = topo.alive_broker_ids()
@@ -742,7 +1357,12 @@ class Executor:
     def _partition_key(self, proposal: ExecutionProposal) -> tuple[str, int]:
         """(topic name, partition number) for a proposal: the catalog maps
         the array model's global partition id; without one, proposal ids are
-        taken at face value (fixture-built proposals)."""
+        taken at face value (fixture-built proposals).  Journal-recovered
+        proposals carry their original keys (a fresh process has no catalog
+        for a predecessor's plan)."""
+        override = self._key_override.get((proposal.topic, proposal.partition))
+        if override is not None:
+            return override
         if self.catalog is not None:
             return self.catalog.partition_key(proposal.partition)
         return (
@@ -754,7 +1374,7 @@ class Executor:
 
     def executor_state(self) -> dict:
         """STATE endpoint payload (reference ExecutorState JSON)."""
-        return {
+        out = {
             "state": self.state.value,
             "numFinishedMovements": self.tracker.count(state=TaskState.COMPLETED),
             "numTotalMovements": len(self.tracker.tasks()),
@@ -772,3 +1392,10 @@ class Executor:
             # ExecutorState requested*MovementConcurrency fields)
             "requestedConcurrency": self.requested_concurrency(),
         }
+        adjuster = self._adjuster
+        if adjuster is not None:
+            out["adaptiveConcurrency"] = adjuster.state_json()
+        recovery = self.recovery_info()
+        if recovery is not None:
+            out["recovery"] = recovery
+        return out

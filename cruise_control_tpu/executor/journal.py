@@ -1,0 +1,287 @@
+"""Durable execution journal — crash-safe record of everything the
+executor does to the real cluster.
+
+Reference: executor/Executor.java persists executor state (ongoing
+execution, removed/demoted broker reservations) so a restarted process
+can reconcile instead of stranding in-flight reassignments; here the
+persistence is an append-only JSONL file (configurable via
+`executor.journal.dir`) because there is no ZooKeeper to lean on.
+
+Record stream per execution (one execution per file — `start_execution`
+truncates, because a finished predecessor has nothing left to recover):
+
+  {"t": "start", "uuid", "ms", "tasks": [...], "options": {...},
+   "removed": {...}, "demoted": {...}}       execution begins
+  {"t": "throttle_set", "rate", "topics"}    replication throttle applied
+  {"t": "task", "id", "state", "ms"}         every task state transition
+  {"t": "concurrency", "inter", "cluster"}   adaptive-cap change
+  {"t": "reaped", "id", "mode", "ms"}        stuck-move reaper action
+  {"t": "reservation", "removed", "demoted"} reservation map change
+  {"t": "throttle_cleared"}                  throttle removed
+  {"t": "finished", "ms", "result"}          execution completed cleanly
+
+Writes are batched then flush+fsync'd (`executor.journal.fsync.batch.size`;
+1 = every record is durable before the cluster mutation proceeds).  The
+`start`, throttle, `reaped` and `finished` records always fsync — they are
+the records recovery correctness depends on.  Replay tolerates a torn
+final line (the crash happened mid-write): decoding stops at the first
+malformed line and everything before it is trusted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
+
+#: record types that must be durable the moment they are appended,
+#: regardless of the fsync batch size
+_CRITICAL = frozenset({"start", "throttle_set", "throttle_cleared", "reaped",
+                       "finished"})
+
+
+def proposal_to_journal(p: ExecutionProposal) -> dict:
+    """Full round-trippable proposal encoding (the REST `to_json` drops
+    fields recovery needs to re-submit a move)."""
+    return {
+        "partition": int(p.partition),
+        "topic": int(p.topic),
+        "old_leader": int(p.old_leader),
+        "new_leader": int(p.new_leader),
+        "old_replicas": [int(b) for b in p.old_replicas],
+        "new_replicas": [int(b) for b in p.new_replicas],
+        "disk_moves": [[int(b), int(o), int(n)] for (b, o, n) in p.disk_moves],
+        "inter": float(p.inter_broker_data_to_move),
+        "intra": float(p.intra_broker_data_to_move),
+    }
+
+
+def proposal_from_journal(d: dict) -> ExecutionProposal:
+    return ExecutionProposal(
+        partition=d["partition"],
+        topic=d["topic"],
+        old_leader=d["old_leader"],
+        new_leader=d["new_leader"],
+        old_replicas=tuple(d["old_replicas"]),
+        new_replicas=tuple(d["new_replicas"]),
+        disk_moves=tuple((b, o, n) for b, o, n in d.get("disk_moves", ())),
+        inter_broker_data_to_move=d.get("inter", 0.0),
+        intra_broker_data_to_move=d.get("intra", 0.0),
+    )
+
+
+def task_to_journal(task: ExecutionTask, key: tuple[str, int]) -> dict:
+    return {
+        "id": int(task.execution_id),
+        "type": task.task_type.value,
+        "key": [key[0], int(key[1])],
+        "proposal": proposal_to_journal(task.proposal),
+    }
+
+
+def task_from_journal(d: dict) -> tuple[ExecutionTask, tuple[str, int]]:
+    task = ExecutionTask(
+        execution_id=d["id"],
+        proposal=proposal_from_journal(d["proposal"]),
+        task_type=TaskType(d["type"]),
+    )
+    return task, (d["key"][0], d["key"][1])
+
+
+class ExecutionJournal:
+    """Append-only JSONL journal with fsync'd batches.
+
+    Thread-safe: the execution loop, the reaper and mid-execution admin
+    calls may append concurrently.
+    """
+
+    def __init__(self, path: str, *, fsync_batch: int = 1):
+        self.path = os.path.abspath(os.path.expanduser(path))
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self.fsync_batch = max(1, int(fsync_batch))
+        self._lock = threading.Lock()
+        self._file = None  # opened lazily in append mode
+        self._pending = 0
+        self.records_written = 0
+        self.fsyncs = 0
+
+    # ------------------------------------------------------------- write
+
+    def _ensure_open(self):
+        if self._file is None:
+            # appending after a crash-torn tail would glue the new record
+            # onto the partial line and poison every record after it —
+            # truncate back to the last fully-valid record first
+            self._repair_torn_tail()
+            self._file = open(self.path, "a", encoding="utf-8")  # noqa: SIM115
+
+    def _repair_torn_tail(self):
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        good = 0
+        for line in data.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break  # torn final line
+            s = line.strip()
+            if s:
+                try:
+                    rec = json.loads(s)
+                except ValueError:
+                    break
+                if not isinstance(rec, dict) or "t" not in rec:
+                    break
+            good += len(line)
+        if good < len(data):
+            with open(self.path, "rb+") as f:
+                f.truncate(good)
+
+    def append(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":"))
+        with self._lock:
+            self._ensure_open()
+            self._file.write(line + "\n")
+            self._pending += 1
+            self.records_written += 1
+            if self._pending >= self.fsync_batch or record.get("t") in _CRITICAL:
+                self._fsync_locked()
+
+    def _fsync_locked(self):
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._pending = 0
+        self.fsyncs += 1
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._file is not None and self._pending:
+                self._fsync_locked()
+
+    def start_execution(self, record: dict) -> None:
+        """Begin a new execution: truncate (the previous execution either
+        finished or was already reconciled) and durably write the start
+        record before any cluster mutation happens."""
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+            self._file = open(self.path, "w", encoding="utf-8")  # noqa: SIM115
+            self._pending = 0
+        self.append(dict(record, t="start"))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                if self._pending:
+                    self._fsync_locked()
+                self._file.close()
+                self._file = None
+
+    # -------------------------------------------------------------- read
+
+    def replay(self) -> list[dict]:
+        """Decode the journal, tolerating crash truncation: a torn final
+        line (or any garbage after it) ends the replay; every record
+        before it is returned."""
+        records: list[dict] = []
+        try:
+            with open(self.path, encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break  # torn tail — trust only what decoded
+                    if not isinstance(rec, dict) or "t" not in rec:
+                        break
+                    records.append(rec)
+        except OSError:
+            return []
+        return records
+
+    def unfinished_execution(self) -> "JournaledExecution | None":
+        """The in-flight execution a crashed predecessor left behind, or
+        None when the journal is absent/empty/cleanly finished."""
+        records = self.replay()
+        if not records or records[0].get("t") != "start":
+            return None
+        if any(r.get("t") == "finished" for r in records):
+            return None
+        return JournaledExecution.from_records(records)
+
+
+@dataclasses.dataclass
+class JournaledExecution:
+    """Parsed view of an unfinished journal: the last-known state of every
+    task plus the side effects (throttle, reservations) still standing."""
+
+    uuid: str | None
+    options: dict
+    started_ms: int
+    #: execution id -> (task at its last journaled state, partition key)
+    tasks: dict[int, tuple[ExecutionTask, tuple[str, int]]]
+    removed: dict[int, int]  # broker id -> reservation ms
+    demoted: dict[int, int]
+    throttle_active: bool
+    throttled_topics: list[str]
+    #: last journaled adaptive caps (None = never adjusted)
+    adaptive: dict | None
+
+    @staticmethod
+    def from_records(records: list[dict]) -> "JournaledExecution":
+        start = records[0]
+        tasks: dict[int, tuple[ExecutionTask, tuple[str, int]]] = {}
+        for td in start.get("tasks", ()):
+            task, key = task_from_journal(td)
+            tasks[task.execution_id] = (task, key)
+        removed = {int(b): int(ms) for b, ms in start.get("removed", {}).items()}
+        demoted = {int(b): int(ms) for b, ms in start.get("demoted", {}).items()}
+        throttle_active = False
+        throttled: list[str] = []
+        adaptive = None
+        for rec in records[1:]:
+            t = rec.get("t")
+            if t == "task":
+                entry = tasks.get(rec.get("id"))
+                if entry is None:
+                    continue
+                task, _key = entry
+                # replay transitions WITHOUT the state machine's validity
+                # check: the journal is the authority on what happened
+                task.state = TaskState(rec["state"])
+                if task.state == TaskState.IN_PROGRESS:
+                    task.start_time_ms = rec.get("ms", -1)
+                elif task.state in (TaskState.COMPLETED, TaskState.ABORTED,
+                                    TaskState.DEAD):
+                    task.end_time_ms = rec.get("ms", -1)
+            elif t == "throttle_set":
+                throttle_active = True
+                throttled = list(rec.get("topics", ()))
+            elif t == "throttle_cleared":
+                throttle_active = False
+                throttled = []
+            elif t == "reservation":
+                removed = {int(b): int(ms)
+                           for b, ms in rec.get("removed", {}).items()}
+                demoted = {int(b): int(ms)
+                           for b, ms in rec.get("demoted", {}).items()}
+            elif t == "concurrency":
+                adaptive = {k: rec[k] for k in ("inter", "cluster") if k in rec}
+        return JournaledExecution(
+            uuid=start.get("uuid"),
+            options=start.get("options", {}),
+            started_ms=start.get("ms", 0),
+            tasks=tasks,
+            removed=removed,
+            demoted=demoted,
+            throttle_active=throttle_active,
+            throttled_topics=throttled,
+            adaptive=adaptive,
+        )

@@ -53,6 +53,17 @@ class ExecutionTaskPlanner:
         self._inter = self.strategy.order(self._inter, context)
         return all_tasks
 
+    def adopt_tasks(self, tasks: list[ExecutionTask], context: dict | None = None):
+        """Re-queue PRE-BUILT tasks (journal recovery): ids are preserved —
+        a recovered task must journal under the id it started with — and
+        the id counter jumps past them so later additions cannot collide."""
+        for t in tasks:
+            self._next_id = max(self._next_id, t.execution_id + 1)
+        self._inter += [t for t in tasks if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION]
+        self._intra += [t for t in tasks if t.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION]
+        self._leadership += [t for t in tasks if t.task_type == TaskType.LEADER_ACTION]
+        self._inter = self.strategy.order(self._inter, context)
+
     # ------------------------------------------------------------------
 
     @property

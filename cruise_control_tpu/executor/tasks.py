@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Callable
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 
@@ -50,6 +51,12 @@ class ExecutionTask:
     start_time_ms: int = -1
     end_time_ms: int = -1
     alert_time_ms: int = -1
+    #: called with (task, new_state, now_ms) after every transition — the
+    #: executor's durable-journal hook (executor/journal.py); excluded from
+    #: equality/repr so tasks stay value-comparable in tests
+    observer: Callable | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def _transfer(self, target: TaskState, now_ms: int):
         if target not in _VALID_TRANSFER[self.state]:
@@ -59,6 +66,8 @@ class ExecutionTask:
             self.start_time_ms = now_ms
         if target in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
             self.end_time_ms = now_ms
+        if self.observer is not None:
+            self.observer(self, target, now_ms)
 
     def in_progress(self, now_ms: int):
         self._transfer(TaskState.IN_PROGRESS, now_ms)
@@ -90,12 +99,17 @@ class ExecutionTask:
 
 class ExecutionTaskTracker:
     """Counts tasks by (type, state) + data-movement progress
-    (reference executor/ExecutionTaskTracker.java:25)."""
+    (reference executor/ExecutionTaskTracker.java:25).
 
-    def __init__(self):
+    observer: installed on every tracked task (see ExecutionTask.observer)."""
+
+    def __init__(self, observer: Callable | None = None):
         self._tasks: dict[int, ExecutionTask] = {}
+        self._observer = observer
 
     def add(self, task: ExecutionTask):
+        if self._observer is not None:
+            task.observer = self._observer
         self._tasks[task.execution_id] = task
 
     def tasks(self, task_type: TaskType | None = None, state: TaskState | None = None):

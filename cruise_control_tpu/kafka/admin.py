@@ -101,6 +101,16 @@ class KafkaClusterAdmin:
                 {key: None for key in in_progress}
             )
 
+    def cancel_partition_reassignments(self, keys) -> None:
+        """Cancel INDIVIDUAL reassignments (KIP-455 null-replicas form):
+        each partition rolls back to its original replica set — the
+        stuck-move reaper's rollback path.  A move that completed between
+        observation and cancellation (NO_REASSIGNMENT_IN_PROGRESS) is not
+        an error: there is nothing left to cancel."""
+        self.client.alter_partition_reassignments(
+            {(k[0], k[1]): None for k in keys}
+        )
+
     def elect_leaders(self, specs: list[LeadershipSpec]) -> None:
         """Realize leadership moves: make the target the PREFERRED (first)
         replica, then run a preferred election (ExecutorUtils.scala:95).

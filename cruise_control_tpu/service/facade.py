@@ -124,6 +124,20 @@ class CruiseControl:
         #: paths in the pool register custom classes on first resolve
         self.allowed_strategies = set(config.get("replica.movement.strategies"))
         notifier_cls = config.get("executor.notifier.class")
+        # durable execution journal (crash-safe execution): constructing the
+        # Executor replays it and reconciles anything a crashed predecessor
+        # left in flight; start_up() resumes the remainder
+        journal = None
+        journal_dir = config.get("executor.journal.dir")
+        if journal_dir:
+            import os
+
+            from cruise_control_tpu.executor.journal import ExecutionJournal
+
+            journal = ExecutionJournal(
+                os.path.join(journal_dir, "execution-journal.jsonl"),
+                fsync_batch=config.get("executor.journal.fsync.batch.size"),
+            )
         self.executor = Executor(
             admin,
             strategy=resolve_strategy_chain(
@@ -138,7 +152,13 @@ class CruiseControl:
                 "demotion.history.retention.time.ms"
             ),
             notifier=notifier_cls() if notifier_cls is not None else None,
+            journal=journal,
         )
+        if self.executor.recovery_info() is not None:
+            log.warning(
+                "executor journal reconciliation: %s",
+                self.executor.recovery_info(),
+            )
         self._cache: _CachedResult | None = None
         self._cache_lock = threading.Lock()
         self._proposal_expiration_ms = config.get("proposal.expiration.ms")
@@ -176,6 +196,10 @@ class CruiseControl:
             sensors=self.sensors,
             history_size=config.get("num.cached.recent.anomaly.states"),
         )
+        # the stuck-move reaper reports EXECUTION_STUCK through the same
+        # queue every detector feeds, so the notifier (Slack included)
+        # alerts on wedged moves like any other anomaly
+        self.executor.anomaly_sink = self.anomaly_detector.add_anomaly
         self._wire_detectors()
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
@@ -381,6 +405,16 @@ class CruiseControl:
             detection_interval_s
             or self.config.get("anomaly.detection.interval.ms") / 1000.0
         )
+        if self.executor.has_recovered_execution:
+            # drive the journal-reconciled remainder off the startup path:
+            # re-adopted moves progress without resubmission while the
+            # service comes up (reference resumes its persisted execution
+            # the same way)
+            threading.Thread(
+                target=self.executor.resume_recovered_execution,
+                daemon=True,
+                name="executor-recovery",
+            ).start()
         if precompute:
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, daemon=True, name="proposal-precompute"
@@ -735,6 +769,22 @@ class CruiseControl:
                 "task.execution.alerting.threshold.ms"
             )
             / 1000.0,
+            reaper_stuck_timeout_s=(
+                self.config.get("executor.reaper.stuck.timeout.s")
+                if self.config.get("executor.reaper.enabled")
+                else None
+            ),
+            adaptive_enabled=self.config.get("executor.adaptive.enabled"),
+            adaptive_min_concurrency=self.config.get("executor.adaptive.min"),
+            adaptive_max_concurrency=self.config.get("executor.adaptive.max"),
+            adaptive_backoff_factor=self.config.get(
+                "executor.adaptive.backoff.factor"
+            ),
+            adaptive_recover_step=self.config.get(
+                "executor.adaptive.recover.step"
+            ),
+            adaptive_urp_slack=self.config.get("executor.adaptive.urp.slack"),
+            adaptive_stall_ticks=self.config.get("executor.adaptive.stall.ticks"),
         )
 
     def _build_options(

@@ -42,7 +42,12 @@ class RequestInfo:
     submitter: str
     status: ReviewStatus = ReviewStatus.PENDING_REVIEW
     reason: str = ""
+    #: wall clock, for DISPLAY only (SubmissionTimeMs in the board JSON)
     submitted_ms: int = dataclasses.field(default_factory=lambda: int(time.time() * 1000))
+    #: monotonic stamp driving retention — a backwards NTP step must not
+    #: immortalize a parked request (or expire a fresh one), same clock-skew
+    #: class the facade proposal cache fixed
+    submitted_mono: float = dataclasses.field(default_factory=time.monotonic)
 
     def to_json(self) -> dict:
         return {
@@ -66,11 +71,11 @@ class Purgatory:
         self.max_requests = max_requests
 
     def _prune_expired(self):
-        now = int(time.time() * 1000)
+        now = time.monotonic()
         for rid in [
             r.review_id
             for r in self._requests.values()
-            if now - r.submitted_ms > self.retention_ms
+            if (now - r.submitted_mono) * 1000.0 > self.retention_ms
         ]:
             del self._requests[rid]
 

@@ -799,6 +799,16 @@ class CruiseControlApp:
     # ------------------------------------------------------------------
 
     def start(self):
+        # crash-safe execution: an execution journal-reconciled at
+        # construction belongs in the operation audit trail — the operator
+        # reading it learns the service came up mid-rebalance and is
+        # resuming (the live detail rides /state ExecutorState.recovery)
+        recovery = self.cc.executor.recovery_info()
+        if recovery is not None:
+            OPERATION_LOGGER.warning(
+                "executor recovered in-flight execution from journal: %s",
+                recovery,
+            )
         app = self
 
         class Handler(BaseHTTPRequestHandler):

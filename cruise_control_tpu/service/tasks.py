@@ -24,7 +24,10 @@ class UserTask:
     endpoint: str
     future: Future
     progress: OperationProgress
-    created_ms: int
+    created_ms: int  # wall clock, for display (StartMs in the task JSON)
+    #: monotonic stamp driving completed-task retention (wall-clock steps
+    #: must not expire fresh tasks or immortalize old ones)
+    created_mono: float = dataclasses.field(default_factory=time.monotonic)
     request_url: str = ""
     #: requesting client identity (reference UserTaskInfo clientIdentity,
     #: filterable via USER_TASKS client_ids)
@@ -114,15 +117,16 @@ class UserTaskManager:
         return ENDPOINT_TYPES.get(task.endpoint)
 
     def _maybe_evict(self):
-        now = int(time.time() * 1000)
+        now = time.monotonic()
         completed = [t for t in self._tasks.values() if t.status != "Active"]
-        completed.sort(key=lambda t: t.created_ms)
+        completed.sort(key=lambda t: t.created_mono)
         # retention by age then by count, with per-category overrides
-        # (reference UserTaskManager scanner + UserTaskManagerConfig)
+        # (reference UserTaskManager scanner + UserTaskManagerConfig);
+        # ages are monotonic so wall-clock steps cannot mass-evict
         for t in completed:
             cat = self._category(t)
             retention = self.category_retention_ms.get(cat, self.completed_retention_ms)
-            if now - t.created_ms > retention:
+            if (now - t.created_mono) * 1000.0 > retention:
                 del self._tasks[t.task_id]
         for t in [t for t in completed if t.task_id in self._tasks]:
             cat = self._category(t)

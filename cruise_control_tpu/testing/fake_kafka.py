@@ -61,6 +61,10 @@ class FakeKafkaCluster:
         self.future_replicas: dict[int, dict[tuple[str, int], list]] = {}
         self._auto_complete_after: int | None = None
         self._list_polls = 0
+        #: reassignments frozen by stall_reassignment: they stay listed as
+        #: in-progress but complete_reassignments skips them (a wedged
+        #: follower that never catches up — stuck-move reaper fodder)
+        self.stalled: set[tuple[str, int]] = set()
         #: data plane: (topic, partition) -> [batch bytes]; offsets assigned
         #: at append like a real log
         self.logs: dict[tuple[str, int], list[bytes]] = {}
@@ -106,6 +110,8 @@ class FakeKafkaCluster:
         with self._lock:
             done = []
             for (t, pidx), replicas in list(self.reassignments.items()):
+                if (t, pidx) in self.stalled:
+                    continue
                 part = self.topics[t][pidx]
                 old = part["replicas"]
                 part["replicas"] = list(replicas)
@@ -128,6 +134,17 @@ class FakeKafkaCluster:
         calls — drives the executor's real progress-check loop."""
         self._auto_complete_after = polls
         self._list_polls = 0
+
+    def stall_reassignment(self, topic: str, partition: int) -> None:
+        """Freeze one reassignment: it stays in-progress (listed by
+        ListPartitionReassignments) but never completes until unstalled —
+        the wedged-move shape the executor's reaper exists for."""
+        with self._lock:
+            self.stalled.add((topic, partition))
+
+    def unstall_reassignment(self, topic: str, partition: int) -> None:
+        with self._lock:
+            self.stalled.discard((topic, partition))
 
     def kill_broker(self, broker_id: int) -> None:
         """Chaos: crash one broker — its listener closes (connections die),

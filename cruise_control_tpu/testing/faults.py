@@ -329,3 +329,59 @@ def kafka_connection_errors(client, *, schedule: FaultSchedule = ALWAYS):
         raising(lambda: ConnectionError("injected: connection reset by peer")),
         schedule=schedule,
     )
+
+
+# ----------------------------------------------------------------------
+# crash/restart + stall injection (crash-safe executor tests)
+# ----------------------------------------------------------------------
+
+
+class SimulatedProcessCrash(RuntimeError):
+    """Raised out of the executor's progress loop to model the process
+    dying mid-execution (kill -9, OOM-kill, node loss)."""
+
+
+@contextlib.contextmanager
+def process_crash(admin, *, on: str = "tick", schedule: FaultSchedule = ALWAYS):
+    """Model a HARD process crash mid-execution against `admin`.
+
+    The scheduled call to `admin.on` raises SimulatedProcessCrash — and for
+    the remainder of the context the dying process's outbound CLEANUP calls
+    (`clear_replication_throttle`, `cancel_reassignments`) ALSO raise it,
+    because a crashed process never reaches the cluster again: whatever
+    `finally` blocks the interpreter still runs must not tidy up state —
+    on the cluster OR in the journal — that a real kill -9 would have left
+    behind (leaked throttles, in-flight reassignments, no trailing journal
+    records).  The test catches the exception, abandons the "dead"
+    executor, and constructs a fresh one over the same journal to exercise
+    recovery.
+    """
+    crash = raising(lambda: SimulatedProcessCrash("injected crash"))
+    with method_fault(admin, on, crash, schedule=schedule) as log, \
+            method_fault(admin, "clear_replication_throttle", crash), \
+            method_fault(admin, "cancel_reassignments", crash):
+        yield log
+
+
+@contextlib.contextmanager
+def stalled_moves(admin, *keys):
+    """Freeze the given reassignments on a SimulatedClusterAdmin (or any
+    admin exposing stall/unstall): listed as in-progress forever, zero byte
+    progress — the shape the stuck-move reaper enforces against."""
+    admin.stall(*keys)
+    try:
+        yield
+    finally:
+        admin.unstall(*keys)
+
+
+def truncate_file(path: str, *, keep_bytes: int | None = None, drop_bytes: int = 0):
+    """Crash-truncate a journal: keep the first `keep_bytes` (or all minus
+    `drop_bytes`) — models fsync racing the crash, including a torn final
+    record."""
+    import os
+
+    size = os.path.getsize(path)
+    keep = keep_bytes if keep_bytes is not None else max(0, size - drop_bytes)
+    with open(path, "rb+") as f:
+        f.truncate(keep)

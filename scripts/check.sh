@@ -48,6 +48,14 @@ echo "== check.sh: fault supervision gate (degraded mode, breaker, harness) =="
 python -m pytest tests/test_faults.py -q
 faults_rc=$?
 
+echo "== check.sh: crash-safe execution gate (journal recovery, reaper, adaptive) =="
+# named gate: the kill-and-restart matrix (process crash mid-move /
+# mid-leadership / mid-logdir-copy, truncated-journal replay, stuck-move
+# reaper, adaptive-concurrency backoff) must hold regardless of what the
+# full suite ran — a regression here strands real reassignments.
+python -m pytest tests/test_executor_recovery.py -q
+recovery_rc=$?
+
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc faults=$faults_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc faults=$faults_rc recovery=$recovery_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ]
