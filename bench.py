@@ -702,7 +702,119 @@ def churn(smoke_mode: bool) -> int:
     return 0 if ok else 1
 
 
+def scenarios_bench(smoke_mode: bool) -> int:
+    """`bench.py --scenarios [--smoke]`: batched what-if evaluation gate.
+
+    Builds one base cluster and N what-if scenarios (rack loss, broker
+    adds, broker removals, topic load scaling) of ONE planned shape, then
+    scores them two ways: (a) ONE batched vmap program over the stacked
+    states — the planner's serving path — and (b) N sequential
+    single-state evaluations of the same jitted program.  Gate (--smoke,
+    wired into scripts/check.sh): the batched pass must be no slower than
+    the sequential pass (steady state, both warmed) and must produce
+    IDENTICAL per-scenario objectives — batching is a pure execution
+    detail, never a numerics change.
+    """
+    import jax
+
+    if smoke_mode:
+        jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.analyzer.scenario_eval import ScenarioEvaluator
+    from cruise_control_tpu.planner.scenario import (
+        BrokerAdd,
+        Scenario,
+        apply_scenario,
+        plan_shape,
+    )
+    from cruise_control_tpu.testing.fixtures import (
+        RandomClusterSpec,
+        random_cluster_fast,
+    )
+
+    if smoke_mode:
+        spec = RandomClusterSpec(
+            num_brokers=24, num_partitions=1500, num_racks=6, num_topics=12,
+            skew=0.8,
+        )
+        n_scenarios = 12
+        reps = 5
+    else:
+        spec = RandomClusterSpec(
+            num_brokers=500, num_partitions=50_000, num_racks=20,
+            num_topics=100, skew=0.5,
+        )
+        n_scenarios = 32
+        reps = 3
+    state = random_cluster_fast(spec, seed=7)
+    scenarios = []
+    for i in range(n_scenarios):
+        kind = i % 4
+        if kind == 0:
+            scenarios.append(Scenario(name=f"kill-rack-{i}", kill_racks=(i % spec.num_racks,)))
+        elif kind == 1:
+            scenarios.append(Scenario(name=f"add-{i}", add_brokers=(BrokerAdd(count=1 + i % 3),)))
+        elif kind == 2:
+            scenarios.append(Scenario(
+                name=f"remove-{i}", remove_brokers=(i % spec.num_brokers,)
+            ))
+        else:
+            scenarios.append(Scenario(
+                name=f"scale-{i}", topic_load_factors={i % spec.num_topics: 1.0 + 0.25 * (i % 5)}
+            ))
+    shape = plan_shape(state, scenarios)
+    if shape != state.shape:
+        from cruise_control_tpu.models.builder import pad_state
+
+        state = pad_state(state, shape)  # pad once: scenario states alias it
+    states = [apply_scenario(state, sc, shape=shape) for sc in scenarios]
+
+    ev = ScenarioEvaluator(max_scenarios=max(32, n_scenarios))
+    # warm both programs (compile outside the measurement: the gate is
+    # about serving, and one batch program amortizes like any engine)
+    ev.evaluate_states(states)
+    obj_seq_warm, _ = ev._evaluate_cpu(states[:1])  # noqa: F841 — warm cpu jit
+    t0 = time.monotonic()
+    for _ in range(reps):
+        batched_obj, batched_viol, _ = ev.evaluate_states(states)
+    batched_s = (time.monotonic() - t0) / reps
+
+    # sequential twin: same chain/constraint, one jitted single-state
+    # program reused across scenarios (its own best case)
+    import jax as _jax
+
+    def one(s):
+        obj, viol, _ = ev.chain.evaluate(s, constraint=ev.constraint)
+        return obj, viol
+
+    seq_fn = _jax.jit(one)
+    seq_fn(states[0])  # warm
+    t0 = time.monotonic()
+    for _ in range(reps):
+        seq = [_jax.device_get(seq_fn(s)) for s in states]
+    sequential_s = (time.monotonic() - t0) / reps
+    seq_obj = np.asarray([float(o) for o, _ in seq])
+
+    identical = bool(np.array_equal(batched_obj.astype(np.float32), seq_obj.astype(np.float32)))
+    ok = identical and batched_s <= sequential_s
+    _emit(
+        metric="scenario_batched_vs_sequential",
+        value=round(batched_s, 4),
+        unit="s",
+        vs_baseline=round(batched_s / max(sequential_s, 1e-9), 4),
+        scenarios=n_scenarios,
+        batched_wall_s=round(batched_s, 4),
+        sequential_wall_s=round(sequential_s, 4),
+        identical_objectives=identical,
+        max_objective_delta=float(np.abs(batched_obj - seq_obj).max()),
+        shape=dict(R=shape.R, B=shape.B, P=shape.P),
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 def main():
+    if "--scenarios" in sys.argv:
+        sys.exit(scenarios_bench("--smoke" in sys.argv))
     if "--churn" in sys.argv:
         sys.exit(churn("--smoke" in sys.argv))
     if "--smoke" in sys.argv:

@@ -12,6 +12,7 @@ from cruise_control_tpu.analyzer.objective import (
 from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, extract_proposals
+from cruise_control_tpu.analyzer.scenario_eval import ScenarioEvaluator, ScenarioOutcome
 
 __all__ = [
     "DEFAULT_CHAIN",
@@ -23,6 +24,8 @@ __all__ = [
     "OptimizationOptions",
     "OptimizerConfig",
     "OptimizerResult",
+    "ScenarioEvaluator",
+    "ScenarioOutcome",
     "balancedness_score",
     "extract_proposals",
 ]

@@ -41,6 +41,22 @@ def positive_int_param(value: str) -> str:
     return value
 
 
+def json_param(value: str) -> str:
+    """Inline JSON, or @path to read it from a file (scenario documents
+    are unwieldy on a command line)."""
+    if value.startswith("@"):
+        try:
+            with open(value[1:]) as f:
+                value = f.read()
+        except OSError as e:
+            raise argparse.ArgumentTypeError(f"cannot read {value[1:]!r}: {e}") from e
+    try:
+        json.loads(value)
+    except json.JSONDecodeError as e:
+        raise argparse.ArgumentTypeError(f"not valid JSON: {e}") from e
+    return value
+
+
 # ----------------------------------------------------------------------
 # endpoint model (reference Endpoint.py)
 # ----------------------------------------------------------------------
@@ -121,6 +137,21 @@ ENDPOINTS: dict[str, dict] = {
                "params": {"--approve": ("approve", csv_int_param),
                           "--discard": ("discard", csv_int_param),
                           "--reason": ("reason", str)}},
+    # scenario planner (read-only what-if analysis)
+    "simulate": {"method": "POST", "endpoint": "simulate",
+                 "params": {"--scenarios": ("scenarios", json_param),
+                            "--optimize": ("optimize", boolean_param),
+                            "--allow-capacity-estimation":
+                                ("allow_capacity_estimation", boolean_param),
+                            "--reason": ("reason", str),
+                            "--review-id": ("review_id", positive_int_param)},
+                 "required": ["--scenarios"]},
+    "rightsize": {"method": "GET", "endpoint": "rightsize",
+                  "params": {"--horizon-ms": ("horizon_ms", positive_int_param),
+                             "--min-brokers": ("min_brokers", positive_int_param),
+                             "--max-broker-factor": ("max_broker_factor", str),
+                             "--allow-capacity-estimation":
+                                 ("allow_capacity_estimation", boolean_param)}},
 }
 
 

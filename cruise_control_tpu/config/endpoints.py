@@ -10,12 +10,12 @@ through package __init__s).
 
 GET_ENDPOINTS = (
     "bootstrap", "train", "load", "partition_load", "proposals", "state",
-    "kafka_cluster_state", "user_tasks", "review_board",
+    "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
 )
 POST_ENDPOINTS = (
     "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
     "stop_proposal_execution", "pause_sampling", "resume_sampling",
-    "demote_broker", "admin", "review", "topic_configuration",
+    "demote_broker", "admin", "review", "topic_configuration", "simulate",
 )
 ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS
 
@@ -43,6 +43,9 @@ ENDPOINT_TYPES = {
     "admin": "CRUISE_CONTROL_ADMIN",
     "review": "CRUISE_CONTROL_ADMIN",
     "topic_configuration": "KAFKA_ADMIN",
+    # planner endpoints are read-only analysis over the monitor's model
+    "simulate": "KAFKA_MONITOR",
+    "rightsize": "KAFKA_MONITOR",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
 

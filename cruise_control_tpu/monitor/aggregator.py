@@ -92,6 +92,27 @@ class AggregationResult:
     completeness: MetricSampleCompleteness
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowedHistory:
+    """Read-only snapshot of the aggregator's completed windows.
+
+    The forecaster's (planner/forecast.py) input contract: per-entity
+    per-window aggregated values plus a completeness mask, WITHOUT the
+    extrapolation/validity policy aggregate() layers on top — a trend fit
+    wants raw observations and an honest "this cell was sampled" bit, and
+    it must not reach into the ring buffers (`_acc`/`_roll_to` slots are
+    private and move under the lock).
+    """
+
+    window_indices: np.ndarray  # i64[Wv] newest -> oldest
+    window_ms: int
+    values: np.ndarray  # f32[E, Wv, M] per-window values (strategy-reduced)
+    sample_counts: np.ndarray  # i32[E, Wv] samples behind each cell
+    complete: np.ndarray  # bool[E, Wv] cell met min_samples (no extrapolation)
+    entities: tuple  # row order of the E axis
+    generation: int
+
+
 class WindowedMetricSampleAggregator:
     """Dense ring-buffer aggregator over a dynamic entity set.
 
@@ -419,6 +440,44 @@ class WindowedMetricSampleAggregator:
                 extrapolation=ext,
                 entity_valid=entity_valid,
                 completeness=completeness,
+            )
+
+    def history_snapshot(self) -> WindowedHistory:
+        """Windowed-history snapshot for trend fitting (WindowedHistory).
+
+        Covers every COMPLETED window still in the ring (the in-progress
+        current window is excluded, like aggregate()), newest first.
+        Values are strategy-reduced (AVG divided by count, MAX/LATEST as
+        stored) but NOT extrapolated; `complete` marks cells that met
+        min_samples on their own.  All arrays are copies — safe to hold
+        across further sampling and window rolls.
+        """
+        with self._lock:
+            if self._current_window is None:
+                raise ValueError("no samples added yet")
+            E = len(self._entity_rows)
+            newest = self._current_window - 1
+            oldest = max(self._oldest_window or 0, newest - self.num_windows + 1)
+            if newest < oldest:
+                raise ValueError("no completed windows yet")
+            widx = np.arange(newest, oldest - 1, -1, np.int64)
+            slots = widx % self._W
+            values = self._acc[:E][:, slots].copy()  # [E, Wv, M]
+            counts = self._counts[:E][:, slots].copy()  # [E, Wv]
+            avg = self._strategies == 0
+            nonavg = np.nonzero(~avg)[0]
+            saved = values[:, :, nonavg].copy()
+            with np.errstate(invalid="ignore", divide="ignore"):
+                values /= np.maximum(counts[..., None], 1)
+            values[:, :, nonavg] = saved
+            return WindowedHistory(
+                window_indices=widx,
+                window_ms=self.window_ms,
+                values=values,
+                sample_counts=counts.astype(np.int32),
+                complete=counts >= self.min_samples,
+                entities=tuple(self._entity_rows),
+                generation=self._generation,
             )
 
     def entities(self) -> list:

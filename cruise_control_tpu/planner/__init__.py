@@ -1,0 +1,31 @@
+"""Scenario planner — batched what-if simulation, forecasting, rightsizing.
+
+A read-only subsystem beside monitor/analyzer/executor/detector: it
+answers "what happens if" (lose a rack, add brokers, traffic doubles)
+by editing the flattened cluster model (models/whatif.py), batch-scoring
+the hypotheticals on the same goal engine proposals use
+(analyzer/scenario_eval.py), extrapolating load from the monitor's
+windowed history (planner/forecast.py), and searching broker counts for
+the minimum footprint that satisfies every hard goal
+(planner/rightsizer.py).  Surfaced via POST /simulate and GET /rightsize.
+"""
+
+from cruise_control_tpu.planner.forecast import LoadForecaster, TopicTrend
+from cruise_control_tpu.planner.rightsizer import ProvisionStatus, Rightsizer
+from cruise_control_tpu.planner.scenario import (
+    BrokerAdd,
+    Scenario,
+    apply_scenario,
+    plan_shape,
+)
+
+__all__ = [
+    "BrokerAdd",
+    "LoadForecaster",
+    "ProvisionStatus",
+    "Rightsizer",
+    "Scenario",
+    "TopicTrend",
+    "apply_scenario",
+    "plan_shape",
+]

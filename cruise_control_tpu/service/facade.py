@@ -117,6 +117,32 @@ class CruiseControl:
             supervisor=self.supervisor,
             degraded_budget_s=config.get("tpu.supervisor.degraded.greedy.budget.s"),
         )
+        from cruise_control_tpu.analyzer.scenario_eval import ScenarioEvaluator
+        from cruise_control_tpu.planner.rightsizer import Rightsizer
+
+        #: scenario planner: batched what-if evaluation over the SAME goal
+        #: chain, constraint, supervisor, and optimizer (engine cache) the
+        #: proposal path uses — a simulated future and a real proposal are
+        #: scored by one code path
+        self.scenario_evaluator = ScenarioEvaluator(
+            chain=self.chain,
+            constraint=self.constraint,
+            optimizer=self.optimizer,
+            supervisor=self.supervisor,
+            sensors=self.sensors,
+            balancedness_weights=self.balancedness_weights,
+            # +1: simulate() rides a baseline scenario in every batch; a
+            # request of exactly planner.max.scenarios must not be pushed
+            # over the evaluator's limit by the rider
+            max_scenarios=config.get("planner.max.scenarios") + 1,
+        )
+        self.rightsizer = Rightsizer(
+            self.scenario_evaluator,
+            min_brokers=config.get("planner.rightsize.min.brokers"),
+            max_broker_factor=config.get("planner.rightsize.max.broker.factor"),
+            bucket=self.bucket_policy,
+            sensors=self.sensors,
+        )
         from cruise_control_tpu.executor.strategy import resolve_strategy_chain
 
         #: the configured strategy pool gates what requests may reference
@@ -1000,6 +1026,150 @@ class CruiseControl:
             progress.add_step(ExecutingProposals())
             r = self.executor.execute_proposals(proposals, self._exec_options())
             out["execution"] = {"completed": r.completed, "dead": r.dead}
+        return out
+
+    # ------------------------------------------------------------------
+    # scenario planner (read-only what-if analysis; planner/)
+    # ------------------------------------------------------------------
+
+    def simulate(
+        self,
+        progress: OperationProgress,
+        scenarios,
+        *,
+        optimize: bool | None = None,
+        allow_capacity_estimation: bool = True,
+    ) -> dict:
+        """Batch-evaluate what-if scenarios against the live model
+        (POST /simulate).  `scenarios`: planner.scenario.Scenario list (the
+        parameter layer parses the JSON).  Never touches the cluster."""
+        from cruise_control_tpu.planner.scenario import Scenario
+
+        t0 = time.monotonic()
+        if optimize is None:
+            optimize = self.config.get("planner.simulate.optimize.default")
+        scenarios = list(scenarios)
+        if len(scenarios) > self.config.get("planner.max.scenarios"):
+            raise ValueError(
+                f"{len(scenarios)} scenarios exceed planner.max.scenarios="
+                f"{self.config.get('planner.max.scenarios')}"
+            )
+        state = self._cluster_model(
+            progress, allow_capacity_estimation=allow_capacity_estimation
+        )
+        if optimize:
+            progress.add_step(
+                BatchedOptimization(self.optimizer.config.num_rounds)
+            )
+        with self.sensors.timer("planner.simulate-timer").time():
+            # the identity scenario rides the SAME batch so "vs today" in
+            # the response cannot drift from the mutated states' scoring;
+            # its optimize flag is False — the response never serializes a
+            # baseline fix, so annealing it would be a wasted full anneal
+            outcomes = self.scenario_evaluator.evaluate(
+                state,
+                [Scenario(name="__baseline__")] + scenarios,
+                self.monitor.last_catalog,
+                optimize=[False] + [bool(optimize)] * len(scenarios),
+                bucket=self.bucket_policy,
+            )
+        base, rest = outcomes[0], outcomes[1:]
+        return {
+            "scenarios": [o.to_json() for o in rest],
+            "baseline": {
+                "objective": base.objective,
+                "violatedGoals": list(base.violated_goals),
+                "balancedness": base.balancedness,
+                "brokersAlive": base.brokers_alive,
+            },
+            "degraded": any(o.degraded for o in outcomes),
+            "wallSeconds": round(time.monotonic() - t0, 3),
+        }
+
+    def _forecast_scenario(self, horizon_ms: int):
+        """Load Scenario at `horizon_ms` from the partition aggregator's
+        windowed history; None when too little history exists to trend."""
+        from cruise_control_tpu.planner.forecast import LoadForecaster
+
+        try:
+            history = self.monitor.partition_aggregator.history_snapshot()
+        except ValueError:
+            return None
+        forecaster = LoadForecaster(
+            method=self.config.get("planner.forecast.method"),
+            min_windows=self.config.get("planner.forecast.min.windows"),
+            max_factor=self.config.get("planner.forecast.max.factor"),
+        )
+        catalog = self.monitor.last_catalog
+        trends = forecaster.fit(
+            history,
+            self.monitor.partition_aggregator.metric_def,
+            catalog.topic_names_by_id() if catalog is not None else None,
+        )
+        if not trends:
+            return None
+        return forecaster.scenario_at(
+            trends, horizon_ms, history.window_ms, name=f"forecast+{horizon_ms}ms"
+        )
+
+    def rightsize(
+        self,
+        progress: OperationProgress,
+        *,
+        horizon_ms: int | None = None,
+        min_brokers: int | None = None,
+        max_broker_factor: float | None = None,
+        allow_capacity_estimation: bool = True,
+    ) -> dict:
+        """Minimum brokers satisfying all hard goals (GET /rightsize) —
+        Cruise Control's ProvisionStatus, answered by a monotone what-if
+        search.  With `horizon_ms`, the verdict is ALSO computed under the
+        forecast load at that horizon and reported under `forecast`."""
+        state = self._cluster_model(
+            progress, allow_capacity_estimation=allow_capacity_estimation
+        )
+        progress.add_step(BatchedOptimization(self.optimizer.config.num_rounds))
+        rs = self.rightsizer
+        if min_brokers is not None or max_broker_factor is not None:
+            from cruise_control_tpu.planner.rightsizer import Rightsizer
+
+            rs = Rightsizer(
+                self.scenario_evaluator,
+                min_brokers=min_brokers if min_brokers is not None else rs.min_brokers,
+                max_broker_factor=(
+                    max_broker_factor
+                    if max_broker_factor is not None
+                    else rs.max_broker_factor
+                ),
+                bucket=self.bucket_policy,
+                sensors=self.sensors,
+            )
+        max_anneals = self.config.get("planner.rightsize.max.anneals")
+        catalog = self.monitor.last_catalog
+        out = rs.rightsize(state, catalog, max_anneals=max_anneals)
+        # trend outlook at the CONFIGURED horizons (planner.forecast.
+        # horizons.ms): the fitted per-topic scale factors, no extra
+        # anneals — the full forecast VERDICT still needs an explicit
+        # horizon_ms (a search per horizon is an operator's choice to pay)
+        outlook = []
+        for h in self.config.get("planner.forecast.horizons.ms"):
+            sc = self._forecast_scenario(int(h))
+            if sc is not None:
+                outlook.append({"horizonMs": int(h), "scenario": sc.to_json()})
+        out["forecastOutlook"] = outlook
+        if horizon_ms is not None:
+            load_sc = self._forecast_scenario(horizon_ms)
+            if load_sc is None:
+                out["forecast"] = {
+                    "horizonMs": horizon_ms,
+                    "error": "not enough windowed history to fit a trend",
+                }
+            else:
+                fc = rs.rightsize(
+                    state, catalog, load_scenario=load_sc, max_anneals=max_anneals
+                )
+                fc["horizonMs"] = horizon_ms
+                out["forecast"] = fc
         return out
 
     def stop_proposal_execution(self, *, force: bool = False) -> dict:

@@ -103,6 +103,27 @@ def _min1_float(s: str):
     return v
 
 
+def _scenario_list(s: str):
+    """JSON scenario list for /simulate — validated structurally HERE so a
+    malformed scenario 400s before a cluster model is built for it."""
+    import json
+
+    from cruise_control_tpu.planner.scenario import Scenario
+
+    try:
+        raw = json.loads(s)
+    except json.JSONDecodeError as e:
+        raise ParameterError(f"scenarios is not valid JSON: {e}") from e
+    if isinstance(raw, dict):
+        raw = [raw]
+    if not isinstance(raw, list) or not raw:
+        raise ParameterError("scenarios must be a non-empty JSON list of objects")
+    try:
+        return [Scenario.from_json(d) for d in raw]
+    except (TypeError, ValueError, KeyError) as e:
+        raise ParameterError(f"bad scenario: {e}") from e
+
+
 # bounds MATCH server._parse_execution_overrides — the declared parser is
 # what custom request classes consume, so the two layers must agree
 _STRATEGIES = Param(
@@ -172,6 +193,19 @@ _RAW_PARAMETERS: dict[str, tuple] = {
         "topic_configuration": (Param("topic", str),
                                 Param("replication_factor", _int), _DRYRUN,
                                 _REVIEW_ID),
+        # --- scenario planner (read-only what-if analysis) ---
+        "simulate": (Param("scenarios", _scenario_list,
+                           "JSON list of scenario objects (see docs/rest-api.md)"),
+                     Param("optimize", _bool,
+                           "also run the full anneal per scenario (projected "
+                           "post-fix view; slower)"),
+                     Param("allow_capacity_estimation", _bool),
+                     _REVIEW_ID),
+        "rightsize": (Param("horizon_ms", _min1_int,
+                            "also rightsize at the load forecast this far out"),
+                      Param("min_brokers", _min1_int),
+                      Param("max_broker_factor", _min1_float),
+                      Param("allow_capacity_estimation", _bool)),
 }
 
 from cruise_control_tpu.config.endpoints import (  # noqa: E402

@@ -82,6 +82,29 @@ BROKER_LOAD_ITEM = Schema((
     Field("NW_OUT", NUM), Field("NW_OUTPct", NUM),
 ))
 
+#: one scenario's outcome in the /simulate response
+#: (analyzer/scenario_eval.py ScenarioOutcome.to_json)
+SCENARIO_OUTCOME_ITEM = Schema((
+    Field("name", STR),
+    Field("objective", NUM),
+    Field("violatedGoals", LIST),
+    Field("balancedness", NUM),
+    Field("hardGoalsSatisfied", BOOL),
+    Field("brokersAlive", NUM),
+    # present when optimize=true: OptimizerResult.summary() + hard-goal
+    # verdict for the projected post-fix cluster
+    Field("fix", DICT, required=False),
+))
+
+#: one annealed candidate in the /rightsize response
+RIGHTSIZE_CANDIDATE_ITEM = Schema((
+    Field("brokers", NUM),
+    Field("feasible", BOOL),
+    Field("violatedHardGoals", LIST),
+    Field("objectiveAfter", NUM),
+    Field("numMoves", NUM),
+))
+
 RESPONSE_SCHEMAS: dict[str, Schema] = {
     "state": Schema((
         Field("version", NUM, required=False),  # API-version negotiation
@@ -161,6 +184,36 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         # mid-execution concurrency change acknowledgment
         Field("requestedConcurrency", DICT, required=False),
         Field("ongoingExecution", BOOL, required=False),
+    )),
+    # --- scenario planner ---
+    "simulate": Schema((
+        Field("scenarios", LIST, item_schema=SCENARIO_OUTCOME_ITEM),
+        # the unmutated cluster scored the same way, for contrast
+        Field("baseline", DICT),
+        # true when the device breaker routed scoring through the CPU path
+        Field("degraded", BOOL),
+        Field("wallSeconds", NUM),
+        Field("_userTaskId", STR, required=False),
+    )),
+    "rightsize": Schema((
+        Field("provisionStatus", STR),
+        Field("currentBrokers", NUM),
+        Field("minBrokers", NUM),  # null when the search ended UNDECIDED
+        # UNDECIDED only: feasible count the unfinished search proved
+        Field("minBrokersUpperBound", NUM),
+        Field("searchedRange", LIST),
+        Field("annealsRun", NUM),
+        Field("undecided", BOOL),
+        Field("degraded", BOOL),
+        Field("preMoveViolations", DICT),
+        Field("candidates", LIST, item_schema=RIGHTSIZE_CANDIDATE_ITEM),
+        Field("loadScenario", DICT, required=False),
+        # fitted trend scenarios at the planner.forecast.horizons.ms
+        # horizons (no extra anneals; empty without enough history)
+        Field("forecastOutlook", LIST),
+        Field("forecast", DICT, required=False),
+        Field("wallSeconds", NUM),
+        Field("_userTaskId", STR, required=False),
     )),
 }
 

@@ -618,7 +618,80 @@ class CruiseControlApp:
             "train", lambda progress: runner.train(start, end)
         )
 
+    def _ep_rightsize(self, params) -> tuple[int, dict]:
+        """GET /rightsize — minimum brokers satisfying all hard goals at
+        current (and, with horizon_ms, forecast) load.  Read-only."""
+        allow_est = _parse_bool(params, "allow_capacity_estimation", True)
+
+        def _opt_num(name, cast, lo):
+            # bounds match the declared _min1_* parsers (parameters.py):
+            # a negative horizon would "forecast" backwards and a
+            # sub-1 factor degenerates the search ceiling silently
+            v = params.get(name, [None])[0]
+            if v is None:
+                return None
+            try:
+                v = cast(v)
+            except ValueError as e:
+                raise BadRequest(f"bad {name}: {e}") from e
+            if not v >= lo:
+                raise BadRequest(f"{name} must be >= {lo}, got {v}")
+            return v
+
+        horizon = _opt_num("horizon_ms", int, 1)
+        min_brokers = _opt_num("min_brokers", int, 1)
+        max_factor = _opt_num("max_broker_factor", float, 1)
+        return self._async_op(
+            "rightsize",
+            lambda progress: self.cc.rightsize(
+                progress,
+                horizon_ms=horizon,
+                min_brokers=min_brokers,
+                max_broker_factor=max_factor,
+                allow_capacity_estimation=allow_est,
+            ),
+        )
+
     # --- POST ---
+
+    def _ep_simulate(self, params) -> tuple[int, dict]:
+        """POST /simulate — batched what-if evaluation.  POST because the
+        scenario payload is a JSON document (rides the form body), but the
+        operation never mutates the cluster."""
+        from cruise_control_tpu.service.parameters import (
+            ParameterError,
+            _scenario_list,
+        )
+
+        raw = params.get("scenarios", [None])[0]
+        if raw is None:
+            raise BadRequest("missing parameter scenarios (JSON list)")
+        try:
+            scenarios = _scenario_list(raw)
+        except ParameterError as e:
+            raise BadRequest(str(e)) from e
+        cap = self.cc.config.get("planner.max.scenarios")
+        if len(scenarios) > cap:
+            # 400 HERE: an oversized batch is a client error, not a task
+            # failure surfaced as 500 after a cluster model was built
+            raise BadRequest(
+                f"{len(scenarios)} scenarios exceed planner.max.scenarios={cap}"
+            )
+        optimize = (
+            _parse_bool(params, "optimize", False)
+            if "optimize" in params
+            else None  # None -> planner.simulate.optimize.default
+        )
+        allow_est = _parse_bool(params, "allow_capacity_estimation", True)
+        return self._async_op(
+            "simulate",
+            lambda progress: self.cc.simulate(
+                progress,
+                scenarios,
+                optimize=optimize,
+                allow_capacity_estimation=allow_est,
+            ),
+        )
 
     def _ep_rebalance(self, params) -> tuple[int, dict]:
         dryrun = _parse_bool(params, "dryrun", True)

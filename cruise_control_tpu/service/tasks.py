@@ -25,9 +25,16 @@ class UserTask:
     future: Future
     progress: OperationProgress
     created_ms: int  # wall clock, for display (StartMs in the task JSON)
-    #: monotonic stamp driving completed-task retention (wall-clock steps
-    #: must not expire fresh tasks or immortalize old ones)
+    #: monotonic stamp of submission (wall-clock steps must not expire
+    #: fresh tasks or immortalize old ones)
     created_mono: float = dataclasses.field(default_factory=time.monotonic)
+    #: monotonic stamp of COMPLETION — retention counts from here, never
+    #: from creation.  A rightsize search (or any async op) that runs
+    #: longer than the retention window would otherwise expire the moment
+    #: it finished, 404ing the very poll that was waiting on it.  Stamped
+    #: by a future done-callback; None while the task is in execution
+    #: (in-execution tasks are exempt from retention altogether).
+    completed_mono: float | None = None
     request_url: str = ""
     #: requesting client identity (reference UserTaskInfo clientIdentity,
     #: filterable via USER_TASKS client_ids)
@@ -99,6 +106,12 @@ class UserTaskManager:
                 request_url=request_url,
                 client_id=client_id,
             )
+            # completion stamp for retention: set the moment the operation
+            # finishes, so the retention window starts when the RESULT
+            # became available, not when the task was born
+            future.add_done_callback(
+                lambda f, t=task: setattr(t, "completed_mono", time.monotonic())
+            )
             self._tasks[tid] = task
             self._maybe_evict()
             return task
@@ -119,14 +132,22 @@ class UserTaskManager:
     def _maybe_evict(self):
         now = time.monotonic()
         completed = [t for t in self._tasks.values() if t.status != "Active"]
-        completed.sort(key=lambda t: t.created_mono)
-        # retention by age then by count, with per-category overrides
-        # (reference UserTaskManager scanner + UserTaskManagerConfig);
-        # ages are monotonic so wall-clock steps cannot mass-evict
+        # a done-callback can race this scan by a hair (future done, stamp
+        # not yet written): treat the stamp as "now" — never older
+        for t in completed:
+            if t.completed_mono is None:
+                t.completed_mono = now
+        completed.sort(key=lambda t: t.completed_mono)
+        # retention by age-SINCE-COMPLETION then by count, with per-category
+        # overrides (reference UserTaskManager scanner +
+        # UserTaskManagerConfig); ages are monotonic so wall-clock steps
+        # cannot mass-evict.  Counting from completion (not creation) keeps
+        # a long-running async op — a rightsize search outlasting the
+        # retention window — pollable for the full window after it finishes.
         for t in completed:
             cat = self._category(t)
             retention = self.category_retention_ms.get(cat, self.completed_retention_ms)
-            if (now - t.created_mono) * 1000.0 > retention:
+            if (now - t.completed_mono) * 1000.0 > retention:
                 del self._tasks[t.task_id]
         for t in [t for t in completed if t.task_id in self._tasks]:
             cat = self._category(t)

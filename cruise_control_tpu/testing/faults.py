@@ -42,7 +42,10 @@ from cruise_control_tpu.common.device_watchdog import set_device_op_hook
 #: every engine-invocation op name (the probe is separate on purpose:
 #: error-class injectors must not break the recovery probe, only
 #: `device_wedged` models a device that fails the probe too)
-ENGINE_OPS = ("engine.run", "sharded.run", "grid.run", "portfolio.run")
+ENGINE_OPS = (
+    "engine.run", "sharded.run", "grid.run", "portfolio.run",
+    "scenario.batch-eval",
+)
 PROBE_OP = "probe"
 ALL_DEVICE_OPS = ENGINE_OPS + (PROBE_OP,)
 

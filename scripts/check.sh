@@ -40,6 +40,21 @@ echo "== check.sh: bench.py --churn --smoke (shape-bucketed serving, CPU) =="
 GRAFT_FORCE_CPU=1 python bench.py --churn --smoke
 churn_rc=$?
 
+echo "== check.sh: bench.py --scenarios --smoke (batched what-if evaluation, CPU) =="
+# named gate: one batched N-scenario evaluation must be no slower than N
+# sequential runs AND produce bit-identical per-scenario objectives —
+# batching is an execution detail of the planner, never a numerics change
+GRAFT_FORCE_CPU=1 python bench.py --scenarios --smoke
+scenarios_rc=$?
+
+echo "== check.sh: scenario planner gate (what-if parity, forecaster, rightsizer) =="
+# named gate: the identity-scenario byte parity, dead-rack/broker-add
+# semantics, engine-cache reuse across a scenario batch, and the
+# /simulate & /rightsize surfaces — regressions here mislead capacity
+# decisions silently
+python -m pytest tests/test_planner.py -q
+planner_rc=$?
+
 echo "== check.sh: fault supervision gate (degraded mode, breaker, harness) =="
 # named gate: every breaker transition / degraded proposal is pinned by
 # deterministic fault injection (testing/faults.py), never by a real TPU
@@ -57,5 +72,5 @@ python -m pytest tests/test_executor_recovery.py -q
 recovery_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc faults=$faults_rc recovery=$recovery_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ]

@@ -213,6 +213,45 @@ def test_full_stack_reporter_to_executor_round_trip():
         st, state, _ = req("GET", "state", substates="executor")
         assert state["ExecutorState"]["numFinishedMovements"] > 0
 
+        # --- scenario planner against the live fake cluster ---
+        def poll(method, ep, **params):
+            s, p, h = req(method, ep, **params)
+            t_id = h.get("User-Task-ID")
+            dl = time.time() + 180
+            while s == 202 and time.time() < dl:
+                time.sleep(0.5)
+                s, p, _ = req(method, ep, headers={"User-Task-ID": t_id}, **params)
+            return s, p
+
+        placement_before_planning = workload_placement()
+        # compact separators: the raw-URL helper does not percent-encode
+        scenarios = json.dumps([
+            {"name": "lose-a-broker", "removeBrokers": [3]},
+            {"name": "add-two", "addBrokers": [{"count": 2}]},
+            {"name": "t0-doubles", "topicLoadFactors": {"T0": 2.0}},
+        ], separators=(",", ":"))
+        status, sim = poll("POST", "simulate", scenarios=scenarios, optimize="true")
+        assert status == 200, sim
+        from cruise_control_tpu.service.schemas import validate_response
+
+        assert validate_response("simulate", sim) == []
+        by_name = {s["name"]: s for s in sim["scenarios"]}
+        base_alive = sim["baseline"]["brokersAlive"]
+        assert by_name["lose-a-broker"]["brokersAlive"] == base_alive - 1
+        assert by_name["add-two"]["brokersAlive"] == base_alive + 2
+        assert by_name["t0-doubles"]["objective"] >= sim["baseline"]["objective"]
+        assert by_name["lose-a-broker"]["fix"]["numReplicaMovements"] > 0
+
+        status, rsz = poll("GET", "rightsize")
+        assert status == 200, rsz
+        assert validate_response("rightsize", rsz) == []
+        assert rsz["currentBrokers"] == base_alive
+        assert rsz["provisionStatus"] in (
+            "RIGHT_SIZED", "OVER_PROVISIONED", "UNDER_PROVISIONED", "UNDECIDED"
+        )
+        # planning is READ-ONLY: the fake cluster's placement is untouched
+        assert workload_placement() == placement_before_planning
+
         # --- "restart": replay the sample store into a FRESH aggregator ---
         from cruise_control_tpu.monitor import (
             KAFKA_METRIC_DEF,

@@ -89,6 +89,35 @@ def test_openapi_spec_is_current():
         assert "post" in committed["paths"][f"/{ep}"]
 
 
+def test_openapi_paths_match_endpoint_tables_exactly():
+    """Bidirectional openapi <-> GET_ENDPOINTS/POST_ENDPOINTS drift gate:
+    the committed spec must cover EXACTLY the served endpoint set — no
+    endpoint missing from the spec, no ghost path lingering after an
+    endpoint is removed, no method served that the spec does not declare."""
+    import json
+
+    from cruise_control_tpu.config.endpoints import GET_ENDPOINTS, POST_ENDPOINTS
+
+    with open(os.path.join(REPO, "docs", "openapi.json")) as f:
+        spec = json.load(f)
+    served = {f"/{ep}" for ep in GET_ENDPOINTS} | {f"/{ep}" for ep in POST_ENDPOINTS}
+    assert set(spec["paths"]) == served, (
+        "docs/openapi.json paths drifted from config/endpoints.py — "
+        "run scripts/gen_api_spec.py"
+    )
+    for ep in GET_ENDPOINTS:
+        assert set(spec["paths"][f"/{ep}"]) >= {"get"}
+    for ep in POST_ENDPOINTS:
+        assert set(spec["paths"][f"/{ep}"]) >= {"post"}
+    # and no method is declared that the server does not dispatch
+    for path, ops in spec["paths"].items():
+        ep = path.lstrip("/")
+        for method in ops:
+            assert (method == "get" and ep in GET_ENDPOINTS) or (
+                method == "post" and ep in POST_ENDPOINTS
+            ), f"{method.upper()} {path} declared in the spec but not served"
+
+
 def test_service_boots_from_shipped_properties():
     """The start script's exact path: load the shipped properties, boot the
     service from them (simulated backend — no bootstrap.servers), serve a

@@ -82,6 +82,10 @@ CASES = [
     ("resume_sampling", "POST", {}),
     ("admin", "POST", {"enable_self_healing_for": "broker_failure"}),
     ("stop_proposal_execution", "POST", {}),
+    # compact JSON: the raw-URL helper does not percent-encode spaces
+    ("simulate", "POST",
+     {"scenarios": '[{"name":"add-one","addBrokers":[{"count":1}]}]'}),
+    ("rightsize", "GET", {}),
 ]
 
 

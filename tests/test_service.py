@@ -195,15 +195,16 @@ def test_unknown_endpoint_and_bad_params(service):
 
 def test_endpoint_surface_complete():
     """The reference exposes 9 GET + 11 POST endpoints
-    (CruiseControlEndPoint.java:16-37) — all must exist here."""
+    (CruiseControlEndPoint.java:16-37) — all must exist here, plus the
+    planner's read-only /rightsize (GET) and /simulate (POST)."""
     assert set(GET_ENDPOINTS) == {
         "bootstrap", "train", "load", "partition_load", "proposals", "state",
-        "kafka_cluster_state", "user_tasks", "review_board",
+        "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
     }
     assert set(POST_ENDPOINTS) == {
         "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
         "stop_proposal_execution", "pause_sampling", "resume_sampling",
-        "demote_broker", "admin", "review", "topic_configuration",
+        "demote_broker", "admin", "review", "topic_configuration", "simulate",
     }
 
 
@@ -794,6 +795,46 @@ def test_admin_drop_recently_demoted_brokers(service):
     assert status == 200
     assert 4 not in ex.demoted_brokers
     assert payload["recentlyDemotedBrokers"] == sorted(ex.demoted_brokers)
+
+
+def test_long_running_task_survives_retention_after_completion():
+    """Purgatory-retention audit for long-running async ops: a task whose
+    EXECUTION outlives the completed-task retention window (a rightsize
+    search, a big simulate batch) must stay pollable for the full window
+    AFTER completion — retention counts from completion, not creation.
+    Under the old creation-stamped retention the record expired the moment
+    it finished, 404ing the poll that was waiting on it."""
+    import threading
+
+    from cruise_control_tpu.service.tasks import UserTaskManager
+
+    utm = UserTaskManager(completed_retention_ms=150, max_cached_completed=10)
+    try:
+        gate = threading.Event()
+
+        def long_op(progress):
+            gate.wait(10)
+            return {"provisionStatus": "RIGHT_SIZED"}
+
+        task = utm.submit("rightsize", long_op)
+        time.sleep(0.4)  # run well past the 150ms retention window
+        # in-execution: eviction scans must never touch it
+        utm.submit("load", lambda p: {})
+        assert utm.get(task.task_id) is not None
+        gate.set()
+        task.future.result(timeout=10)
+        # freshly completed (older than retention since CREATION): an
+        # eviction scan must keep it — the client has not polled yet
+        utm.submit("load", lambda p: {})
+        resumed = utm.get(task.task_id)
+        assert resumed is not None, "completed task expired before it could be polled"
+        assert resumed.future.result()["provisionStatus"] == "RIGHT_SIZED"
+        # ...and once the window has passed SINCE COMPLETION it may expire
+        time.sleep(0.4)
+        utm.submit("load", lambda p: {})
+        assert utm.get(task.task_id) is None
+    finally:
+        utm.shutdown()
 
 
 def test_user_tasks_filters(service):
