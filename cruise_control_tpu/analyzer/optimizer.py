@@ -146,6 +146,8 @@ class GoalOptimizer:
         shape_bucket=None,
         supervisor=None,
         degraded_budget_s: float = 30.0,
+        tracer=None,
+        profiler_dir: str | None = None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (model sharded over every device,
@@ -176,7 +178,16 @@ class GoalOptimizer:
         result tagged degraded=True instead of hanging or failing; None
         (the default, offline/test usage) keeps the direct path with zero
         behavior change.  degraded_budget_s caps the greedy fallback's
-        wall clock (config tpu.supervisor.degraded.greedy.budget.s)."""
+        wall clock (config tpu.supervisor.degraded.greedy.budget.s).
+
+        tracer (config trace.*): flight-recorder Tracer every optimize
+        call opens an `analyzer.optimize` span on, with the run's timing
+        record (device_s / engine_cache_hit / bucket / degraded) attached
+        as attributes; defaults to the process-wide common.trace.TRACER.
+
+        profiler_dir (config tpu.profiler.*): when set, every engine run
+        is wrapped in a jax.profiler trace dumped there — the XLA-level
+        view for slow-run forensics.  None (default) profiles nothing."""
         import threading
 
         import jax
@@ -216,6 +227,17 @@ class GoalOptimizer:
         self.sensors = sensors
         self.supervisor = supervisor
         self.degraded_budget_s = degraded_budget_s
+        from cruise_control_tpu.common.trace import TRACER
+
+        self.tracer = tracer if tracer is not None else TRACER
+        self.profiler_dir = profiler_dir
+        #: per-bucket cumulative cold-start attribution: bucket key ->
+        #: {compiles, coldWallSeconds, buildSeconds}.  A cache-miss run's
+        #: wall INCLUDES its lazy XLA compile (engine_build_s is host
+        #: construction only), so coldWallSeconds is the honest per-bucket
+        #: compile+first-run bill — the number ROADMAP item 2's persistent
+        #: compile cache must drive toward zero.  Guarded by _cache_lock.
+        self._compile_attribution: dict[str, dict] = {}
         #: breaker open-epoch last seen — caches are purged once per open
         #: transition (pull-based: no callback registration to leak across
         #: the facade's short-lived per-request optimizers)
@@ -491,8 +513,43 @@ class GoalOptimizer:
         exhausted transient retries) degrade to the CPU greedy path;
         application errors (bad states, bad option masks) propagate
         unchanged so a malformed request can neither degrade the service
-        nor get silently served a greedy answer."""
+        nor get silently served a greedy answer.
+
+        Traced: every call is an `analyzer.optimize` span carrying the
+        run's timing record (device_s / blocking_syncs / engine_cache_hit
+        / bucket) and degradation verdict as attributes — the flight
+        recorder's analyzer stage."""
         cfg = config or self.config
+        with self.tracer.span("analyzer.optimize", component="analyzer") as sp:
+            result = self._optimize_routed(state, options, verbose, cfg)
+            timing = next((h for h in result.history if h.get("timing")), {})
+            sp.set(
+                parallel_mode=self.parallel_mode,
+                degraded=result.degraded,
+                wall_s=round(result.wall_seconds, 6),
+                num_proposals=len(result.proposals),
+                **{
+                    k: timing.get(k)
+                    for k in (
+                        "device_s", "blocking_syncs", "host_extract_s",
+                        "engine_cache_hit", "engine_build_s", "bucket",
+                    )
+                    if timing.get(k) is not None
+                },
+            )
+            return result
+
+    def _optimize_routed(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        verbose: bool,
+        cfg: OptimizerConfig,
+    ) -> OptimizerResult:
+        """Supervision routing (the pre-trace `optimize` body): device
+        path under the supervisor, CPU greedy degradation on breaker-open
+        or classified failure — split out so the span wrapper observes
+        every route's result uniformly."""
         sup = self.supervisor
         if sup is None:
             return self._optimize_on_device(state, options, verbose=verbose, config=cfg)
@@ -514,6 +571,42 @@ class GoalOptimizer:
                 state, options, cfg,
                 reason=e.failure_class.value, cause=e,
             )
+
+    # ------------------------------------------------------------------
+    # per-bucket compile-time attribution (device profiling surface)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _bucket_key(shape) -> str:
+        return f"R{shape.R}.B{shape.B}.P{shape.P}.T{shape.num_topics}"
+
+    def _attribute_cold_run(self, shape, *, wall_s: float, build_s: float) -> None:
+        with self._cache_lock:
+            row = self._compile_attribution.setdefault(
+                self._bucket_key(shape),
+                {"compiles": 0, "coldWallSeconds": 0.0, "buildSeconds": 0.0},
+            )
+            row["compiles"] += 1
+            row["coldWallSeconds"] = round(row["coldWallSeconds"] + wall_s, 6)
+            row["buildSeconds"] = round(row["buildSeconds"] + build_s, 6)
+
+    def compile_attribution(self) -> dict[str, dict]:
+        """Cumulative cold-start bill per shape bucket.  A cache-miss
+        run's wall INCLUDES its lazy XLA compile (engine_build_s is host
+        construction only), so coldWallSeconds is the honest per-bucket
+        compile+first-run cost — what ROADMAP item 2's persistent compile
+        cache must drive toward zero.  /state AnalyzerState carries it;
+        the `analyzer.engine-compile-seconds-by-bucket` collector exposes
+        it to /metrics."""
+        with self._cache_lock:
+            return {k: dict(v) for k, v in self._compile_attribution.items()}
+
+    def compile_attribution_values(self) -> list[tuple[dict, float]]:
+        """Collector callback: [({"bucket": key}, coldWallSeconds), ...]."""
+        return [
+            ({"bucket": k}, v["coldWallSeconds"])
+            for k, v in self.compile_attribution().items()
+        ]
 
     def _maybe_purge_after_open(self) -> None:
         """Drop every cached engine once per breaker-open transition: a
@@ -584,7 +677,13 @@ class GoalOptimizer:
                 before_host_f = pool.submit(fetch_before_host, state)
                 if engine is None:
                     engine, cache_info = self._parallel_engine(state, options, cfg)
-                final, history = engine.run(verbose=verbose)
+                # opt-in device profiling (config tpu.profiler.*): the
+                # engine run — where the XLA program actually executes —
+                # is the block a profiler dump illuminates
+                from cruise_control_tpu.common.profiling import profiler_trace
+
+                with profiler_trace(self.profiler_dir):
+                    final, history = engine.run(verbose=verbose)
                 before_host = before_host_f.result()
         finally:
             # run() is done with the engine's buffers (everything below
@@ -624,6 +723,14 @@ class GoalOptimizer:
         viol_b = np.asarray(viol_b)
         viol_a = np.asarray(viol_a)
         wall = time.monotonic() - t0
+        if cache_info is not None and not cache_info.get("engine_cache_hit", True):
+            # cold run: the whole wall (incl. the lazy XLA compile) bills
+            # to this shape bucket's cold-start attribution
+            self._attribute_cold_run(
+                state.shape,
+                wall_s=wall,
+                build_s=cache_info.get("engine_build_s", 0.0),
+            )
         return OptimizerResult(
             proposals=proposals,
             state_before=state,

@@ -152,6 +152,15 @@ ENDPOINTS: dict[str, dict] = {
                              "--max-broker-factor": ("max_broker_factor", str),
                              "--allow-capacity-estimation":
                                  ("allow_capacity_estimation", boolean_param)}},
+    # observability: flight-recorder replay + Prometheus exposition.
+    # `cccli trace` lists recent root traces; `cccli trace --id <traceId>`
+    # (the _traceId of any async response, or a TraceId from user_tasks)
+    # replays the span tree.  `cccli metrics` prints the exposition text
+    # verbatim (NOT JSON) — pipe it to promtool or grep.
+    "trace": {"method": "GET", "endpoint": "trace",
+              "params": {"--id": ("id", str),
+                         "--limit": ("limit", positive_int_param)}},
+    "metrics": {"method": "GET", "endpoint": "metrics", "params": {}},
 }
 
 
@@ -217,7 +226,13 @@ class Client:
         while True:
             req = urllib.request.Request(url, method=method, headers=headers)
             with urllib.request.urlopen(req, timeout=60, context=self._ssl_ctx) as resp:
-                payload = json.loads(resp.read())
+                body = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+                if not ctype.startswith("application/json"):
+                    # raw-text endpoint (/metrics Prometheus exposition):
+                    # pass the body through verbatim
+                    return body.decode()
+                payload = json.loads(body)
                 if resp.status != 202:
                     return payload
                 tid = resp.headers.get(USER_TASK_ID_HEADER) or payload.get("_userTaskId")
@@ -247,7 +262,10 @@ def main(argv=None) -> int:
     except urllib.error.HTTPError as e:
         print(json.dumps(json.loads(e.read() or b"{}"), indent=args.json_indent))
         return 1
-    print(json.dumps(result, indent=args.json_indent))
+    if isinstance(result, str):
+        print(result, end="" if result.endswith("\n") else "\n")
+    else:
+        print(json.dumps(result, indent=args.json_indent))
     return 0
 
 

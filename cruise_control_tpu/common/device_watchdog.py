@@ -373,11 +373,15 @@ class DeviceSupervisor:
         rng: random.Random | None = None,
         clock=time.monotonic,
         sleep=time.sleep,
+        tracer=None,
     ):
         """probe: () -> str | None (None = healthy) — defaults to the
         trivial-op watchdog under `probe_timeout_s`; injectable for tests.
         rng feeds the retry jitter; clock/sleep are injectable so breaker
-        timing tests run without wall-clock waits."""
+        timing tests run without wall-clock waits.  tracer: flight
+        recorder every supervised call opens a `device.<op>` span on
+        (retries, classified failures and breaker transitions become span
+        events); defaults to the process-wide common.trace.TRACER."""
         if op_timeout_s <= 0:
             raise ValueError(f"op_timeout_s must be > 0, got {op_timeout_s}")
         if max_retries < 0:
@@ -398,6 +402,9 @@ class DeviceSupervisor:
         self._sleep = sleep
         self._lock = threading.Lock()
         self.sensors = sensors
+        from cruise_control_tpu.common.trace import TRACER
+
+        self.tracer = tracer if tracer is not None else TRACER
         self._failure_counts: dict[FailureClass, int] = {c: 0 for c in FailureClass}
         self.last_failure: dict | None = None
         self.num_retries = 0
@@ -468,35 +475,49 @@ class DeviceSupervisor:
         Unclassified exceptions propagate unchanged and touch nothing.
         """
         budget = timeout_s if timeout_s is not None else self.op_timeout_s
-        attempt = 0
-        while True:
-            try:
-                result = self._bounded(fn, op, budget)
-            except BaseException as e:  # noqa: BLE001 — classified below
-                cls = classify_failure(e)
-                if cls is None:
-                    raise
-                self._count(cls, op, e)
-                if cls is FailureClass.TRANSIENT and attempt < self.max_retries:
-                    attempt += 1
-                    with self._lock:
-                        self.num_retries += 1
-                    if self.sensors is not None:
-                        self.sensors.counter("analyzer.supervisor.retries").inc()
-                    self._sleep(
-                        jittered_backoff_s(
+        with self.tracer.span(
+            f"device.{op}", component="device", timeout_s=budget
+        ) as sp:
+            attempt = 0
+            while True:
+                try:
+                    result = self._bounded(fn, op, budget)
+                except BaseException as e:  # noqa: BLE001 — classified below
+                    cls = classify_failure(e)
+                    if cls is None:
+                        raise
+                    self._count(cls, op, e)
+                    sp.event("failure", failure_class=cls.value, error=repr(e))
+                    if cls is FailureClass.TRANSIENT and attempt < self.max_retries:
+                        attempt += 1
+                        with self._lock:
+                            self.num_retries += 1
+                        if self.sensors is not None:
+                            self.sensors.counter("analyzer.supervisor.retries").inc()
+                        backoff = jittered_backoff_s(
                             attempt,
                             base_s=self.retry_backoff_s,
                             cap_s=self.retry_backoff_cap_s,
                             rng=self._rng,
                         )
-                    )
-                    continue
-                if self.breaker.record_failure() and self.sensors is not None:
-                    self.sensors.counter("analyzer.supervisor.breaker-opened").inc()
-                raise DeviceDegradedError(op, cls, e) from e
-            self.breaker.record_success()
-            return result
+                        sp.event("retry", attempt=attempt, backoff_s=round(backoff, 4))
+                        self._sleep(backoff)
+                        continue
+                    if self.breaker.record_failure():
+                        # a breaker flip is THE degradation moment — make
+                        # it a first-class trace event, not just a counter
+                        sp.event(
+                            "breaker-opened", open_epoch=self.breaker.open_epoch
+                        )
+                        if self.sensors is not None:
+                            self.sensors.counter(
+                                "analyzer.supervisor.breaker-opened"
+                            ).inc()
+                    sp.set(attempts=attempt + 1, failure_class=cls.value)
+                    raise DeviceDegradedError(op, cls, e) from e
+                self.breaker.record_success()
+                sp.set(attempts=attempt + 1)
+                return result
 
     # -- availability / half-open probing -------------------------------
 
@@ -525,27 +546,38 @@ class DeviceSupervisor:
                 self.num_probes += 1
             if self.sensors is not None:
                 self.sensors.counter("analyzer.supervisor.probes").inc()
-            try:
-                diagnosis = self._probe()
-            except BaseException as e:  # noqa: BLE001 — a raising probe is a failed probe
-                diagnosis = repr(e)
-            if diagnosis is None:
-                self.breaker.probe_succeeded()
+            # a recovery probe is its own root span: it runs on whatever
+            # request thread happened to poll availability, and must not
+            # attach the breaker's recovery story to that request's trace
+            with self.tracer.span(
+                "device.probe", component="device", root=True
+            ) as sp:
+                try:
+                    diagnosis = self._probe()
+                except BaseException as e:  # noqa: BLE001 — a raising probe is a failed probe
+                    diagnosis = repr(e)
+                if diagnosis is None:
+                    self.breaker.probe_succeeded()
+                    sp.event("breaker-closed", open_epoch=self.breaker.open_epoch)
+                    sp.set(healthy=True)
+                    if self.sensors is not None:
+                        self.sensors.counter(
+                            "analyzer.supervisor.probe-successes"
+                        ).inc()
+                    return True
+                self.breaker.probe_failed()
+                sp.set(healthy=False, diagnosis=diagnosis)
+                with self._lock:
+                    self.num_probe_failures += 1
+                    self.last_failure = {
+                        "op": "probe",
+                        "class": FailureClass.HANG.value,
+                        "error": diagnosis,
+                        "ms": int(time.time() * 1000),
+                    }
                 if self.sensors is not None:
-                    self.sensors.counter("analyzer.supervisor.probe-successes").inc()
-                return True
-            self.breaker.probe_failed()
-            with self._lock:
-                self.num_probe_failures += 1
-                self.last_failure = {
-                    "op": "probe",
-                    "class": FailureClass.HANG.value,
-                    "error": diagnosis,
-                    "ms": int(time.time() * 1000),
-                }
-            if self.sensors is not None:
-                self.sensors.counter("analyzer.supervisor.probe-failures").inc()
-            return False
+                    self.sensors.counter("analyzer.supervisor.probe-failures").inc()
+                return False
         finally:
             self._probe_lock.release()
 

@@ -1,0 +1,273 @@
+"""Prometheus text exposition for the SensorRegistry (+ a lint parser).
+
+`GET /metrics` renders the whole sensor catalog in the Prometheus text
+format (version 0.0.4) so the service is scrapeable by any standard
+collector instead of only via the `/state` JSON blob:
+
+  * Counter   -> `counter`, sample `<name>_total` (monotonic)
+  * Gauge     -> `gauge`
+  * Timer     -> `summary` in SECONDS: `<name>_seconds{quantile=...}` over
+                 the bounded sample window + `_sum`/`_count` (totals exact,
+                 quantiles windowed — same caveat as the JSON snapshot)
+  * Meter     -> `<name>_total` counter + `<name>_rate_per_hour` gauge
+  * Histogram -> `histogram`: cumulative `_bucket{le=...}` + `_sum`/`_count`
+  * Collector -> `gauge` with one labeled sample per (labels, value) entry
+
+Sensor names are dotted-kebab (`analyzer.engine-cache-hits`); Prometheus
+names must match `[a-zA-Z_:][a-zA-Z0-9_:]*`, so `metric_name` sanitizes
+every non-conforming rune to `_` under a configurable namespace prefix
+(`metrics.prometheus.namespace`).  Sanitization can collide two catalog
+names onto one metric family — `prometheus_text` raises rather than emit a
+duplicate family, because a silently merged counter lies to every alert
+built on it.
+
+`parse_exposition` is the deliberately small strict parser behind the
+scripts/check.sh lint gate and the tests: TYPE-before-samples, one TYPE
+per family, counter naming + non-negativity, label syntax/escaping, and
+histogram bucket monotonicity (with the `+Inf` bucket == `_count`).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+from cruise_control_tpu.common.sensors import (
+    Collector,
+    Counter,
+    Gauge,
+    Histogram,
+    Meter,
+    SensorRegistry,
+    Timer,
+)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+
+def metric_name(name: str, *, namespace: str = "cruisecontrol") -> str:
+    """Sanitize a sensor catalog name into a Prometheus metric name."""
+    base = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    out = f"{namespace}_{base}" if namespace else base
+    if not _NAME_OK.match(out):
+        # a namespace starting with a digit, or an empty namespace with a
+        # digit-leading sensor name
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+    return format(float(v), ".10g")
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        if not _LABEL_NAME_OK.match(str(k)):
+            raise ValueError(f"invalid Prometheus label name {k!r}")
+        parts.append(f'{k}="{_escape_label(labels[k])}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_text(registry: SensorRegistry, *, namespace: str = "cruisecontrol") -> str:
+    """Render the registry in the exposition format; ends with a newline."""
+    lines: list[str] = []
+    seen_families: dict[str, str] = {}  # family -> source sensor name
+
+    def family(sensor_name: str, suffix: str, ptype: str) -> str:
+        fam = metric_name(sensor_name, namespace=namespace) + suffix
+        prior = seen_families.get(fam)
+        if prior is not None and prior != sensor_name:
+            raise ValueError(
+                f"sensor names {prior!r} and {sensor_name!r} sanitize to the "
+                f"same Prometheus family {fam!r}; rename one"
+            )
+        if prior is None:
+            seen_families[fam] = sensor_name
+            lines.append(f"# HELP {fam} sensor {sensor_name}")
+            lines.append(f"# TYPE {fam} {ptype}")
+        return fam
+
+    for name, sensor in registry.items():
+        if isinstance(sensor, Counter):
+            fam = family(name, "_total", "counter")
+            lines.append(f"{fam} {_fmt(sensor.count)}")
+        elif isinstance(sensor, Gauge):
+            fam = family(name, "", "gauge")
+            lines.append(f"{fam} {_fmt(sensor.value)}")
+        elif isinstance(sensor, Timer):
+            fam = family(name, "_seconds", "summary")
+            for q, v in sorted(sensor.quantiles().items()):
+                lines.append(f'{fam}{{quantile="{_fmt(q)}"}} {_fmt(v)}')
+            lines.append(f"{fam}_sum {_fmt(sensor.total_seconds())}")
+            lines.append(f"{fam}_count {_fmt(sensor.count)}")
+        elif isinstance(sensor, Meter):
+            fam = family(name, "_total", "counter")
+            lines.append(f"{fam} {_fmt(sensor.count)}")
+            rfam = family(name + ".rate-per-hour", "", "gauge")
+            lines.append(f"{rfam} {_fmt(sensor.rate_per_hour())}")
+        elif isinstance(sensor, Histogram):
+            fam = family(name, "", "histogram")
+            cum, total, n = sensor.cumulative()
+            for bound, c in cum:
+                le = "+Inf" if bound == float("inf") else _fmt(bound)
+                lines.append(f'{fam}_bucket{{le="{le}"}} {_fmt(c)}')
+            lines.append(f"{fam}_sum {_fmt(total)}")
+            lines.append(f"{fam}_count {_fmt(n)}")
+        elif isinstance(sensor, Collector):
+            fam = family(name, "", "gauge")
+            for labels, v in sensor.values():
+                lines.append(f"{fam}{_labels(labels)} {_fmt(v)}")
+        # unknown sensor types are skipped: the exposition only promises
+        # the documented catalog
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------------
+# minimal strict parser (the exposition lint gate)
+# ----------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$"
+)
+_LABEL_RE = re.compile(
+    r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"(?P<value>(?:[^"\\]|\\["\\n])*)"\s*(?:,|$)'
+)
+_SUMMARY_HISTOGRAM_SUFFIXES = ("_sum", "_count", "_bucket")
+
+
+class ExpositionError(ValueError):
+    """A lint violation in a /metrics body, with the offending line."""
+
+
+def _parse_labels(raw: str) -> dict:
+    labels: dict[str, str] = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            raise ExpositionError(f"malformed label block {raw!r}")
+        name = m.group("name")
+        if name in labels:
+            raise ExpositionError(f"duplicate label {name!r} in {raw!r}")
+        labels[name] = (
+            m.group("value")
+            .replace("\\n", "\n")
+            .replace('\\"', '"')
+            .replace("\\\\", "\\")
+        )
+        pos = m.end()
+    return labels
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Parse + lint an exposition body.
+
+    Returns {family: {"type": str, "samples": [(name, labels, value)]}}.
+    Raises ExpositionError on: samples without a preceding TYPE, repeated
+    TYPE lines, bad sample/label syntax, unparseable values, counters not
+    ending in `_total` or going negative, and histograms whose cumulative
+    buckets decrease or whose `+Inf` bucket disagrees with `_count`.
+    """
+    families: dict[str, dict] = {}
+
+    def family_of(sample_name: str) -> str | None:
+        if sample_name in families:
+            return sample_name
+        for suffix in _SUMMARY_HISTOGRAM_SUFFIXES:
+            if sample_name.endswith(suffix):
+                base = sample_name[: -len(suffix)]
+                if base in families and families[base]["type"] in (
+                    "summary", "histogram",
+                ):
+                    return base
+        return None
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ExpositionError(f"line {lineno}: malformed TYPE line {line!r}")
+            _, _, fam, ptype = parts
+            if ptype not in ("counter", "gauge", "summary", "histogram", "untyped"):
+                raise ExpositionError(f"line {lineno}: unknown type {ptype!r}")
+            if fam in families:
+                raise ExpositionError(f"line {lineno}: duplicate TYPE for {fam!r}")
+            if ptype == "counter" and not fam.endswith("_total"):
+                raise ExpositionError(
+                    f"line {lineno}: counter family {fam!r} must end in _total"
+                )
+            families[fam] = {"type": ptype, "samples": []}
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ExpositionError(f"line {lineno}: malformed sample line {line!r}")
+        name = m.group("name")
+        fam = family_of(name)
+        if fam is None:
+            raise ExpositionError(
+                f"line {lineno}: sample {name!r} has no preceding TYPE line"
+            )
+        labels = _parse_labels(m.group("labels")) if m.group("labels") else {}
+        raw_v = m.group("value")
+        try:
+            value = float(raw_v)
+        except ValueError as e:
+            raise ExpositionError(
+                f"line {lineno}: unparseable value {raw_v!r}"
+            ) from e
+        if families[fam]["type"] == "counter" and name == fam and value < 0:
+            raise ExpositionError(
+                f"line {lineno}: counter {fam!r} is negative ({value})"
+            )
+        families[fam]["samples"].append((name, labels, value))
+
+    # histogram structural lint: buckets cumulative + +Inf == _count
+    for fam, info in families.items():
+        if info["type"] != "histogram":
+            continue
+        buckets = [
+            (labels.get("le"), v)
+            for name, labels, v in info["samples"]
+            if name == fam + "_bucket"
+        ]
+        if not buckets:
+            raise ExpositionError(f"histogram {fam!r} emitted no buckets")
+        if buckets[-1][0] != "+Inf":
+            raise ExpositionError(f"histogram {fam!r} missing the +Inf bucket")
+        prev = -1.0
+        for le, v in buckets:
+            if v < prev:
+                raise ExpositionError(
+                    f"histogram {fam!r} bucket le={le} decreases ({v} < {prev})"
+                )
+            prev = v
+        counts = [
+            v for name, _, v in info["samples"] if name == fam + "_count"
+        ]
+        if counts and counts[0] != buckets[-1][1]:
+            raise ExpositionError(
+                f"histogram {fam!r}: +Inf bucket {buckets[-1][1]} != _count {counts[0]}"
+            )
+    return families

@@ -1,0 +1,98 @@
+"""Device profiling hooks: memory/buffer gauges + opt-in jax.profiler dump.
+
+Two observability gaps the trace layer alone does not close:
+
+  * **Where is the HBM going?**  Engine caches, shape-bucket padding and
+    scenario batches all hold device buffers; `register_device_gauges`
+    publishes per-backend memory-in-use / limit / live-buffer-count gauges
+    (aggregates under fixed sensor names, per-device detail as a labeled
+    collector) so `/metrics` answers it continuously.
+  * **What is the device DOING during a slow run?**  The span layer times
+    stages; `profiler_trace` (config `tpu.profiler.*`) wraps one engine
+    run in a `jax.profiler.trace` dump — the XLA-level view (op timeline,
+    fusion, transfers) an operator attaches TensorBoard/XProf to.  Opt-in:
+    profiler dumps cost real time and disk, so the default is off.
+
+Everything here degrades to no-ops on backends without the introspection
+APIs (CPU `memory_stats()` returns None; Gauge callbacks that raise read
+as NaN), so the gauges are safe to register unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+def _memory_stat(field: str) -> float:
+    """Sum a memory_stats field across local devices; 0.0 where a backend
+    exposes no stats (host CPU) — the per-device collector distinguishes."""
+    import jax
+
+    total = 0.0
+    for d in jax.local_devices():
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+        if stats:
+            total += float(stats.get(field, 0) or 0)
+    return total
+
+
+def _live_buffer_count() -> float:
+    import jax
+
+    return float(len(jax.live_arrays()))
+
+
+def _per_device_memory() -> list[tuple[dict, float]]:
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+        if stats:
+            out.append(
+                (
+                    {"device": str(d.id), "platform": d.platform},
+                    float(stats.get("bytes_in_use", 0) or 0),
+                )
+            )
+    return out
+
+
+def register_device_gauges(sensors) -> None:
+    """Install the device-memory/buffer sensor surface on a registry.
+
+    Names are fixed (documented in docs/sensors.md; the drift test walks
+    them); per-device breakdown rides collector LABELS, never dynamic
+    sensor names."""
+    sensors.gauge("tpu.device.memory-in-use-bytes", lambda: _memory_stat("bytes_in_use"))
+    sensors.gauge("tpu.device.memory-limit-bytes", lambda: _memory_stat("bytes_limit"))
+    sensors.gauge("tpu.device.live-buffers", _live_buffer_count)
+    sensors.collector("tpu.device.memory-by-device", _per_device_memory)
+
+
+@contextlib.contextmanager
+def profiler_trace(dump_dir: str | None):
+    """Wrap a block in a jax.profiler trace dump when `dump_dir` is set
+    (config tpu.profiler.enabled + tpu.profiler.dump.dir); no-op otherwise.
+
+    A profiler that fails to start (unsupported backend, unwritable dir)
+    must never fail the optimization it was meant to observe — the error
+    is swallowed and the block runs unprofiled."""
+    if not dump_dir:
+        yield
+        return
+    import jax
+
+    try:
+        ctx = jax.profiler.trace(dump_dir)
+        ctx.__enter__()
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001
+            pass

@@ -50,10 +50,16 @@ class Counter:
 
     @property
     def count(self) -> int:
-        return self._count
+        # read under the lock: a bare int read is atomic in CPython today,
+        # but `inc` is a read-modify-write and the exposition scrape reads
+        # concurrently with every component thread — take the lock so the
+        # monotonic-counter contract holds by construction, not by
+        # interpreter accident
+        with self._lock:
+            return self._count
 
     def snapshot(self) -> dict:
-        return {"type": "counter", "count": self._count}
+        return {"type": "counter", "count": self.count}
 
 
 class Gauge:
@@ -103,7 +109,27 @@ class Timer:
 
     @property
     def count(self) -> int:
-        return self._count
+        # same locked-reader contract as Counter.count: `update` writes
+        # count/total/min/max as a group, so a reader must not interleave
+        with self._lock:
+            return self._count
+
+    def total_seconds(self) -> float:
+        with self._lock:
+            return self._total
+
+    def quantiles(self) -> dict[float, float]:
+        """{quantile: seconds} over the bounded sample window — the
+        Prometheus summary exposition's source (empty before any update)."""
+        with self._lock:
+            if not self._samples:
+                return {}
+            ordered = sorted(self._samples)
+
+            def pct(p: float) -> float:
+                return ordered[min(len(ordered) - 1, int(p * len(ordered)))]
+
+            return {0.5: pct(0.50), 0.95: pct(0.95), 0.99: pct(0.99)}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -140,14 +166,26 @@ class _TimerContext:
 
 class Meter:
     """Event rate + mean inter-arrival time (the MTBA sensor's shape:
-    reference detector/MeanTimeBetweenAnomaliesMs.java)."""
+    reference detector/MeanTimeBetweenAnomaliesMs.java).
 
-    def __init__(self, clock: Callable[[], float] = time.time) -> None:
+    Inter-arrival math rides an injected MONOTONIC clock (default
+    time.monotonic): a backwards NTP step must not produce a negative
+    mean-time-between or an absurd rate spike.  Wall-clock stamps are kept
+    separately, for display only (`lastEventMs` in the snapshot)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        *,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
         self._lock = threading.Lock()
         self._clock = clock
+        self._wall = wall
         self._count = 0
         self._first: float | None = None
         self._last: float | None = None
+        self._last_wall_ms: int | None = None
 
     def mark(self, n: int = 1) -> None:
         with self._lock:
@@ -156,10 +194,12 @@ class Meter:
             if self._first is None:
                 self._first = now
             self._last = now
+            self._last_wall_ms = int(self._wall() * 1000)
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     def mean_time_between_ms(self) -> float:
         """Mean time between events; inf until two events were seen."""
@@ -183,9 +223,108 @@ class Meter:
         mtb = self.mean_time_between_ms()
         return {
             "type": "meter",
-            "count": self._count,
+            "count": self.count,
             "ratePerHour": self.rate_per_hour(),
             "meanTimeBetweenMs": (None if mtb == float("inf") else mtb),
+            "lastEventMs": self._last_event_wall_ms(),
+        }
+
+    def _last_event_wall_ms(self) -> int | None:
+        with self._lock:
+            return self._last_wall_ms
+
+
+#: default Histogram boundaries: latency-shaped seconds buckets spanning
+#: the service's realistic range (5ms model builds to 5-minute compiles)
+DEFAULT_HISTOGRAM_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0,
+)
+
+
+class Histogram:
+    """Fixed-boundary histogram with exportable cumulative buckets — the
+    sensor type the Prometheus exposition needs (a Timer's bounded sample
+    window yields quantiles, but quantiles cannot be aggregated across
+    instances; buckets can)."""
+
+    def __init__(self, buckets=DEFAULT_HISTOGRAM_BUCKETS) -> None:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket boundary")
+        if len(set(bounds)) != len(bounds):
+            raise ValueError(f"duplicate histogram boundaries: {bounds}")
+        self._lock = threading.Lock()
+        self.bounds = bounds
+        # per-bucket (non-cumulative) counts; last slot is the +Inf bucket
+        self._counts = [0] * (len(bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        i = bisect.bisect_left(self.bounds, float(value))
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += float(value)
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def cumulative(self) -> tuple[list[tuple[float, int]], float, int]:
+        """([(upper_bound, cumulative_count)...incl +Inf], sum, count) —
+        the exposition's `_bucket{le=...}` series, precomputed atomically."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        cum = []
+        running = 0
+        for bound, c in zip(self.bounds, counts):
+            running += c
+            cum.append((bound, running))
+        cum.append((float("inf"), running + counts[-1]))
+        return cum, total, n
+
+    def snapshot(self) -> dict:
+        cum, total, n = self.cumulative()
+        return {
+            "type": "histogram",
+            "count": n,
+            "sum": round(total, 6),
+            "buckets": [
+                {"le": ("+Inf" if b == float("inf") else b), "count": c}
+                for b, c in cum
+            ],
+        }
+
+
+class Collector:
+    """Labeled multi-value callback gauge: `fn() -> [(labels, value), ...]`
+    with labels a {name: str} dict.  The JSON snapshot and the Prometheus
+    exposition both read it at scrape time; per-device memory and
+    per-bucket compile attribution ride this instead of minting one sensor
+    NAME per device/bucket (names are a documented, drift-tested catalog —
+    label values are data)."""
+
+    def __init__(self, fn: Callable[[], list]) -> None:
+        self._fn = fn
+
+    def values(self) -> list[tuple[dict, float]]:
+        try:
+            return [(dict(labels), float(v)) for labels, v in self._fn()]
+        except Exception:  # noqa: BLE001 — a failing callback yields no series
+            return []
+
+    def snapshot(self) -> dict:
+        return {
+            "type": "collector",
+            "values": [
+                {"labels": labels, "value": v} for labels, v in self.values()
+            ],
         }
 
 
@@ -219,10 +358,25 @@ class SensorRegistry:
     def meter(self, name: str) -> Meter:
         return self._get(name, Meter)
 
-    def snapshot(self) -> dict:
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        return self._get(
+            name,
+            (lambda: Histogram(buckets)) if buckets is not None else Histogram,
+        )
+
+    def collector(self, name: str, fn: Callable[[], list] | None = None) -> Collector:
+        c = self._get(name, lambda: Collector(fn or (lambda: [])))
+        if fn is not None:
+            c._fn = fn  # re-registration rebinds, like gauge callbacks
+        return c
+
+    def items(self) -> list[tuple[str, object]]:
+        """Stable (name, sensor) listing — the exposition iterates this."""
         with self._lock:
-            items = list(self._sensors.items())
-        return {name: s.snapshot() for name, s in sorted(items)}
+            return sorted(self._sensors.items())
+
+    def snapshot(self) -> dict:
+        return {name: s.snapshot() for name, s in self.items()}
 
 
 #: process-wide default registry (components accept an override for tests)

@@ -176,6 +176,54 @@ def _analyzer_defs() -> ConfigDef:
              "wall-clock budget for the CPU greedy fallback that serves "
              "proposals while the breaker is open", in_range(lo=0.001),
              group=g)
+    # --- opt-in device profiling (common/profiling.py) ---
+    g = "analyzer.tpu.profiler"
+    d.define("tpu.profiler.enabled", T.BOOLEAN, False, I.LOW,
+             "wrap every engine run in a jax.profiler trace dumped to "
+             "tpu.profiler.dump.dir — the XLA-level op timeline for "
+             "slow-run forensics (TensorBoard/XProf readable).  Costs "
+             "real time and disk per run; keep off outside an "
+             "investigation", group=g)
+    d.define("tpu.profiler.dump.dir", T.STRING,
+             "/tmp/cruise-control-tpu-profiler", I.LOW,
+             "directory jax.profiler trace dumps land in when "
+             "tpu.profiler.enabled is on", group=g)
+    return d
+
+
+def _observability_defs() -> ConfigDef:
+    """Flight recorder + Prometheus exposition keys (common/trace.py,
+    common/exposition.py — no reference analog: the reference's
+    observability is JMX sensors only)."""
+    d = ConfigDef()
+    g = "observability.trace"
+    d.define("trace.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "record flight-recorder spans for every pipeline stage "
+             "(model build, optimize, device ops, execution, planner, "
+             "detector) — served by GET /trace; async responses carry "
+             "_traceId.  Overhead is gated <2% of a smoke proposal run "
+             "(scripts/check.sh)", group=g)
+    d.define("trace.retention.spans.per.component", T.INT, 512, I.LOW,
+             "bounded ring-buffer size PER COMPONENT (service/monitor/"
+             "analyzer/device/executor/planner/detector) — a chatty "
+             "component evicts its own history, never another's; a trace "
+             "expires when its spans age out of every ring",
+             in_range(lo=16), group=g)
+    d.define("trace.max.events.per.span", T.INT, 512, I.LOW,
+             "events kept per span (task transitions, retries, breaker "
+             "flips); beyond it events are counted as dropped, not kept — "
+             "a 100k-task execution must not hold 100k dicts",
+             in_range(lo=8), group=g)
+    g = "observability.metrics"
+    d.define("metrics.prometheus.namespace", T.STRING, "cruisecontrol",
+             I.LOW,
+             "metric-name prefix of the GET /metrics Prometheus "
+             "exposition (sensor catalog names are sanitized beneath it)",
+             lambda n, v: None if __import__("re").fullmatch(
+                 r"[a-zA-Z_][a-zA-Z0-9_]*", str(v)
+             ) else (_ for _ in ()).throw(ConfigException(
+                 f"{n}={v!r} is not a valid Prometheus name prefix")),
+             group=g)
     return d
 
 
@@ -681,6 +729,7 @@ def _webserver_defs() -> ConfigDef:
 def cruise_control_config_def() -> ConfigDef:
     return (
         _analyzer_defs()
+        .merge(_observability_defs())
         .merge(_planner_defs())
         .merge(_monitor_defs())
         .merge(_executor_defs())
@@ -761,7 +810,7 @@ class CruiseControlConfig(AbstractConfig):
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")
 
-    def device_supervisor(self, *, sensors=None, probe=None):
+    def device_supervisor(self, *, sensors=None, probe=None, tracer=None):
         """DeviceSupervisor from the tpu.supervisor.* keys; None when
         supervision is disabled (offline tools, parity benchmarks)."""
         if not self.get("tpu.supervisor.enabled"):
@@ -781,6 +830,20 @@ class CruiseControlConfig(AbstractConfig):
             probe_timeout_s=self.get("tpu.supervisor.probe.timeout.s"),
             sensors=sensors,
             probe=probe,
+            tracer=tracer,
+        )
+
+    def tracer(self):
+        """Flight-recorder Tracer from the trace.* keys (one per service;
+        the facade shares it across every subsystem)."""
+        from cruise_control_tpu.common.trace import Tracer
+
+        return Tracer(
+            enabled=self.get("trace.enabled"),
+            retention_per_component=self.get(
+                "trace.retention.spans.per.component"
+            ),
+            max_events_per_span=self.get("trace.max.events.per.span"),
         )
 
     def shape_bucket_policy(self):

@@ -11,6 +11,7 @@ through package __init__s).
 GET_ENDPOINTS = (
     "bootstrap", "train", "load", "partition_load", "proposals", "state",
     "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
+    "trace", "metrics",
 )
 POST_ENDPOINTS = (
     "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
@@ -46,6 +47,9 @@ ENDPOINT_TYPES = {
     # planner endpoints are read-only analysis over the monitor's model
     "simulate": "KAFKA_MONITOR",
     "rightsize": "KAFKA_MONITOR",
+    # observability: trace replay + Prometheus exposition (both read-only)
+    "trace": "CRUISE_CONTROL_MONITOR",
+    "metrics": "CRUISE_CONTROL_MONITOR",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
 

@@ -135,14 +135,21 @@ class AnomalyDetector:
         now_ms=None,
         sensors=None,
         history_size: int = 10,
+        tracer=None,
     ):
         from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.trace import TRACER
 
         self.notifier = notifier
         self.actions = actions
         # history_size: reference num.cached.recent.anomaly.states (default 10)
         self.state = AnomalyDetectorState(history_size=history_size)
         self.sensors = sensors if sensors is not None else REGISTRY
+        #: flight recorder: each handled anomaly is a `detector.handle`
+        #: ROOT span, and a FIX dispatch's whole pipeline (model build,
+        #: optimize, execution) nests under it — the trace of a
+        #: self-healing action reads exactly like a user request's
+        self.tracer = tracer if tracer is not None else TRACER
 
         def _healing_ratio() -> float:
             enabled = notifier.self_healing_enabled()
@@ -233,6 +240,17 @@ class AnomalyDetector:
 
     def _handle(self, anomaly: Anomaly) -> AnomalyRecord:
         """Reference AnomalyHandlerTask:318."""
+        with self.tracer.span(
+            "detector.handle",
+            component="detector",
+            root=True,  # detector loop: never attach to a request context
+            anomaly_type=anomaly.anomaly_type.name,
+        ) as sp:
+            rec = self._handle_traced(anomaly)
+            sp.set(status=rec.status)
+            return rec
+
+    def _handle_traced(self, anomaly: Anomaly) -> AnomalyRecord:
         now = self._now()
         # only FIXABLE anomalies wait for the executor: an alert-only one
         # (EXECUTION_STUCK, OPTIMIZER_DEGRADED) never touches it, and

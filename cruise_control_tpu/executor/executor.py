@@ -275,6 +275,15 @@ class ConcurrencyAdjuster:
                     {"t": "concurrency", "inter": inter, "cluster": cluster,
                      "urps": urps}
                 )
+            # flight recorder: cap changes land as events on the live
+            # execution span (observe() runs on the execution-loop thread,
+            # inside the span's context)
+            from cruise_control_tpu.common.trace import TRACER
+
+            TRACER.event(
+                "adaptive-cap", inter=inter, cluster=cluster, urps=urps,
+                stressed=bool(stressed),
+            )
         return self.caps()
 
     def state_json(self) -> dict:
@@ -305,6 +314,7 @@ class Executor:
         journal: ExecutionJournal | None = None,
         clock=None,
         anomaly_sink=None,
+        tracer=None,
     ):
         """notifier (reference ExecutorConfig executor.notifier.class): an
         object with on_execution_finished(result, uuid), called after every
@@ -317,10 +327,21 @@ class Executor:
         timestamps ride it, so simulated runs and tests control time.
         anomaly_sink: callable(Anomaly) the stuck-move reaper reports
         EXECUTION_STUCK through (the facade wires the anomaly detector's
-        add_anomaly here)."""
+        add_anomaly here).
+
+        tracer: flight recorder (common/trace.py) — every execution is an
+        `executor.execution` span whose EVENTS are the task transitions
+        (riding the same ExecutionTask.observer hook the journal uses),
+        reaper actions and adaptive-cap changes; defaults to the
+        process-wide TRACER."""
         from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.trace import TRACER
 
         self.sensors = sensors if sensors is not None else REGISTRY
+        self.tracer = tracer if tracer is not None else TRACER
+        #: live span of the ongoing execution (task-transition events
+        #: attach here from whatever thread drives the loop)
+        self._exec_span = None
         self.admin = admin
         self.strategy = strategy
         self.notifier = notifier
@@ -379,6 +400,17 @@ class Executor:
             self.journal.append(
                 {"t": "task", "id": task.execution_id, "state": state.value,
                  "ms": now_ms}
+            )
+        # same observer, second consumer: every task transition is also a
+        # flight-recorder event on the live execution span (bounded there)
+        sp = self._exec_span
+        if sp is not None:
+            sp.event(
+                "task",
+                id=task.execution_id,
+                type=task.task_type.value,
+                state=state.value,
+                ms=now_ms,
             )
 
     def _journal_reservations(self):
@@ -583,14 +615,31 @@ class Executor:
         live_proposals = [
             t.proposal for t in self.tracker.tasks() if t.state not in _TERMINAL
         ]
-        result = self._run_guarded(
-            options,
-            live_proposals,
-            in_flight=adopted,
-            intra_in_flight=adopted_intra,
-            adaptive_initial=(adaptive or {}).get("inter"),
-        )
-        return result
+        # the recovery drive is its own ROOT trace: it belongs to no user
+        # request (the crashed predecessor's request died with it)
+        with self.tracer.span(
+            "executor.recovery-resume",
+            component="executor",
+            root=True,
+            num_tasks=len(live_proposals),
+            adopted=len(adopted or {}),
+        ) as sp:
+            self._exec_span = sp
+            try:
+                result = self._run_guarded(
+                    options,
+                    live_proposals,
+                    in_flight=adopted,
+                    intra_in_flight=adopted_intra,
+                    adaptive_initial=(adaptive or {}).get("inter"),
+                )
+            finally:
+                self._exec_span = None
+            sp.set(
+                completed=result.completed, aborted=result.aborted,
+                dead=result.dead, stopped=result.stopped,
+            )
+            return result
 
     # ------------------------------------------------------------------
     # mid-execution concurrency control (reference Executor.java:485-510,
@@ -776,7 +825,22 @@ class Executor:
                         str(b): ms for b, ms in self._demoted_history.items()
                     },
                 })
-        return self._run_guarded(options, proposals)
+        with self.tracer.span(
+            "executor.execution",
+            component="executor",
+            uuid=uuid,
+            num_tasks=len(tasks),
+        ) as sp:
+            self._exec_span = sp
+            try:
+                result = self._run_guarded(options, proposals)
+            finally:
+                self._exec_span = None
+            sp.set(
+                completed=result.completed, aborted=result.aborted,
+                dead=result.dead, stopped=result.stopped, ticks=result.ticks,
+            )
+            return result
 
     def _run_guarded(
         self,
@@ -888,6 +952,14 @@ class Executor:
         del in_flight[key]
         watermark.pop(key, None)
         self.sensors.counter("executor.reaper.stuck-task").inc()
+        sp = self._exec_span
+        if sp is not None:
+            sp.event(
+                "reaped",
+                id=task.execution_id,
+                mode="rollback" if rolled_back else "dead",
+                stalled_s=round(stalled_ms / 1000.0, 3),
+            )
         if self.journal is not None:
             self.journal.append({
                 "t": "reaped",

@@ -197,14 +197,28 @@ class LoadMonitor:
     ) -> ClusterState:
         """Generate the array-encoded cluster model
         (reference LoadMonitor.clusterModel():485-568; timed like its
-        cluster-model-creation-timer sensor, LoadMonitor.java:100,510)."""
+        cluster-model-creation-timer sensor, LoadMonitor.java:100,510).
+
+        Traced as the `monitor.cluster_model` span of whatever operation
+        requested the model (the flight recorder's first pipeline stage) —
+        the served (bucketed) shape and generation ride as attributes so a
+        trace shows which compiled-engine bucket this build landed in."""
         from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.trace import TRACER
 
         sensors = getattr(self, "sensors", None) or REGISTRY
+        tracer = getattr(self, "tracer", None) or TRACER
         with sensors.timer("monitor.cluster-model-creation-timer").time():
-            return self._cluster_model_impl(
-                requirements, allow_capacity_estimation=allow_capacity_estimation
-            )
+            with tracer.span("monitor.cluster_model", component="monitor") as sp:
+                state = self._cluster_model_impl(
+                    requirements, allow_capacity_estimation=allow_capacity_estimation
+                )
+                s = state.shape
+                sp.set(
+                    brokers=s.B, partitions=s.P, replicas=s.R,
+                    topics=s.num_topics, load_generation=self._load_generation,
+                )
+                return state
 
     def _cluster_model_impl(
         self,

@@ -88,6 +88,24 @@ class CruiseControl:
         #: MetricRegistry per app, KafkaCruiseControlApp.java:39-41)
         self.sensors = sensors if sensors is not None else SensorRegistry()
         monitor.sensors = self.sensors
+        #: flight recorder (config trace.*): ONE tracer per service — the
+        #: monitor, analyzer, supervisor, executor, detector and planner
+        #: all record into the same per-component ring store, so one
+        #: rebalance correlates across every subsystem under one trace ID
+        #: (served by GET /trace; async responses carry `_traceId`)
+        self.tracer = config.tracer()
+        monitor.tracer = self.tracer
+        # device profiling surface: per-backend memory/live-buffer gauges
+        # + per-device labeled collector, scrapeable via GET /metrics
+        from cruise_control_tpu.common.profiling import register_device_gauges
+
+        register_device_gauges(self.sensors)
+        #: opt-in jax.profiler dump dir (config tpu.profiler.*)
+        self.profiler_dir = (
+            config.get("tpu.profiler.dump.dir")
+            if config.get("tpu.profiler.enabled")
+            else None
+        )
         self.constraint = config.balancing_constraint()
         self.chain = chain or GoalChain.from_names(config.get("default.goals"))
         #: reference AnalyzerConfig goal.balancedness.{priority,strictness}.weight
@@ -104,7 +122,9 @@ class CruiseControl:
         #: ad-hoc per-request ones + the precompute thread): they all feed
         #: the same circuit breaker, so a wedged device degrades the whole
         #: analyzer surface coherently instead of per-optimizer
-        self.supervisor = config.device_supervisor(sensors=self.sensors)
+        self.supervisor = config.device_supervisor(
+            sensors=self.sensors, tracer=self.tracer
+        )
         self.optimizer = GoalOptimizer(
             chain=self.chain,
             constraint=self.constraint,
@@ -116,6 +136,15 @@ class CruiseControl:
             shape_bucket=self.bucket_policy,
             supervisor=self.supervisor,
             degraded_budget_s=config.get("tpu.supervisor.degraded.greedy.budget.s"),
+            tracer=self.tracer,
+            profiler_dir=self.profiler_dir,
+        )
+        # per-bucket cold-start attribution as labeled /metrics series
+        # (only the facade's long-lived default optimizer feeds it; ad-hoc
+        # per-request optimizers are too short-lived to own a collector)
+        self.sensors.collector(
+            "analyzer.engine-compile-seconds-by-bucket",
+            self.optimizer.compile_attribution_values,
         )
         from cruise_control_tpu.analyzer.scenario_eval import ScenarioEvaluator
         from cruise_control_tpu.planner.rightsizer import Rightsizer
@@ -171,6 +200,7 @@ class CruiseControl:
                 allowed=self.allowed_strategies,
             ),
             sensors=self.sensors,
+            tracer=self.tracer,
             removal_history_retention_ms=config.get(
                 "removal.history.retention.time.ms"
             ),
@@ -221,6 +251,7 @@ class CruiseControl:
             self.actions,
             sensors=self.sensors,
             history_size=config.get("num.cached.recent.anomaly.states"),
+            tracer=self.tracer,
         )
         # the stuck-move reaper reports EXECUTION_STUCK through the same
         # queue every detector feeds, so the notifier (Slack included)
@@ -564,6 +595,8 @@ class CruiseControl:
             degraded_budget_s=self.config.get(
                 "tpu.supervisor.degraded.greedy.budget.s"
             ),
+            tracer=self.tracer,
+            profiler_dir=self.profiler_dir,
         )
 
     def proposals(
@@ -601,9 +634,13 @@ class CruiseControl:
             options = self._build_options(state)
         optimizer = self.optimizer if goals is None else self._make_optimizer(goals)
         progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
-        # reference GoalOptimizer proposal-computation-timer (:116,155)
+        # reference GoalOptimizer proposal-computation-timer (:116,155);
+        # the histogram twin feeds /metrics with aggregatable buckets
         with self.sensors.timer("analyzer.proposal-computation-timer").time():
             result = optimizer.optimize(state, options=options or OptimizationOptions())
+        self.sensors.histogram("analyzer.proposal-computation-seconds").observe(
+            result.wall_seconds
+        )
         if storable:
             with self._cache_lock:
                 self._cache = _CachedResult(
@@ -1061,7 +1098,12 @@ class CruiseControl:
             progress.add_step(
                 BatchedOptimization(self.optimizer.config.num_rounds)
             )
-        with self.sensors.timer("planner.simulate-timer").time():
+        with self.sensors.timer("planner.simulate-timer").time(), self.tracer.span(
+            "planner.simulate",
+            component="planner",
+            scenarios=len(scenarios),
+            optimize=bool(optimize),
+        ) as sp:
             # the identity scenario rides the SAME batch so "vs today" in
             # the response cannot drift from the mutated states' scoring;
             # its optimize flag is False — the response never serializes a
@@ -1073,6 +1115,7 @@ class CruiseControl:
                 optimize=[False] + [bool(optimize)] * len(scenarios),
                 bucket=self.bucket_policy,
             )
+            sp.set(degraded=any(o.degraded for o in outcomes))
         base, rest = outcomes[0], outcomes[1:]
         return {
             "scenarios": [o.to_json() for o in rest],
@@ -1146,7 +1189,13 @@ class CruiseControl:
             )
         max_anneals = self.config.get("planner.rightsize.max.anneals")
         catalog = self.monitor.last_catalog
-        out = rs.rightsize(state, catalog, max_anneals=max_anneals)
+        with self.tracer.span("planner.rightsize", component="planner") as sp:
+            out = rs.rightsize(state, catalog, max_anneals=max_anneals)
+            sp.set(
+                status=out.get("provisionStatus"),
+                anneals=out.get("annealsRun"),
+                min_brokers=out.get("minBrokers"),
+            )
         # trend outlook at the CONFIGURED horizons (planner.forecast.
         # horizons.ms): the fitted per-topic scale factors, no extra
         # anneals — the full forecast VERDICT still needs an explicit
@@ -1215,6 +1264,9 @@ class CruiseControl:
                 # because the device breaker is not closed
                 "degraded": self.supervisor is not None
                 and self.supervisor.is_degraded,
+                # per-bucket cumulative cold-start bill (compile + first
+                # run); the /metrics collector mirrors coldWallSeconds
+                "compileAttribution": self.optimizer.compile_attribution(),
             }
             if self.supervisor is not None:
                 out["AnalyzerState"]["supervisor"] = self.supervisor.state_json()

@@ -206,6 +206,13 @@ _RAW_PARAMETERS: dict[str, tuple] = {
                       Param("min_brokers", _min1_int),
                       Param("max_broker_factor", _min1_float),
                       Param("allow_capacity_estimation", _bool)),
+        # --- observability (flight recorder + Prometheus exposition) ---
+        "trace": (Param("id", str,
+                        "trace id to replay (from _traceId of an async "
+                        "response); omit to list recent root traces"),
+                  Param("limit", _min1_int,
+                        "max recent traces listed without id (default 50)")),
+        "metrics": (),
 }
 
 from cruise_control_tpu.config.endpoints import (  # noqa: E402

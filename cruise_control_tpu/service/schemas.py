@@ -154,6 +154,9 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
             Field("ClientIdentity", STR),
             Field("Status", STR),
             Field("StartMs", NUM),
+            # flight-recorder trace id of the operation (empty when
+            # tracing is disabled)
+            Field("TraceId", STR, required=False),
         ))),
     )),
     "review_board": Schema((Field("requestInfo", LIST),)),
@@ -215,12 +218,26 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("wallSeconds", NUM),
         Field("_userTaskId", STR, required=False),
     )),
+    # --- observability ---
+    # GET /trace: with ?id= the replayed span forest; without, an index of
+    # recent root traces.  Exactly one of the two shapes appears.
+    "trace": Schema((
+        Field("traceId", STR, required=False),
+        Field("spans", LIST, required=False),
+        Field("traces", LIST, required=False),
+    )),
+    # GET /metrics is TEXT (Prometheus exposition 0.0.4), not JSON — the
+    # schema entry satisfies the full-coverage gate; the body itself is
+    # validated by the exposition lint parser (common/exposition.py,
+    # scripts/check.sh gate)
+    "metrics": Schema((), allow_extra=True),
 }
 
 #: non-200 body shapes (shared by every endpoint)
 ASYNC_PROGRESS_SCHEMA = Schema((  # 202
     Field("progress", LIST),
     Field("_userTaskId", STR),
+    Field("_traceId", STR, required=False),
 ))
 ERROR_SCHEMA = Schema((  # 4xx/5xx
     Field("errorMessage", STR),
@@ -261,7 +278,9 @@ def _check(schema: Schema, payload, *, where: str) -> list[str]:
             for i, item in enumerate(v[:5]):  # spot-check the head
                 problems += _check(f.item_schema, item, where=f"{where}.{f.name}[{i}]")
     if not schema.allow_extra:
-        extra = set(payload) - schema.field_names() - {"_userTaskId"}
+        # _userTaskId/_traceId are cross-cutting rider fields every async
+        # response carries (poll resume + flight-recorder correlation)
+        extra = set(payload) - schema.field_names() - {"_userTaskId", "_traceId"}
         if extra:
             problems.append(f"{where}: undeclared fields {sorted(extra)}")
     return problems

@@ -38,6 +38,16 @@ class BadRequest(ValueError):
     pass
 
 
+class RawResponse:
+    """A non-JSON endpoint body (the Prometheus exposition): the handler
+    returns one of these and `_send` writes it verbatim under its own
+    Content-Type instead of JSON-encoding it."""
+
+    def __init__(self, body: str, content_type: str):
+        self.body = body
+        self.content_type = content_type
+
+
 #: operation audit trail (reference OPERATION_LOGGER, executor/Executor.java:74,
 #: detector/AnomalyDetector.java:56): one line per REST operation with the
 #: authenticated principal and outcome.  Route to a file via standard logging
@@ -183,6 +193,11 @@ class CruiseControlApp:
 
         self.cc = cc
         self.config = cc.config
+        # flight recorder + exposition (facade-owned; standalone facades
+        # built without the config keys fall back to the process tracer)
+        from cruise_control_tpu.common.trace import TRACER
+
+        self.tracer = getattr(cc, "tracer", None) or TRACER
 
         def _cat_map(fmt: str) -> dict:
             cats = {
@@ -390,20 +405,41 @@ class CruiseControlApp:
         return fn(params)
 
     def _task_response(self, task) -> tuple[int, dict]:
+        # every shape carries the flight-recorder trace id (when tracing
+        # is on): a client polling a 202 can ALREADY replay the live span
+        # tree via GET /trace?id=..., and a 500's trace shows which stage
+        # died
+        rider = {"_traceId": task.trace_id} if task.trace_id else {}
         try:
             result = task.future.result(timeout=1.0)
-            return 200, {**result, "_userTaskId": task.task_id}
+            return 200, {**result, "_userTaskId": task.task_id, **rider}
         except FutureTimeout:
             return 202, {
                 "progress": task.progress.to_json(),
                 "_userTaskId": task.task_id,
+                **rider,
             }
         except Exception as e:  # noqa: BLE001 — operation failed
-            return 500, {"errorMessage": str(e), "_userTaskId": task.task_id}
+            return 500, {
+                "errorMessage": str(e), "_userTaskId": task.task_id, **rider,
+            }
 
     def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
+        # flight recorder: ONE trace per submitted operation.  The id is
+        # minted here (synchronously, so the UserTask carries it and the
+        # very first 202 can hand it to the client); the root span opens
+        # on the pool thread when the operation actually runs, and every
+        # pipeline stage beneath (model build, optimize, device ops,
+        # execution) parents into it via context propagation.
+        tracer = self.tracer
+        trace_id = tracer.new_trace_id() if tracer.enabled else ""
+
         def wrapped(progress, _op=fn):
-            out = _op(progress)
+            with tracer.span(
+                f"service.{endpoint}", component="service",
+                trace_id=trace_id, root=True,
+            ):
+                out = _op(progress)
             # degraded serving must be visible in the ops audit trail, not
             # only in the payload: the analyzer's device breaker is open
             # and this answer came from the CPU greedy fallback
@@ -416,24 +452,24 @@ class CruiseControlApp:
             return out
 
         fn = wrapped
+
+        def _submit():
+            return self.user_tasks.submit(
+                endpoint, fn, client_id=client, trace_id=trace_id
+            )
+
         key = getattr(self._local, "session_key", None)
         client = getattr(self._local, "client", "") or ""
         if key is None:
-            task = self.user_tasks.submit(endpoint, fn, client_id=client)
-            return self._task_response(task)
+            return self._task_response(_submit())
         # bind the session to the submitted task so a client that lost the
         # User-Task-ID header resumes the same operation instead of
         # re-executing it (reference servlet/SessionManager.java)
-        tid = self.sessions.get_or_bind(
-            key, lambda: self.user_tasks.submit(endpoint, fn, client_id=client).task_id
-        )
+        tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
         task = self.user_tasks.get(tid)
         if task is None:  # bound task evicted; start fresh
             self.sessions.release(key)
-            tid = self.sessions.get_or_bind(
-                key,
-                lambda: self.user_tasks.submit(endpoint, fn, client_id=client).task_id,
-            )
+            tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
             task = self.user_tasks.get(tid)
         status, payload = self._task_response(task)
         if status != 202:  # response delivered -> close the session
@@ -617,6 +653,37 @@ class CruiseControlApp:
         return self._async_op(
             "train", lambda progress: runner.train(start, end)
         )
+
+    def _ep_trace(self, params) -> tuple[int, dict]:
+        """GET /trace — flight-recorder replay.  With ?id=<traceId> the
+        span forest of one trace (404 when nothing of it is retained);
+        without, a newest-first index of recent root traces."""
+        tid = params.get("id", [None])[0]
+        if tid is None:
+            # the declared Param("limit", _min1_int) parser already 400'd
+            # malformed/<1 values before dispatch reached this handler
+            limit = int(params.get("limit", ["50"])[0])
+            return 200, {"traces": self.tracer.recent_traces(limit)}
+        spans = self.tracer.trace_tree(tid)
+        if not spans:
+            # KeyError -> the dispatcher's 404 path: an unknown (or
+            # already-evicted) trace id is "not found", not an empty tree
+            raise KeyError(f"no retained spans for trace id {tid}")
+        return 200, {"traceId": tid, "spans": spans}
+
+    def _ep_metrics(self, params) -> tuple[int, dict]:
+        """GET /metrics — Prometheus text exposition of the whole sensor
+        registry (common/exposition.py); text/plain, not JSON."""
+        from cruise_control_tpu.common.exposition import (
+            CONTENT_TYPE,
+            prometheus_text,
+        )
+
+        body = prometheus_text(
+            self.cc.sensors,
+            namespace=self.config.get("metrics.prometheus.namespace"),
+        )
+        return 200, RawResponse(body, CONTENT_TYPE)
 
     def _ep_rightsize(self, params) -> tuple[int, dict]:
         """GET /rightsize — minimum brokers satisfying all hard goals at
@@ -970,7 +1037,22 @@ class CruiseControlApp:
                 self._user = principal
                 self._send(status, payload)
 
-            def _send(self, status: int, payload: dict):
+            def _send(self, status: int, payload):
+                if isinstance(payload, RawResponse):
+                    body = payload.body.encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", payload.content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in app.cors_headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                    if app.access_log:
+                        app.access_log.log(
+                            self.client_address[0], getattr(self, "_user", ""),
+                            self.command, self.path, status, len(body),
+                        )
+                    return
                 body = json.dumps(payload, default=_json_default).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

@@ -39,6 +39,10 @@ class UserTask:
     #: requesting client identity (reference UserTaskInfo clientIdentity,
     #: filterable via USER_TASKS client_ids)
     client_id: str = ""
+    #: flight-recorder trace id of the operation (empty when tracing is
+    #: off) — the handle a client uses with GET /trace to replay the
+    #: operation's span tree after (or while) it runs
+    trace_id: str = ""
 
     @property
     def status(self) -> str:
@@ -55,6 +59,7 @@ class UserTask:
             "ClientIdentity": self.client_id,
             "Status": self.status,
             "StartMs": self.created_ms,
+            "TraceId": self.trace_id,
         }
 
 
@@ -87,7 +92,8 @@ class UserTaskManager:
         self.category_retention_ms = category_retention_ms or {}
 
     def submit(self, endpoint: str, fn, *, request_url: str = "",
-               task_id: str | None = None, client_id: str = "") -> UserTask:
+               task_id: str | None = None, client_id: str = "",
+               trace_id: str = "") -> UserTask:
         """Run fn(progress) on the session pool; returns the UserTask."""
         with self._lock:
             active = sum(1 for t in self._tasks.values() if t.status == "Active")
@@ -105,6 +111,7 @@ class UserTaskManager:
                 created_ms=int(time.time() * 1000),
                 request_url=request_url,
                 client_id=client_id,
+                trace_id=trace_id,
             )
             # completion stamp for retention: set the moment the operation
             # finishes, so the retention window starts when the RESULT

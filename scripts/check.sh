@@ -71,6 +71,51 @@ echo "== check.sh: crash-safe execution gate (journal recovery, reaper, adaptive
 python -m pytest tests/test_executor_recovery.py -q
 recovery_rc=$?
 
+echo "== check.sh: /metrics exposition lint gate (live scrape) =="
+# named gate: boot the simulated service, scrape GET /metrics over HTTP,
+# and lint the body with the strict exposition parser (TYPE lines, label
+# escaping, counter monotonicity, histogram bucket structure) — a
+# malformed exposition breaks every dashboard silently
+GRAFT_FORCE_CPU=1 python - <<'EOF'
+import urllib.request
+
+from cruise_control_tpu.common.exposition import parse_exposition
+from cruise_control_tpu.service.main import build_simulated_service
+from cruise_control_tpu.service.progress import OperationProgress
+
+app, fetcher, admin, sampler = build_simulated_service(seed=1)
+app.start()
+try:
+    # one proposal run so the analyzer/device sensor surface registers
+    app.cc.proposals(OperationProgress())
+    url = f"http://{app.host}:{app.port}{app.prefix}/metrics"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain"), (
+            resp.headers["Content-Type"]
+        )
+        families = parse_exposition(resp.read().decode())
+    for fam in (
+        "cruisecontrol_analyzer_proposal_computation_timer_seconds",
+        "cruisecontrol_analyzer_proposal_computation_seconds",
+        "cruisecontrol_tpu_device_live_buffers",
+    ):
+        assert fam in families, f"missing family {fam}"
+    print(f"exposition lint: OK ({len(families)} families)")
+finally:
+    app.stop()
+EOF
+metrics_rc=$?
+
+echo "== check.sh: trace overhead gate (tracing-on adds <2% to a smoke run) =="
+# named gate: the flight recorder is ON by default on the hot proposal
+# path, so its cost is pinned by measurement, not assumption
+GRAFT_FORCE_CPU=1 python bench.py --trace-overhead
+overhead_rc=$?
+
+echo "== check.sh: flight-recorder unit gate (trace model, exposition parser) =="
+python -m pytest tests/test_trace.py -q
+trace_rc=$?
+
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]

@@ -144,3 +144,23 @@ def test_cli_basic_auth(tmp_path, capsys):
         assert rc == 1 and "errorMessage" in err
     finally:
         app.stop()
+
+
+def test_cli_trace_and_metrics(service, capsys):
+    # seed a traced operation, then replay it through the CLI
+    rc, payload = run_cli(service, capsys, "proposals")
+    assert rc == 0
+    tid = payload.get("_traceId")
+    assert tid
+    rc, idx = run_cli(service, capsys, "trace")
+    assert rc == 0 and idx["traces"]
+    rc, tree = run_cli(service, capsys, "trace", "--id", tid)
+    assert rc == 0
+    assert tree["traceId"] == tid and tree["spans"]
+    # metrics is raw Prometheus text, passed through verbatim (not JSON)
+    rc = main(["-a", f"http://{service.host}:{service.port}", "metrics"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    from cruise_control_tpu.common.exposition import parse_exposition
+
+    assert parse_exposition(out), "CLI must emit lintable exposition text"

@@ -213,6 +213,55 @@ def test_full_stack_reporter_to_executor_round_trip():
         st, state, _ = req("GET", "state", substates="executor")
         assert state["ExecutorState"]["numFinishedMovements"] > 0
 
+        # --- flight recorder: ONE trace id covers the whole pipeline ---
+        trace_id = payload.get("_traceId")
+        assert trace_id, "rebalance response must carry _traceId"
+        st, trace, _ = req("GET", "trace", id=trace_id)
+        assert st == 200 and trace["traceId"] == trace_id
+
+        def flatten(nodes):
+            out = []
+            for n in nodes:
+                out.append(n)
+                out.extend(flatten(n["children"]))
+            return out
+
+        spans = flatten(trace["spans"])
+        assert {s["traceId"] for s in spans} == {trace_id}
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], s)
+        # root: the submitted operation
+        assert by_name["service.rebalance"]["parentId"] is None
+        # stage 1: monitor model build
+        assert "monitor.cluster_model" in by_name
+        # stage 2: engine run with the timing record as attributes
+        opt_attrs = by_name["analyzer.optimize"]["attributes"]
+        assert "device_s" in opt_attrs
+        assert "engine_cache_hit" in opt_attrs
+        assert "bucket" in opt_attrs
+        # stage 3: the supervised device op
+        assert by_name["device.optimize"]["component"] == "device"
+        # stage 4: execution, with EVERY task transition as span events
+        exc = by_name["executor.execution"]
+        task_events = [e for e in exc["events"] if e["name"] == "task"]
+        ids_seen = {e["id"] for e in task_events}
+        assert len(ids_seen) == exc["attributes"]["num_tasks"]
+        completed = {
+            e["id"] for e in task_events if e["state"] == "COMPLETED"
+        }
+        assert completed == ids_seen, "every task must reach COMPLETED"
+        assert exc["attributes"]["completed"] == len(ids_seen)
+
+        # --- Prometheus exposition over the live service ---
+        from cruise_control_tpu.common.exposition import parse_exposition
+
+        r = urllib.request.Request(f"{base}/metrics")
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            fams = parse_exposition(resp.read().decode())
+        assert "cruisecontrol_executor_execution_started_total" in fams
+
         # --- scenario planner against the live fake cluster ---
         def poll(method, ep, **params):
             s, p, h = req(method, ep, **params)

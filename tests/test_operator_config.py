@@ -154,3 +154,132 @@ def test_service_boots_from_shipped_properties():
         assert cap.disk_capacities == {"/data/d0": 250_000.0, "/data/d1": 250_000.0}
     finally:
         app.stop()
+
+
+# ------------------------------------------------ sensor-catalog drift gate
+
+
+def _documented_sensor_names():
+    """Parse the docs/sensors.md table into (concrete names, regex
+    patterns).  Cell grammar the parser understands:
+
+      * ```a.b.c` ``                       one name
+      * ```a.b.c` / `.d` ``                suffix shorthand: second name
+                                           replaces the last segment(s)
+      * ```a.{x,y}` ``                     brace expansion
+      * ```a.<type>.rate` ``               placeholder -> regex pattern
+    """
+    import re
+
+    names: set[str] = set()
+    patterns: list[str] = []
+    with open(os.path.join(REPO, "docs", "sensors.md")) as f:
+        for line in f:
+            if not line.startswith("|") or line.startswith("|---"):
+                continue
+            cell = line.split("|")[1].strip()
+            if cell in ("sensor", ""):
+                continue
+            base = None
+            for tok in re.findall(r"`([^`]+)`", cell):
+                if tok.startswith("."):
+                    assert base is not None, f"suffix {tok!r} with no base"
+                    suffix = tok[1:].split(".")
+                    parts = base.split(".")
+                    tok = ".".join(parts[: len(parts) - len(suffix)] + suffix)
+                else:
+                    base = tok
+                m = re.match(r"(.*)\{([^}]+)\}(.*)", tok)
+                expanded = (
+                    [f"{m.group(1)}{alt}{m.group(3)}" for alt in m.group(2).split(",")]
+                    if m
+                    else [tok]
+                )
+                for name in expanded:
+                    if "<" in name:
+                        patterns.append(
+                            "^"
+                            + re.sub(r"<[^>]+>", r"[a-z0-9_-]+", re.escape(name).replace(
+                                re.escape("<"), "<").replace(re.escape(">"), ">"))
+                            + "$"
+                        )
+                    else:
+                        names.add(name)
+    assert names, "docs/sensors.md table parsed empty"
+    return names, patterns
+
+
+def test_runtime_sensor_names_are_documented():
+    """Every sensor a full-service smoke registers must appear in
+    docs/sensors.md — the sensors twin of the openapi<->endpoint-table
+    drift gate.  (The reverse direction is
+    test_documented_sensor_names_exist_in_source.)"""
+    import re
+
+    from cruise_control_tpu.service.main import build_simulated_service
+
+    documented, patterns = _documented_sensor_names()
+    app, fetcher, admin, sampler = build_simulated_service(seed=23)
+    try:
+        cc = app.cc
+        # drive the proposal pipeline + an execution so the monitor,
+        # analyzer, device-supervisor and executor surfaces all register
+        from cruise_control_tpu.service.progress import OperationProgress
+
+        result = cc.proposals(OperationProgress(), ignore_cache=True)
+        cc.rebalance(OperationProgress(), dryrun=False)
+        runtime = set(cc.sensors.snapshot())
+        assert result is not None and runtime
+        undocumented = {
+            n
+            for n in runtime
+            if n not in documented
+            and not any(re.match(p, n) for p in patterns)
+        }
+        assert not undocumented, (
+            f"sensors registered at runtime but missing from docs/sensors.md: "
+            f"{sorted(undocumented)}"
+        )
+    finally:
+        app.stop()
+
+
+def test_documented_sensor_names_exist_in_source():
+    """Every name docs/sensors.md lists must still exist in the package
+    source — a renamed/removed sensor must not leave a ghost row. Dynamic
+    (pattern) rows are checked by their literal fragments."""
+    import re
+
+    documented, patterns = _documented_sensor_names()
+    src = []
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "cruise_control_tpu")):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn)) as f:
+                    src.append(f.read())
+    blob = "\n".join(src)
+
+    def in_source(name: str) -> bool:
+        if name in blob:
+            return True
+        # f-string-built families (f"executor.recovery.{name}",
+        # f"analyzer.engine-cache-{name}"): accept a documented name whose
+        # prefix appears in source immediately followed by a placeholder
+        for i, ch in enumerate(name):
+            if ch in ".-" and name[: i + 1] + "{" in blob:
+                return True
+        return False
+
+    ghosts = [n for n in documented if not in_source(n)]
+    assert not ghosts, f"docs/sensors.md rows with no source analog: {ghosts}"
+    for p in patterns:
+        # ^anomaly\-detector\.[a-z0-9_-]+\.rate$ -> fragments around the
+        # placeholder must both appear in source
+        frags = [
+            re.sub(r"\\(.)", r"\1", frag)
+            for frag in re.split(r"\[[^\]]+\]\+", p.strip("^$"))
+        ]
+        for frag in frags:
+            assert frag.strip(".") == "" or frag in blob or frag.strip(".") in blob, (
+                f"pattern fragment {frag!r} from docs/sensors.md not in source"
+            )

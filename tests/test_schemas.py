@@ -86,7 +86,11 @@ CASES = [
     ("simulate", "POST",
      {"scenarios": '[{"name":"add-one","addBrokers":[{"count":1}]}]'}),
     ("rightsize", "GET", {}),
+    ("trace", "GET", {}),
 ]
+# /metrics is absent from CASES on purpose: its body is Prometheus TEXT,
+# validated by the exposition lint gate (scripts/check.sh +
+# tests/test_trace.py), not by the JSON schema walker.
 
 
 @pytest.mark.parametrize("endpoint,method,params", CASES,

@@ -196,10 +196,12 @@ def test_unknown_endpoint_and_bad_params(service):
 def test_endpoint_surface_complete():
     """The reference exposes 9 GET + 11 POST endpoints
     (CruiseControlEndPoint.java:16-37) — all must exist here, plus the
-    planner's read-only /rightsize (GET) and /simulate (POST)."""
+    planner's read-only /rightsize (GET) and /simulate (POST) and the
+    observability surface /trace + /metrics (GET)."""
     assert set(GET_ENDPOINTS) == {
         "bootstrap", "train", "load", "partition_load", "proposals", "state",
         "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
+        "trace", "metrics",
     }
     assert set(POST_ENDPOINTS) == {
         "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
@@ -871,3 +873,115 @@ def test_user_tasks_filters(service):
     # non-matching filter returns empty, not everything
     status, none, _ = _request(service, "GET", "user_tasks", client_ids="nobody")
     assert none["userTasks"] == []
+
+
+# -------------------------------------------- observability (PR 6 surface)
+
+
+def _raw_get(app, endpoint, **params):
+    """GET returning the raw body (the /metrics exposition is text)."""
+    req = urllib.request.Request(_url(app, endpoint, **params), method="GET")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.read().decode(), dict(resp.headers)
+
+
+def _flatten_spans(nodes):
+    out = []
+    for n in nodes:
+        out.append(n)
+        out.extend(_flatten_spans(n["children"]))
+    return out
+
+
+def test_metrics_endpoint_is_lintable_prometheus_text(service):
+    from cruise_control_tpu.common.exposition import parse_exposition
+
+    # make sure at least one proposal ran so analyzer sensors exist
+    _poll(service, "GET", "proposals")
+    status, body, headers = _raw_get(service, "metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    fams = parse_exposition(body)  # raises ExpositionError on any lint hit
+    assert "cruisecontrol_analyzer_proposal_computation_timer_seconds" in fams
+    assert (
+        fams["cruisecontrol_analyzer_proposal_computation_seconds"]["type"]
+        == "histogram"
+    )
+    # the device-memory surface registered by the facade is scrapeable
+    assert "cruisecontrol_tpu_device_live_buffers" in fams
+
+
+def test_trace_of_a_proposal_covers_monitor_analyzer_device(service):
+    """A fresh (cache-bypassing) proposal computation yields one trace
+    whose tree covers model build -> optimize -> supervised device op,
+    with the engine-run timing attached as span attributes."""
+    status, payload = _poll(
+        service, "GET", "proposals", ignore_proposal_cache="true"
+    )
+    assert status == 200
+    tid = payload.get("_traceId")
+    assert tid, "200 responses must carry the flight-recorder trace id"
+    status, trace, _ = _request(service, "GET", "trace", id=tid)
+    assert status == 200
+    assert trace["traceId"] == tid
+    spans = _flatten_spans(trace["spans"])
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], s)
+    assert "service.proposals" in by_name
+    assert by_name["service.proposals"]["parentId"] is None
+    assert "monitor.cluster_model" in by_name
+    assert by_name["monitor.cluster_model"]["attributes"]["brokers"] >= 6
+    opt = by_name["analyzer.optimize"]
+    assert opt["component"] == "analyzer"
+    attrs = opt["attributes"]
+    assert "device_s" in attrs
+    assert "engine_cache_hit" in attrs
+    assert "bucket" in attrs
+    # the supervised device op nests under the optimize span
+    dev = by_name["device.optimize"]
+    assert dev["component"] == "device"
+    assert dev["attributes"]["attempts"] >= 1
+    # every span of the tree shares the one trace id
+    assert {s["traceId"] for s in spans} == {tid}
+    # ...and the user-task record carries the same handle
+    status, tasks, _ = _request(service, "GET", "user_tasks")
+    assert tid in {t.get("TraceId") for t in tasks["userTasks"]}
+
+
+def test_trace_index_and_unknown_id(service):
+    _poll(service, "GET", "proposals")
+    status, payload, _ = _request(service, "GET", "trace")
+    assert status == 200
+    assert payload["traces"], "recent root traces must be listed"
+    names = {t["name"] for t in payload["traces"]}
+    assert any(n.startswith("service.") for n in names)
+    # limit is respected
+    status, one, _ = _request(service, "GET", "trace", limit=1)
+    assert len(one["traces"]) == 1
+    # unknown id -> 404, not an empty tree
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _request(service, "GET", "trace", id="deadbeef" * 4)
+    assert e.value.code == 404
+
+
+def test_tracing_disabled_service_serves_empty_surface():
+    """trace.enabled=false: no spans recorded, no _traceId riders, but the
+    endpoints stay well-formed (a scraper never 500s)."""
+    config = _service_config(**{
+        "trace.enabled": "false",
+        "tpu.num.candidates": 128,
+        "tpu.leadership.candidates": 32,
+        "tpu.steps.per.round": 16,
+        "tpu.num.rounds": 2,
+    })
+    app, fetcher, admin, sampler = build_simulated_service(config)
+    app.start()
+    try:
+        status, payload = _poll(app, "GET", "proposals")
+        assert status == 200
+        assert "_traceId" not in payload
+        status, idx, _ = _request(app, "GET", "trace")
+        assert status == 200 and idx["traces"] == []
+    finally:
+        app.stop()
