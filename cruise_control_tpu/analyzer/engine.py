@@ -484,6 +484,51 @@ class _WarmedFn:
         return getattr(self._jit, item)
 
 
+def start_warm_pool(targets, *, workers: int = 2):
+    """Trace+lower+compile jitted programs on background daemon threads.
+
+    targets: [(name, jit_fn, avals)]; returns {name: Future[compiled]}.
+    The ONE warm-overlap pool every engine variant shares: the plain
+    Engine warms its fused/scan programs through it and the mesh layer
+    (parallel/mesh.py) warms its shard_map'd whole-anneal program through
+    the same helper, so ahead-of-use tracing always overlaps the caller's
+    serial prelude the same way (see Engine.precompile_async for why this
+    replaced the round-4 AOT export cache).
+
+    DAEMON worker threads, not ThreadPoolExecutor: concurrent.futures
+    joins its (non-daemon) workers at interpreter exit, so a compile
+    stuck on an unresponsive device would block process shutdown forever.
+    Warm-up must never outlive the process.
+    """
+    import collections
+    import concurrent.futures as cf
+    import threading
+
+    queue = collections.deque(
+        (name, cf.Future(), fn, av) for name, fn, av in targets
+    )
+    futures = {name: fut for name, fut, _, _ in queue}
+
+    def worker():
+        while True:
+            try:
+                name, fut, fn, av = queue.popleft()
+            except IndexError:
+                return
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn.trace(*av).lower().compile())
+            except BaseException as e:  # noqa: BLE001 — surface via _fn
+                fut.set_exception(e)
+
+    for i in range(workers):
+        threading.Thread(
+            target=worker, daemon=True, name=f"engine-warm-{i}"
+        ).start()
+    return futures
+
+
 def _relu(x):
     return jnp.maximum(x, 0.0)
 
@@ -578,12 +623,7 @@ class Engine:
         """
         if self._warm_futures is not None:
             return
-        import concurrent.futures as cf
-
-        sx_av = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
-            self.statics,
-        )
+        sx_av = self.statics_avals()
         key_av = jax.ShapeDtypeStruct((2,), jnp.uint32)
         carry_av = jax.eval_shape(self._init_impl, sx_av, key_av)
         plan_av = jax.eval_shape(self._plan_impl, sx_av, carry_av)
@@ -607,35 +647,16 @@ class Engine:
                 ("_jit_round_prep", (sx_av, carry_av)),
                 ("_jit_eval", (sx_av, carry_av)),
             ]
-        # DAEMON worker threads, not ThreadPoolExecutor: concurrent.futures
-        # joins its (non-daemon) workers at interpreter exit, so a compile
-        # stuck on an unresponsive device would block process shutdown
-        # forever.  Warm-up must never outlive the process.
-        import collections
-        import threading
-
-        queue = collections.deque(
-            (name, cf.Future(), getattr(self, name), av) for name, av in targets
+        self._warm_futures = start_warm_pool(
+            [(name, getattr(self, name), av) for name, av in targets]
         )
-        self._warm_futures = {name: fut for name, fut, _, _ in queue}
 
-        def worker():
-            while True:
-                try:
-                    name, fut, fn, av = queue.popleft()
-                except IndexError:
-                    return
-                if not fut.set_running_or_notify_cancel():
-                    continue
-                try:
-                    fut.set_result(fn.trace(*av).lower().compile())
-                except BaseException as e:  # noqa: BLE001 — surface via _fn
-                    fut.set_exception(e)
-
-        for i in range(2):
-            threading.Thread(
-                target=worker, daemon=True, name=f"engine-warm-{i}"
-            ).start()
+    def statics_avals(self):
+        """Abstract shapes of the bound statics (warm-up / eval_shape input)."""
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+            self.statics,
+        )
 
     def _fn(self, name: str):
         """The program `name`, swapped to its precompiled executable once
@@ -1089,13 +1110,42 @@ class Engine:
             r = jnp.concatenate([r, r_imp])
         return r
 
-    def _replica_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+    def _slice_draws(self, slice_, *arrays):
+        """Candidate-axis sharding (parallel/mesh.py): keep only one mesh
+        shard's contiguous slice of the full-K draw vectors.
+
+        Drawing the FULL candidate index stream from a replicated key and
+        slicing afterwards keeps the stream identical for every mesh size
+        — the 1-vs-N-device byte-parity guarantee — while the expensive
+        per-candidate evaluation below the draws runs on K/n rows only.
+        Arrays are edge-padded to n*ceil(K/n) so the tiled all_gather on
+        the far side reassembles the exact full-K order (padding rows are
+        discarded after the gather).  slice_=None is the single-device
+        identity (the plain engine's path)."""
+        if slice_ is None:
+            return arrays if len(arrays) > 1 else arrays[0]
+        idx, n = slice_
+        out = []
+        for a in arrays:
+            size = -(-a.shape[0] // n)
+            pad = n * size - a.shape[0]
+            if pad:
+                a = jnp.concatenate(
+                    [a, jnp.broadcast_to(a[:1], (pad,) + a.shape[1:])]
+                )
+            out.append(jax.lax.dynamic_slice_in_dim(a, idx * size, size))
+        return tuple(out) if len(out) > 1 else out[0]
+
+    def _replica_candidates(
+        self, sx, carry: EngineCarry, key: jax.Array, g, plan=None, slice_=None
+    ):
         """K_r replica-move candidates -> (delta, src, dst, part, payload)."""
         st = sx.state
         K = self.K_r
         k1, k2 = jax.random.split(key)
         r = self._sample_sources(sx, k1, K, plan)
         dst = sx.dest_ids[_uniform_idx(k2, (K,), sx.n_dest)]
+        r, dst = self._slice_draws(slice_, r, dst)
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
 
@@ -1129,7 +1179,7 @@ class Engine:
 
         pot = st.replica_load_leader[r, int(Resource.NW_OUT)]
         lbin = jnp.where(is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0)
-        dcount = jnp.ones((K,), jnp.int32)
+        dcount = jnp.ones(r.shape, jnp.int32)
         dlcount = is_lead.astype(jnp.int32)
 
         delta = self._move_delta(
@@ -1195,11 +1245,13 @@ class Engine:
             stray = (dst != orig).astype(jnp.float32) - (src != orig).astype(jnp.float32)
             delta += plan.replica_cost * stray
 
-        payload = dict(kind=0, r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
+        payload = dict(r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
                        pot=pot, lbin=lbin, d_src=d_src)
         return delta, feasible, src, dst, part, payload
 
-    def _intra_disk_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+    def _intra_disk_candidates(
+        self, sx, carry: EngineCarry, key: jax.Array, g, plan=None, slice_=None
+    ):
         """K_r intra-broker disk-move candidates (JBOD rebalance_disk mode).
 
         Replicas move between a broker's OWN logdirs — no broker-level load
@@ -1213,7 +1265,7 @@ class Engine:
         st = sx.state
         K = self.K_r
         D = self.shape.max_disks_per_broker
-        r = self._sample_sources(sx, key, K, plan)
+        r = self._slice_draws(slice_, self._sample_sources(sx, key, K, plan))
         b = carry.replica_broker[r]
         d_src = carry.replica_disk[r]
         part = st.replica_partition[r]
@@ -1262,7 +1314,7 @@ class Engine:
             )
             delta += plan.replica_cost * stray
 
-        payload = dict(kind=0, r=r, dst=b, d_dst=d_dst, load=load, is_lead=is_lead,
+        payload = dict(r=r, dst=b, d_dst=d_dst, load=load, is_lead=is_lead,
                        pot=st.replica_load_leader[r, int(Resource.NW_OUT)],
                        lbin=jnp.where(
                            is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0
@@ -1270,7 +1322,9 @@ class Engine:
                        d_src=d_src)
         return delta, feasible, b, b, part, payload
 
-    def _swap_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+    def _swap_candidates(
+        self, sx, carry: EngineCarry, key: jax.Array, g, plan=None, slice_=None
+    ):
         """K_s replica-swap candidates: r <-> q exchange brokers (and disk
         slots).  Escapes local optima single relocations cannot leave through
         a feasible intermediate (reference AbstractGoal.maybeApplySwapAction:236,
@@ -1294,6 +1348,7 @@ class Engine:
         k1, k2 = jax.random.split(key)
         r = self._sample_sources(sx, k1, K, plan)
         q = _uniform_idx(k2, (K,), sx.n_source)
+        r, q = self._slice_draws(slice_, r, q)
         src = carry.replica_broker[r]
         dst = carry.replica_broker[q]
         part_r = st.replica_partition[r]
@@ -1362,7 +1417,7 @@ class Engine:
             dst=dst,
             dload_src=load_q - load_r,
             dload_dst=load_r - load_q,
-            dcount=jnp.zeros((K,), jnp.int32),
+            dcount=jnp.zeros(r.shape, jnp.int32),
             dlcount=lead_r.astype(jnp.int32) - lead_q.astype(jnp.int32),
             dpot=pot_r - pot_q,
             dlbin=lbin_r - lbin_q,
@@ -1447,7 +1502,9 @@ class Engine:
         )
         return delta, feasible, src, dst, part_r, part_q, payload
 
-    def _leadership_candidates(self, sx, carry: EngineCarry, key: jax.Array, g, plan=None):
+    def _leadership_candidates(
+        self, sx, carry: EngineCarry, key: jax.Array, g, plan=None, slice_=None
+    ):
         """K_l leadership-transfer candidates (reference relocateLeadership:374)."""
         st = sx.state
         K = self.K_l
@@ -1457,15 +1514,15 @@ class Engine:
             zi = jnp.zeros((0,), jnp.int32)
             zb = jnp.zeros((0,), bool)
             zl = jnp.zeros((0, NUM_RESOURCES), jnp.float32)
-            payload = dict(kind=1, rf=zi, rt=zi, dl_f=zl, dl_t=zl, dlbin_src=z, dlbin_dst=z)
+            payload = dict(rf=zi, rt=zi, dl_f=zl, dl_t=zl, dlbin_src=z, dlbin_dst=z)
             return z, zb, zi, zi, zi, payload
-        rt = _uniform_idx(key, (K,), sx.n_source)
+        rt = self._slice_draws(slice_, _uniform_idx(key, (K,), sx.n_source))
         part = st.replica_partition[rt]
         members = sx.part_replicas[part]  # [K, max_rf]
         m_valid = members < R
         m_idx = jnp.minimum(members, R - 1)
         m_lead = carry.replica_is_leader[m_idx] & m_valid
-        rf = m_idx[jnp.arange(K), jnp.argmax(m_lead, axis=1)]
+        rf = m_idx[jnp.arange(rt.shape[0]), jnp.argmax(m_lead, axis=1)]
 
         src, dst = carry.replica_broker[rf], carry.replica_broker[rt]
         dst_ok = st.broker_alive[dst] & st.disk_alive[dst, carry.replica_disk[rt]]
@@ -1490,9 +1547,9 @@ class Engine:
             dst=dst,
             dload_src=dl_f,
             dload_dst=dl_t,
-            dcount=jnp.zeros((K,), jnp.int32),
-            dlcount=jnp.ones((K,), jnp.int32),
-            dpot=jnp.zeros((K,), jnp.float32),
+            dcount=jnp.zeros(rt.shape, jnp.int32),
+            dlcount=jnp.ones(rt.shape, jnp.int32),
+            dpot=jnp.zeros(rt.shape, jnp.float32),
             dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
             dlbin=dlbin,
             d_src=carry.replica_disk[rf],
@@ -1522,7 +1579,7 @@ class Engine:
             )
             delta += plan.lead_cost * stray
 
-        payload = dict(kind=1, rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
+        payload = dict(rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
                        dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
                        dlbin_dst=dlbin)
         return delta, feasible, src, dst, part, payload
@@ -1635,23 +1692,48 @@ class Engine:
     # step: propose -> evaluate -> select -> apply
     # ------------------------------------------------------------------
 
-    def _propose(self, sx: EngineStatics, carry: EngineCarry, k_r, k_s, k_l, g, plan=None):
-        """Sample + evaluate all candidate kinds; return a selection/apply
-        bundle.  Payloads carry src broker / topic / partition explicitly so
-        `_apply` never has to index replica-axis arrays for them — which lets
-        the sharded engine (parallel/sharded.py) apply rows gathered from
-        OTHER devices' replica shards to its replicated broker aggregates.
-        """
-        st = sx.state
-        R1 = self.shape.R - 1
+    def _propose_kinds(
+        self, sx: EngineStatics, carry: EngineCarry, k_r, k_s, k_l, g,
+        plan=None, slice_=None,
+    ):
+        """Raw per-kind candidate bundles (replica/intra, swap, leadership).
+
+        With `slice_` (the mesh engine's candidate-axis sharding) each
+        bundle covers only this shard's contiguous slice of the full-K
+        stream; the mesh step all_gathers the bundles back into full-K
+        order before `_assemble_prop` — the candidate COLUMNS are the only
+        thing that ever crosses shards."""
         repl = (
             self._intra_disk_candidates
             if self.config.intra_broker
             else self._replica_candidates
         )
-        dr, fr, sr, tr, pr, payr = repl(sx, carry, k_r, g, plan)
-        ds, fs, ss, ts, ps1, ps2, pays = self._swap_candidates(sx, carry, k_s, g, plan)
-        dl, fl, sl, tl, pl, payl = self._leadership_candidates(sx, carry, k_l, g, plan)
+        return (
+            repl(sx, carry, k_r, g, plan, slice_=slice_),
+            self._swap_candidates(sx, carry, k_s, g, plan, slice_=slice_),
+            self._leadership_candidates(sx, carry, k_l, g, plan, slice_=slice_),
+        )
+
+    def _propose(self, sx: EngineStatics, carry: EngineCarry, k_r, k_s, k_l, g, plan=None):
+        """Sample + evaluate all candidate kinds; return a selection/apply
+        bundle.  Payloads carry src broker / topic / partition explicitly so
+        `_apply` never has to index replica-axis arrays for them — which lets
+        the mesh engine (parallel/mesh.py) assemble rows evaluated on OTHER
+        devices' candidate shards without touching their replica arrays.
+        """
+        return self._assemble_prop(
+            sx, carry, *self._propose_kinds(sx, carry, k_r, k_s, k_l, g, plan)
+        )
+
+    def _assemble_prop(self, sx: EngineStatics, carry: EngineCarry, raw_r, raw_s, raw_l):
+        """Concatenate per-kind bundles into the selection/apply bundle
+        (shared verbatim by the plain step and the mesh step's post-gather
+        path, so the two can never diverge)."""
+        st = sx.state
+        R1 = self.shape.R - 1
+        dr, fr, sr, tr, pr, payr = raw_r
+        ds, fs, ss, ts, ps1, ps2, pays = raw_s
+        dl, fl, sl, tl, pl, payl = raw_l
 
         delta = jnp.concatenate([dr, ds, dl])
         feas = jnp.concatenate([fr, fs, fl])
@@ -1714,6 +1796,15 @@ class Engine:
         key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
         g = self._globals(sx, carry)
         prop = self._propose(sx, carry, k_r, k_s, k_l, g, plan)
+        return self._accept_select_apply(sx, carry, prop, temperature, key, k_u)
+
+    def _accept_select_apply(
+        self, sx: EngineStatics, carry: EngineCarry, prop, temperature, key, k_u
+    ):
+        """Metropolis acceptance + conflict resolution + scatter, from a
+        full-K proposal bundle.  Shared by the plain step and the mesh
+        step (which only replaces how `prop` was produced), so acceptance
+        semantics cannot diverge between the two."""
         delta, feas = prop["delta"], prop["feas"]
 
         # Metropolis acceptance: delta < -T log u  (greedy at T=0)

@@ -140,6 +140,7 @@ class GoalOptimizer:
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         config: OptimizerConfig = OptimizerConfig(),
         parallel_mode: str = "single",
+        mesh_max_devices: int = 0,
         balancedness_weights: tuple[float, float] = (1.1, 1.5),
         engine_cache_size: int = 8,
         sensors=None,
@@ -150,9 +151,12 @@ class GoalOptimizer:
         profiler_dir: str | None = None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
-        device), "sharded" (model sharded over every device,
+        device), "sharded" (candidate axis sharded over the mesh,
         parallel/sharded.py), or "grid:RxM" (restart portfolio over model
-        shards, parallel/grid.py).
+        shards, parallel/grid.py) — both through the shared mesh engine
+        layer (parallel/mesh.py).  mesh_max_devices (config key
+        tpu.mesh.max.devices) caps how many visible devices the mesh is
+        built from; 0 (default) uses them all.
 
         balancedness_weights = (priority_weight, strictness_weight) for the
         0-100 balancedness score (reference AnalyzerConfig
@@ -196,16 +200,26 @@ class GoalOptimizer:
         self.constraint = constraint
         self.config = config
         self.parallel_mode = parallel_mode
+        if mesh_max_devices < 0:
+            raise ValueError(
+                f"mesh_max_devices must be >= 0, got {mesh_max_devices}"
+            )
+        self.mesh_max_devices = mesh_max_devices
         self.balancedness_weights = balancedness_weights
         self._grid_shape = parse_parallel_mode(parallel_mode)
+        # device probing stays lazy for the single-device default: only the
+        # mesh modes need a count, and jax.devices() on a wedged backend
+        # hangs outside any supervisor seam (the MULTICHIP_r05 class)
         if self._grid_shape is not None:
             r, m = self._grid_shape
-            if len(jax.devices()) < r * m:
+            n_avail = len(self._mesh_devices())
+            if n_avail < r * m:
                 raise ValueError(
                     f"tpu.parallel.mode={self.parallel_mode!r} needs "
-                    f"{r * m} devices, host has {len(jax.devices())}"
+                    f"{r * m} devices, host has {n_avail} "
+                    f"(tpu.mesh.max.devices={mesh_max_devices})"
                 )
-        elif self.parallel_mode != "single" and len(jax.devices()) < 2:
+        elif self.parallel_mode != "single" and len(self._mesh_devices()) < 2:
             # single-chip host: sharded degenerates to the local engine
             self.parallel_mode = "single"
         if engine_cache_size < 1:
@@ -434,8 +448,6 @@ class GoalOptimizer:
         of wedging the facade's precompute thread forever.  Degradation
         here has no fallback — a skipped prewarm just means the next
         bucket overflow pays its compile."""
-        if self.parallel_mode != "single":
-            return  # parallel engines compile on use; no async warm path
         sup = self.supervisor
         if sup is None:
             self._prewarm_on_device(state, options, config=config)
@@ -462,13 +474,22 @@ class GoalOptimizer:
     ) -> None:
         cfg = config or self.config
         key = (state.shape, cfg)
+        parallel = self.parallel_mode != "single"
+        cache = self._parallel_engines if parallel else self._engines
         with self._cache_lock:
-            if key in self._engines:
+            if key in cache:
                 return
-        engine = Engine(
-            state, self.chain, constraint=self.constraint, options=options, config=cfg
+        # mesh engines warm through the SAME pool as the plain engine
+        # (engine.start_warm_pool) — prewarm covers every parallel mode
+        engine = (
+            self._build_parallel_engine(state, options, cfg)
+            if parallel
+            else Engine(
+                state, self.chain, constraint=self.constraint,
+                options=options, config=cfg,
+            )
         )
-        if not self._cache_put(self._engines, key, engine, if_absent=True):
+        if not self._cache_put(cache, key, engine, if_absent=True):
             return  # a foreground request built the engine first
         self._record(False, count=False)
         try:
@@ -476,21 +497,32 @@ class GoalOptimizer:
         finally:
             self._unpin(engine)
 
+    def _mesh_devices(self):
+        """The devices the mesh engine layer may use: every visible device,
+        optionally capped by tpu.mesh.max.devices."""
+        import jax
+
+        devices = jax.devices()
+        if self.mesh_max_devices:
+            devices = devices[: self.mesh_max_devices]
+        return devices
+
     def _build_parallel_engine(
         self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
     ):
         from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
         from cruise_control_tpu.parallel.sharded import ShardedEngine, model_mesh
 
+        devices = self._mesh_devices()
         if self.parallel_mode == "sharded":
             return ShardedEngine(
-                state, self.chain, mesh=model_mesh(),
+                state, self.chain, mesh=model_mesh(devices),
                 constraint=self.constraint, options=options, config=config,
                 bucket=self.shape_bucket,
             )
         r, m = self._grid_shape
         return GridEngine(
-            state, self.chain, mesh=grid_mesh(r, m),
+            state, self.chain, mesh=grid_mesh(r, m, devices),
             constraint=self.constraint, options=options, config=config,
             bucket=self.shape_bucket,
         )
@@ -533,6 +565,7 @@ class GoalOptimizer:
                     for k in (
                         "device_s", "blocking_syncs", "host_extract_s",
                         "engine_cache_hit", "engine_build_s", "bucket",
+                        "mesh_shape", "collective_bytes",
                     )
                     if timing.get(k) is not None
                 },
@@ -662,12 +695,17 @@ class GoalOptimizer:
         try:
             if self.parallel_mode == "single":
                 engine, cache_info = self._engine_for(state, options, cfg)
-                # only at production scale: tiny test engines compile in
-                # hundreds of ms, and eagerly tracing the rarely-used
-                # programs (full-chain violations) would cost more than
-                # the overlap wins
-                if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
-                    engine.precompile_async()
+            else:
+                engine, cache_info = self._parallel_engine(state, options, cfg)
+            # only at production scale: tiny test engines compile in
+            # hundreds of ms, and eagerly tracing the rarely-used
+            # programs (full-chain violations) would cost more than
+            # the overlap wins.  Plain and mesh engines warm through the
+            # SAME pool (engine.start_warm_pool), so the sharded variants'
+            # shard_map tracing overlaps the report tracing below exactly
+            # like the single-device warm start.
+            if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
+                engine.precompile_async()
             (obj_b, viol_b), stats_b = self._report(state)
             # the proposal diff needs bulk BEFORE-state arrays on host;
             # pull them on a side thread while the device anneals — input
@@ -675,8 +713,6 @@ class GoalOptimizer:
             # compute the host would otherwise spend blocked on the engine
             with ThreadPoolExecutor(max_workers=1) as pool:
                 before_host_f = pool.submit(fetch_before_host, state)
-                if engine is None:
-                    engine, cache_info = self._parallel_engine(state, options, cfg)
                 # opt-in device profiling (config tpu.profiler.*): the
                 # engine run — where the XLA program actually executes —
                 # is the block a profiler dump illuminates
@@ -714,6 +750,17 @@ class GoalOptimizer:
             timing.update(cache_info)
         s = state.shape
         timing["bucket"] = dict(R=s.R, B=s.B, P=s.P, T=s.num_topics)
+        if self.sensors is not None and timing.get("mesh_shape"):
+            # mesh-engine observability (docs/sensors.md "analyzer.mesh-*"):
+            # shard count and per-round collective payload are the two
+            # numbers that decide whether cross-shard overhead is paying off
+            self.sensors.counter("analyzer.mesh-runs").inc()
+            self.sensors.gauge("analyzer.mesh-shards").set(
+                int(timing["mesh_shape"][1])
+            )
+            self.sensors.gauge("analyzer.mesh-collective-bytes").set(
+                int(timing.get("collective_bytes") or 0)
+            )
         final_checks = np.asarray(final_checks)
         if final_checks.any():
             bad = [n for n, c in zip(DEVICE_CHECKS, final_checks) if c]

@@ -117,9 +117,15 @@ def _analyzer_defs() -> ConfigDef:
             raise ConfigException(f"{name}: {e}") from e
 
     d.define("tpu.parallel.mode", T.STRING, "single", I.MEDIUM,
-             "multi-device strategy: single / sharded (model sharded over "
-             "all devices) / grid:RxM (restart portfolio over model shards)",
+             "multi-device strategy: single / sharded (candidate axis "
+             "sharded over the mesh, parallel/mesh.py) / grid:RxM "
+             "(restart portfolio over model shards)",
              _valid_parallel_mode, group=g)
+    d.define("tpu.mesh.max.devices", T.INT, 0, I.MEDIUM,
+             "cap on the devices the mesh engine layer builds its mesh "
+             "from for sharded/grid parallel modes (0 = every visible "
+             "device) — lets operators keep chips free for other tenants "
+             "or pin a power-of-two shard count", in_range(lo=0), group=g)
     d.define("tpu.shape.bucket.enabled", T.BOOLEAN, True, I.MEDIUM,
              "round cluster-model shapes (replicas/brokers/partitions/"
              "topics/racks/hosts) up to geometric buckets so compiled "
@@ -809,6 +815,9 @@ class CruiseControlConfig(AbstractConfig):
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")
+
+    def mesh_max_devices(self) -> int:
+        return self.get("tpu.mesh.max.devices")
 
     def device_supervisor(self, *, sensors=None, probe=None, tracer=None):
         """DeviceSupervisor from the tpu.supervisor.* keys; None when

@@ -1,30 +1,41 @@
-"""Multi-device parallelism: restart portfolios (DP) + model sharding.
+"""Multi-device parallelism: ONE mesh-native engine layer (mesh.py).
 
-Two orthogonal axes over a `jax.sharding.Mesh` (SURVEY §2.6):
-  * portfolio.py — independent SA chains sharded over devices, winner by
-    all_gather race (data parallelism over restarts);
-  * sharded.py — the cluster model itself sharded (replica/partition axes)
-    with replicated broker aggregates and psum'd refresh, for models
-    exceeding one chip's HBM ("replica-axis sharding is our sequence
-    parallelism").
+Every multi-device mode is a view of the same shard_map'd program over an
+explicit 2D ``Mesh((restart, model))`` (see mesh.py module docstring):
+
+  * sharded.py  — Mesh(1, n): one chain, candidate axis sharded n ways;
+  * portfolio.py — Mesh(n, 1): independent SA chains racing to the best
+    objective (data parallelism over restarts);
+  * grid.py     — Mesh(R, M): a portfolio OF candidate-sharded chains.
+
+The jit/shard_map/collective plumbing lives ONLY in mesh.py; the three
+mode modules are thin, named views of it.
 """
 
-from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
-from cruise_control_tpu.parallel.portfolio import default_mesh, portfolio_run
-from cruise_control_tpu.parallel.sharded import (
+from cruise_control_tpu.parallel.grid import GridEngine
+from cruise_control_tpu.parallel.mesh import (
     MODEL_AXIS,
-    ShardedEngine,
-    build_layout,
+    RESTART_AXIS,
+    MeshEngine,
+    default_mesh,
+    grid_mesh,
     model_mesh,
+    normalize_mesh,
+    shard_map_compat,
 )
+from cruise_control_tpu.parallel.portfolio import portfolio_run
+from cruise_control_tpu.parallel.sharded import ShardedEngine
 
 __all__ = [
     "GridEngine",
     "MODEL_AXIS",
+    "MeshEngine",
+    "RESTART_AXIS",
     "ShardedEngine",
-    "build_layout",
     "default_mesh",
     "grid_mesh",
     "model_mesh",
+    "normalize_mesh",
     "portfolio_run",
+    "shard_map_compat",
 ]

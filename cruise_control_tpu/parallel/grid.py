@@ -1,64 +1,43 @@
-"""2D-mesh optimization: restart portfolio OVER model-sharded chains.
+"""2D-mesh optimization: restart portfolio OVER candidate-sharded chains.
 
-Composes the two parallel axes (SURVEY §2.6/§7 M6) the way a training
-stack composes data and model parallelism:
+``GridEngine`` is the ``Mesh((restart=R, model=M))`` view of the shared
+mesh engine layer (parallel/mesh.py): R independent annealing chains race
+to the best objective, each with its candidate axis sharded M ways.  For a
+v5e-16 slice this means e.g. ``grid_mesh(4, 4)``: 4 restarts x 4-way
+candidate shards — chain diversity AND per-chain candidate throughput
+scale together.  The collectives are scoped to the model axis, so chains
+never interact until the host-side winner selection.
 
-  mesh ("restart", "model"): each restart group runs ONE independent
-  annealing chain whose cluster model is sharded across the "model" axis
-  (parallel/sharded.py semantics — all_gather'd candidates, psum'd
-  refresh, collectives scoped to "model" so chains never interact); the
-  best chain is selected at the end by comparing per-chain objectives.
-
-For a v5e-16 slice this means e.g. Mesh(4, 4): 4 restarts × 4-way model
-shards — candidate throughput AND HBM capacity scale together.  The
-statics (cluster data) are sharded over "model" and replicated over
-"restart": each model shard is stored once per restart group, never per
-device pair.
+Deliberately thin: the jit/shard_map plumbing that used to live here is
+parallel/mesh.py, shared verbatim with sharded.py and portfolio.py.
 """
 
 from __future__ import annotations
 
-import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from cruise_control_tpu.analyzer.engine import OptimizerConfig
-from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.analyzer.objective import GoalChain
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.state import ClusterState
-from cruise_control_tpu.parallel.portfolio import RESTART_AXIS
-from cruise_control_tpu.parallel.sharded import (
+from cruise_control_tpu.parallel.mesh import (
     MODEL_AXIS,
-    ShardedEngine,
-    _restack,
-    _shard_map,
-    _unstack,
+    RESTART_AXIS,
+    MeshEngine,
+    grid_mesh,
 )
 
-
-def grid_mesh(n_restarts: int, n_shards: int, devices=None) -> Mesh:
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    if devices.size < n_restarts * n_shards:
-        raise ValueError(
-            f"{devices.size} devices < {n_restarts}x{n_shards} grid"
-        )
-    grid = devices[: n_restarts * n_shards].reshape(n_restarts, n_shards)
-    return Mesh(grid, (RESTART_AXIS, MODEL_AXIS))
+__all__ = ["GridEngine", "grid_mesh", "MODEL_AXIS", "RESTART_AXIS"]
 
 
-class GridEngine(ShardedEngine):
-    """ShardedEngine whose carry carries an extra leading restart axis.
+class GridEngine(MeshEngine):
+    """MeshEngine constructed from an explicit 2D (restart, model) mesh.
 
-    The traced per-shard bodies are inherited unchanged — their collectives
-    name MODEL_AXIS explicitly, so under the 2D mesh each restart group is
-    an isolated chain; only the block (un)stacking and the final winner
-    selection differ.
-    """
+    Kept as a named class (rather than MeshEngine directly) for the
+    ``tpu.parallel.mode=grid:RxM`` wiring and its tests: a grid mode must
+    be handed a genuine 2D mesh, not silently reshaped from whatever
+    devices were lying around."""
 
     def __init__(
         self,
@@ -74,167 +53,7 @@ class GridEngine(ShardedEngine):
             raise ValueError(
                 f"grid mesh must have axes ({RESTART_AXIS!r}, {MODEL_AXIS!r})"
             )
-        self.n_restarts = int(mesh.shape[RESTART_AXIS])
-        #: diagnostics of the most recent COMPLETED run (None before/during)
-        self.last_info: dict | None = None
         super().__init__(
             state, chain, mesh=mesh, constraint=constraint, options=options,
             config=config, bucket=bucket,
         )
-
-    # ---- spec/stacking overrides: carry leaves are [r, m, ...] ----
-
-    def _build_jits(self):
-        spec_sx = P(MODEL_AXIS)     # statics: sharded by model, replicated
-        spec_c = P(RESTART_AXIS, MODEL_AXIS)  # per-chain, per-shard carry
-        self._jit_init = jax.jit(
-            _shard_map(self._init_fn, self.mesh,
-                       in_specs=(spec_sx, spec_c), out_specs=spec_c)
-        )
-        self._jit_round = jax.jit(
-            _shard_map(self._round_fn, self.mesh,
-                       in_specs=(spec_sx, spec_c, P()),
-                       out_specs=(spec_c, spec_c))
-        )
-        # fused multi-round program (inherited _run_fn body; the MODEL_AXIS
-        # collectives keep each restart chain isolated under the 2D mesh)
-        self._jit_run = jax.jit(
-            _shard_map(self._run_fn, self.mesh,
-                       in_specs=(spec_sx, spec_c, P()),
-                       out_specs=(spec_c, spec_c)),
-            donate_argnums=(1,),
-        )
-        self._jit_obj = jax.jit(
-            _shard_map(self._obj_fn, self.mesh,
-                       in_specs=(spec_sx, spec_c), out_specs=spec_c)
-        )
-
-    def _unstack_carry(self, blk):
-        return jax.tree.map(lambda x: x[0, 0], blk)
-
-    def _restack_carry(self, tree):
-        return jax.tree.map(lambda x: x[None, None], tree)
-
-    def _restack_stats(self, tree):
-        return jax.tree.map(lambda x: x[None, None], tree)
-
-    # ---- traced entry points (blocks: sx [1,...], carry [1,1,...]) ----
-
-    def _init_fn(self, sx_blk, keys_blk):
-        sx = _unstack(sx_blk)
-        key = keys_blk[0, 0]
-        carry = self._zero_carry(sx, key)
-        return self._restack_carry(self._sharded_refresh(sx, carry))
-
-    def _round_fn(self, sx_blk, carry_blk, temps):
-        sx = _unstack(sx_blk)
-        carry = self._unstack_carry(carry_blk)
-        carry, stats = self._run_round(sx, carry, temps)
-        return self._restack_carry(carry), self._restack_stats(stats)
-
-    def _obj_fn(self, sx_blk, carry_blk):
-        obj = self._sharded_objective(_unstack(sx_blk), self._unstack_carry(carry_blk))
-        return obj[None, None]
-
-    def objective(self, carry) -> float:
-        """Best chain's objective (the inherited accessor assumes a 1D
-        model-only mesh)."""
-        return float(np.asarray(self._jit_obj(self.statics, carry))[:, 0].min())
-
-    # ---- host-side driver ----
-
-    @device_op("grid.run")
-    def run(self, *, verbose: bool = False):
-        self.last_info = None  # never report a previous run's diagnostics
-        cfg = self.engine.config
-        if not cfg.fused_rounds:
-            return self._run_legacy(verbose=verbose)
-        t_start = time.monotonic()
-        keys = jax.random.split(
-            jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n
-        ).reshape(self.n_restarts, self.n, 2)
-        carry = self._jit_init(self.statics, keys)
-        objs0 = np.asarray(self._jit_obj(self.statics, carry))  # sync 1
-        t0_obj = float(objs0[0, 0]) * cfg.init_temperature_scale
-        temps = self._temp_schedule(t0_obj)
-        t_disp = time.monotonic()
-        carry, ys = self._jit_run(self.statics, carry, jnp.asarray(temps))
-        ys = jax.device_get(ys)  # sync 2: per-round, per-chain scalars
-        t_sync = time.monotonic()
-        accepted = np.asarray(ys["accepted"])  # [restarts, model, rounds]
-        objectives = np.asarray(ys["objective"])
-        history = []
-        for rnd in range(cfg.num_rounds):
-            rec = dict(
-                round=rnd, temperature=float(temps[rnd, 0]),
-                # per-chain counts: the stat is replicated across the model
-                # axis (computed from the all-gathered candidate set), so
-                # take shard column 0 of each chain
-                accepted=int(accepted[:, 0, rnd].sum()),
-            )
-            if verbose:
-                rec["objectives"] = objectives[:, 0, rnd].tolist()
-            history.append(rec)
-        history.append(dict(
-            timing=True, fused=True, blocking_syncs=2,
-            host_dispatch_s=round(t_disp - t_start, 6),
-            device_s=round(t_sync - t_disp, 6),
-        ))
-        # winner: best chain by final objective (identical across the model
-        # axis of a chain — take column 0; already fetched with the stats)
-        objs = objectives[:, 0, -1]
-        winner = int(np.argmin(objs))
-        win_carry = jax.tree.map(lambda x: x[winner], carry)
-        state = self.final_state(win_carry)
-        #: per-run diagnostics beyond the uniform (state, history) contract
-        self.last_info = {
-            "objectives": objs, "winner": winner,
-            "n_chains": self.n_restarts, "n_shards": self.n,
-        }
-        return state, history
-
-    def _run_legacy(self, *, verbose: bool = False):
-        """Legacy per-round loop (one dispatch + stats sync per round)."""
-        cfg = self.engine.config
-        t_start = time.monotonic()
-        syncs = 0
-        keys = jax.random.split(
-            jax.random.PRNGKey(cfg.seed), self.n_restarts * self.n
-        ).reshape(self.n_restarts, self.n, 2)
-        carry = self._jit_init(self.statics, keys)
-        objs0 = np.asarray(self._jit_obj(self.statics, carry))
-        syncs += 1
-        t0_obj = float(objs0[0, 0]) * cfg.init_temperature_scale
-        history = []
-        for rnd in range(cfg.num_rounds):
-            t_round = (
-                0.0 if rnd == cfg.num_rounds - 1
-                else t0_obj * (cfg.temperature_decay**rnd)
-            )
-            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            carry, stats = self._jit_round(self.statics, carry, temps)
-            rec = dict(
-                round=rnd, temperature=t_round,
-                accepted=int(np.asarray(stats["accepted"])[:, 0].sum()),
-            )
-            syncs += 1
-            if verbose:
-                rec["objectives"] = np.asarray(
-                    self._jit_obj(self.statics, carry)
-                )[:, 0].tolist()
-                syncs += 1
-            history.append(rec)
-        objs = np.asarray(self._jit_obj(self.statics, carry))[:, 0]
-        syncs += 1
-        winner = int(np.argmin(objs))
-        win_carry = jax.tree.map(lambda x: x[winner], carry)
-        state = self.final_state(win_carry)
-        history.append(dict(
-            timing=True, fused=False, blocking_syncs=syncs,
-            wall_s=round(time.monotonic() - t_start, 6),
-        ))
-        self.last_info = {
-            "objectives": objs, "winner": winner,
-            "n_chains": self.n_restarts, "n_shards": self.n,
-        }
-        return state, history

@@ -1,41 +1,40 @@
-"""Multi-device parallel optimization: sharded SA restart portfolio.
+"""Multi-device parallel optimization: SA restart portfolio.
 
 The reference parallelizes only across *cached proposal computations*
 (reference analyzer/GoalOptimizer.java:100-107 precompute thread pool); a
-single optimization is strictly sequential.  On TPU we get two axes:
+single optimization is strictly sequential.  On TPU the restart axis is
+free parallelism: independent annealing chains with different RNG seeds
+race over the mesh to the best objective.  SA restart portfolios dominate
+single long chains at equal device-seconds, and the axis scales to any
+mesh shape.
 
-  1. candidate axis — K moves evaluated per step inside one device's
-     vectorized step (engine.py);
-  2. restart axis — independent annealing chains with different RNG seeds,
-     sharded over the device mesh with `shard_map`, racing to the best
-     objective; the winner is selected with an `all_gather` + argmin over
-     ICI.  SA restart portfolios dominate single long chains at equal
-     device-seconds, and the axis scales to any mesh shape (pure DP —
-     SURVEY §2.6 "data-parallel over candidate plans").
+``portfolio_run`` is the explicit-schedule entry point (the caller hands a
+[rounds, steps] temperature schedule); it delegates to the shared mesh
+engine layer (parallel/mesh.py) with a ``Mesh((restart=n, model=1))``
+layout — one chain per device, every round device-resident, one
+winner-selection sync.  The shard_map/collective plumbing that used to
+live here is parallel/mesh.py, shared verbatim with sharded.py and
+grid.py.
 
 This module is mesh-shape agnostic: tests run it on an 8-device CPU mesh
-(`--xla_force_host_platform_device_count=8`), production on a TPU slice.
+(``--xla_force_host_platform_device_count=8``), production on a TPU slice.
 """
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from cruise_control_tpu.analyzer.engine import Engine, EngineCarry
+from cruise_control_tpu.analyzer.engine import Engine
 from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.models.state import ClusterState
+from cruise_control_tpu.parallel.mesh import (
+    RESTART_AXIS,
+    MeshEngine,
+    default_mesh,
+)
 
-RESTART_AXIS = "restart"
-
-
-def default_mesh(devices=None) -> Mesh:
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (RESTART_AXIS,))
+__all__ = ["RESTART_AXIS", "default_mesh", "portfolio_run"]
 
 
 @device_op("portfolio.run")
@@ -50,86 +49,16 @@ def portfolio_run(
 
     temps: f32[S] (one round) or f32[rounds, S] (multi-round).  Multi-round
     chains stay ENTIRELY device-resident — each chain refreshes its
-    aggregates and rebuilds its sampling plan between rounds in-graph
-    (engine._round_prep_impl), matching the fused single-device execution
-    model: one dispatch, one winner fetch, zero per-round host syncs.
+    aggregates and rebuilds its sampling plan between rounds in-graph,
+    matching the fused single-device execution model: one dispatch, one
+    winner fetch, zero per-round host syncs.
+
+    Wraps the caller's EXISTING engine (MeshEngine.from_engine): its
+    statics are re-placed as mesh-replicated arrays, so arrays an earlier
+    single-device run committed to one device can never poison the mesh
+    program (the r4 portfolio devices-mismatch failure mode); the caller's
+    engine is never mutated.
     """
-    temps = jnp.asarray(temps, jnp.float32)
-    if temps.ndim == 1:
-        temps = temps[None]
-    n = mesh.devices.size
-    keys = jax.random.split(jax.random.PRNGKey(seed), n)
-    run_round = engine._make_scan()
-    statics = engine.statics
-
-    def chain_fn(key, sx, carry: EngineCarry, plan):
-        # per-device chain: same initial carry, device-specific key
-        key = key.reshape(-1)[0:2].reshape(2)  # shard_map passes [1, 2]
-        carry = dataclasses.replace(carry, key=key)
-
-        def round_body(cp, t_row):
-            c, p = cp
-            c, stats = run_round(sx, c, t_row, p)
-            # between-rounds program: wash float drift, rebuild the
-            # chain-specific sampling plan — chains diverge, so the plan
-            # must too (the shared init plan only seeds round 0)
-            c, p, _cheap = engine._round_prep_impl(sx, c)
-            return (c, p), stats["accepted"].sum()
-
-        (carry, _), _accepted = jax.lax.scan(round_body, (carry, plan), temps)
-        obj = _sa_objective(engine, sx, carry)
-        # race resolution: gather objectives, broadcast the winner's placement
-        objs = jax.lax.all_gather(obj, RESTART_AXIS)  # [n]
-        best = jnp.argmin(objs)
-        placement = jnp.stack(
-            [
-                carry.replica_broker,
-                carry.replica_disk,
-                carry.replica_is_leader.astype(carry.replica_broker.dtype),
-            ]
-        )
-        all_placements = jax.lax.all_gather(placement, RESTART_AXIS)  # [n, 3, R]
-        winner = all_placements[best]
-        return winner[None], objs[None]
-
-    try:
-        from jax import shard_map
-
-        smap = shard_map(
-            chain_fn,
-            mesh=mesh,
-            in_specs=(P(RESTART_AXIS), P(), P(), P()),
-            out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
-            check_vma=False,
-        )
-    except (ImportError, TypeError):  # older jax
-        from jax.experimental.shard_map import shard_map
-
-        smap = shard_map(
-            chain_fn,
-            mesh=mesh,
-            in_specs=(P(RESTART_AXIS), P(), P(), P()),
-            out_specs=(P(RESTART_AXIS), P(RESTART_AXIS)),
-            check_rep=False,
-        )
-    sharded = jax.jit(smap)
-    carry0 = engine.init_carry(jax.random.PRNGKey(seed))
-    plan0 = engine._jit_plan(statics, carry0)
-    winners, objs = sharded(keys, statics, carry0, plan0)
-    # out axis stacks each device's all_gather copy: [n_dev, n_chains]
-    objs = np.asarray(objs).reshape(n, n)[0]
-    # every device computed the same winner; take device 0's copy
-    w = jax.device_get(winners)[0]
-    final_carry = dataclasses.replace(
-        carry0,
-        replica_broker=jnp.asarray(w[0]),
-        replica_disk=jnp.asarray(w[1]),
-        replica_is_leader=jnp.asarray(w[2]).astype(bool),
-    )
-    state = engine.carry_to_state(final_carry)
-    return state, {"objectives": objs, "n_chains": n}
-
-
-def _sa_objective(engine: Engine, sx, carry: EngineCarry):
-    """Scalar SA objective from carry aggregates (traceable, collective-free)."""
-    return engine.carry_objective(sx, carry)
+    me = MeshEngine.from_engine(engine, mesh)
+    state, info = me.run_schedule(temps, seed=seed)
+    return state, info

@@ -1,659 +1,44 @@
-"""Model-sharded optimization: replica/partition axes split over the mesh.
+"""Candidate-sharded optimization: one chain, K candidates split over devices.
 
-The restart portfolio (portfolio.py) is pure data parallelism — every device
-holds the WHOLE cluster model.  At reference scale that is fine (200k
-partitions ≈ tens of MB), but the design must also cover models that exceed
-one chip's HBM (SURVEY §2.6: "replica-axis sharding is our sequence
-parallelism"; §7 M6).  This module shards the MODEL itself:
+``ShardedEngine`` is the 1-chain view of the shared mesh engine layer
+(parallel/mesh.py): ``Mesh((restart=1, model=n))``.  Each step the full-K
+candidate stream is drawn from the replicated key, each device evaluates
+objective deltas for its K/n slice, and one tiled ``all_gather`` of the
+candidate COLUMNS reassembles the full-K bundle for the global conflict
+resolution that runs identically everywhere.  The model and carry are
+replicated, so a 1-device and an n-device run of the same seeded anneal
+produce byte-identical placements (mesh.py module docstring).
 
-  * The replica axis [R] and partition axis [P] are sharded across the mesh,
-    with a partition-grouped layout so every replica of a partition lives on
-    the same shard (leadership transfers and rack counts stay shard-local).
-  * The small broker/host/topic/disk aggregates ([B]-sized) are REPLICATED;
-    every device applies the same aggregate updates so they never diverge.
-  * Each step, every device samples candidates from ITS replica shard and
-    evaluates exact objective deltas locally (the broker aggregates it needs
-    are replicated).  Candidate metadata — not replica data — is exchanged
-    with one `all_gather` over the mesh axis, conflict resolution runs
-    identically everywhere, and each shard scatters only the placement rows
-    it owns (`Engine._apply` with r_offset/p_offset translation).
-  * Aggregate re-derivation (`refresh`) computes per-shard partial
-    segment-sums and `psum`s them over the mesh — the objective's partial
-    reductions ride ICI, never the host.
+This file is deliberately thin: every jit/shard_map/collective lives in
+parallel/mesh.py, shared verbatim with grid.py and portfolio.py.  The
+pre-round-6 replica/partition-axis sharding implementation that used to
+live here (per-shard RNG streams, psum'd aggregate refresh) was replaced —
+it made 1-vs-N parity impossible and ran ~22% slower than the plain engine
+at n=1 (VERDICT r5 item 4); replica-axis sharding for models exceeding one
+chip's HBM remains future work (ROADMAP item 1).
 
-Communication per step is O(num_candidates) floats — independent of R — so
-the design scales to arbitrarily large cluster models at constant per-step
-comm volume.  Candidate throughput also scales: n devices evaluate
-n × num_candidates moves per step.
-
-Swap partners are sampled within a shard (a swap across shards would need a
-second placement exchange); relocations and leadership transfers are
-unrestricted, so cross-shard mass still moves freely — shards partition the
-*partition id space*, not brokers.
-
-Shape bucketing (models.state.ShapeBucketPolicy): when constructed with a
-`bucket` policy, the input model is padded to its shape bucket BEFORE the
-shard split, so the per-device shard shapes derive from the bucketed
-global shape and survive topology churn (rebind instead of recompile),
-and exact-vs-bucketed builds of the same cluster shard — and anneal —
-identically.  The optimized placement is always reassembled onto the
-caller's original (unpadded) replica axis.
-
-Reference analog: none — the reference's optimizer is a single-threaded Java
-loop over one in-heap model (analyzer/goals/AbstractGoal.java:66-107).  This
-is the TPU-native scale-out story for it.
+Reference analog: none — the reference optimizer is a single-threaded Java
+loop (analyzer/goals/AbstractGoal.java:66-107).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
-
-from cruise_control_tpu.analyzer.engine import (
-    Engine,
-    EngineCarry,
-    OptimizerConfig,
-    build_statics,
-    partition_replica_table,
-)
-from cruise_control_tpu.analyzer.objective import GoalChain
-from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
-from cruise_control_tpu.common.device_watchdog import device_op
-from cruise_control_tpu.common.resources import NUM_RESOURCES
-from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
-from cruise_control_tpu.models.aggregates import compute_aggregates
-from cruise_control_tpu.models.state import (
-    ClusterShape,
-    ClusterState,
-    ShapeBucketPolicy,
+from cruise_control_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    MeshEngine,
+    model_mesh,
+    shard_map_compat,
 )
 
-MODEL_AXIS = "model"
+__all__ = ["MODEL_AXIS", "ShardedEngine", "model_mesh", "shard_map_compat"]
 
 
-def model_mesh(devices=None) -> Mesh:
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (MODEL_AXIS,))
+class ShardedEngine(MeshEngine):
+    """One annealing chain whose candidate axis is sharded over the mesh.
 
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    try:
-        from jax import shard_map
-
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)
-    except (ImportError, TypeError):  # older jax
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_rep=False)
-
-
-def _unstack(tree):
-    """[1, ...] shard_map block -> local pytree."""
-    return jax.tree.map(lambda x: x[0], tree)
-
-
-def _restack(tree):
-    return jax.tree.map(lambda x: x[None], tree)
-
-
-def _tree_stack(trees):
-    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees)
-
-
-@dataclasses.dataclass(frozen=True)
-class ShardLayout:
-    """Host-side partition-grouped sharding of a ClusterState.
-
-    orig_index[i, j] is the original replica id behind shard i's local slot
-    j, or -1 for padding — the inverse map used to reassemble the optimized
-    placement in the original replica order.
-    """
-
-    n_shards: int
-    R_local: int
-    P_local: int
-    max_rf: int
-    orig_index: np.ndarray  # i32[n, R_local]
-    local_states: list  # per-shard ClusterState (numpy-backed)
-
-
-def build_layout(
-    state: ClusterState,
-    n: int,
-    *,
-    bucket: ShapeBucketPolicy | None = None,
-) -> ShardLayout:
-    """Split `state` into n partition-aligned shards.
-
-    Partitions [i*P_local, (i+1)*P_local) and every replica of those
-    partitions land on shard i; each shard is padded to a uniform R_local so
-    the stacked arrays are rectangular.  R_local is data-dependent (the
-    fullest shard's replica count), so it is rounded up to a geometric
-    bucket: with the global shape itself bucketed at model-build time, the
-    per-device shard shapes then also stay stable under topology churn and
-    `rebind()` keeps hitting the compiled sharded programs.
-    """
-    s = state.shape
-    P_local = -(-s.P // n)  # ceil
-    valid = np.asarray(state.replica_valid)
-    part = np.asarray(state.replica_partition)
-    shard_of = np.where(valid, part // P_local, -1)
-    counts = np.bincount(shard_of[valid], minlength=n)
-    R_local = max(8, int(counts.max()))
-    if bucket is not None and bucket.enabled:
-        R_local = bucket.bucket(R_local)
-    R_local = int(-(-R_local // 8) * 8)  # pad to /8
-    counts_all = np.bincount(part[valid], minlength=s.P)
-    max_rf = max(1, int(counts_all.max())) if counts_all.size else 1
-
-    local_shape = ClusterShape(
-        num_replicas=R_local,
-        num_brokers=s.B,
-        num_partitions=P_local,
-        num_topics=s.num_topics,
-        num_racks=s.num_racks,
-        num_hosts=s.num_hosts,
-        max_disks_per_broker=s.max_disks_per_broker,
-    )
-    orig_index = np.full((n, R_local), -1, np.int64)
-    locals_: list[ClusterState] = []
-    repl_fields = [
-        "replica_broker", "replica_partition", "replica_topic", "replica_pos",
-        "replica_is_leader", "replica_valid", "replica_orig_broker",
-        "replica_offline", "replica_disk", "replica_load_leader",
-        "replica_load_follower",
-    ]
-    for i in range(n):
-        sel = np.nonzero(shard_of == i)[0]
-        k = sel.size
-        orig_index[i, :k] = sel
-        kw = {}
-        for f in repl_fields:
-            src = np.asarray(getattr(state, f))
-            pad_shape = (R_local,) + src.shape[1:]
-            dst = np.zeros(pad_shape, src.dtype)
-            dst[:k] = src[sel]
-            kw[f] = dst
-        kw["replica_partition"] = kw["replica_partition"] - np.int32(i * P_local)
-        kw["replica_partition"][k:] = 0
-        kw["replica_valid"][k:] = False
-        locals_.append(
-            dataclasses.replace(
-                state,
-                shape=local_shape,
-                **{f: jnp.asarray(v) for f, v in kw.items()},
-            )
-        )
-    return ShardLayout(
-        n_shards=n, R_local=R_local, P_local=P_local, max_rf=max_rf,
-        orig_index=orig_index, local_states=locals_,
-    )
-
-
-class ShardedEngine:
-    """Engine wrapper that runs ONE annealing chain over a sharded model.
-
-    Reuses Engine's candidate/delta/apply machinery on shard-local views; the
-    cross-shard glue (gather, global selection, psum'd refresh) lives here.
-    """
-
-    def __init__(
-        self,
-        state: ClusterState,
-        chain: GoalChain,
-        mesh: Mesh | None = None,
-        constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
-        options: OptimizationOptions = DEFAULT_OPTIONS,
-        config: OptimizerConfig = OptimizerConfig(),
-        bucket: ShapeBucketPolicy | None = None,
-    ):
-        """bucket: optional ShapeBucketPolicy (the GoalOptimizer passes the
-        service policy).  When set, the input model is padded to its shape
-        bucket BEFORE the shard split, so (a) the per-device shard shapes
-        derive from the bucketed global shape and stay stable under
-        topology churn, and (b) an exact and a bucketed build of the same
-        cluster shard identically — the trajectory-parity guarantee of the
-        single-device engine carries over to the sharded path.  The final
-        placement is always reassembled onto the ORIGINAL (unpadded)
-        state."""
-        self.mesh = mesh if mesh is not None else model_mesh()
-        # number of MODEL shards — on a 2D (restart, model) mesh this is the
-        # model-axis extent, not the device count
-        self.n = int(self.mesh.shape[MODEL_AXIS])
-        self._bucket = bucket if bucket is not None and bucket.enabled else None
-        self.global_state = state
-        self.layout = build_layout(self._padded(state), self.n, bucket=self._bucket)
-        self.P_total = self.layout.P_local * self.n
-        # local-shape engine: candidate generation + apply run per shard
-        self.engine = Engine(
-            self.layout.local_states[0], chain, constraint, options, config
-        )
-        self._bind(state, self.layout, options)
-        self._build_jits()
-
-    def _padded(self, state: ClusterState) -> ClusterState:
-        if self._bucket is None:
-            return state
-        from cruise_control_tpu.models.builder import pad_state
-
-        return pad_state(state, self._bucket.bucket_shape(state.shape))
-
-    def _bind(self, state: ClusterState, layout: ShardLayout,
-              options: OptimizationOptions) -> None:
-        """Point the engine at a model generation: stacked per-shard statics
-        from `layout`, honoring `options` (shared by __init__ and rebind so
-        the two can never diverge)."""
-        self.global_state = state
-        self.layout = layout
-        self._options = options
-        n_valid_global = jnp.asarray(
-            max(1.0, float(np.asarray(state.replica_valid).sum())), jnp.float32
-        )
-        statics_list = []
-        for ls in layout.local_states:
-            sx = build_statics(ls, options)
-            sx = dataclasses.replace(
-                sx,
-                n_valid=n_valid_global,
-                part_replicas=jnp.asarray(
-                    partition_replica_table(ls, max_rf=layout.max_rf)
-                ),
-            )
-            statics_list.append(sx)
-        self.statics = _tree_stack(statics_list)
-
-    def release(self) -> None:
-        """Drop device buffers on engine-cache eviction.
-
-        The inner Engine releases its engine-derived arrays; the shard-local
-        states and stacked statics are only DE-REFERENCED — their broker-axis
-        fields alias the caller's global ClusterState (and, unbucketed, the
-        replica fields too), so explicit delete() here would destroy arrays
-        the caller still holds (result.state_before, sibling engines).  The
-        engine-private shard arrays free via refcount as soon as these refs
-        drop.  The engine is unusable afterwards."""
-        self.engine.release()
-        self.statics = None
-        self.layout = None
-        self.global_state = None
-
-    def rebind(self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS):
-        """Swap in a new model generation without recompiling.
-
-        The shard layout's local shapes (R_local/P_local/max_rf) are
-        data-dependent; when they match the compiled ones the jitted
-        programs are reused, otherwise a ValueError tells the caller to
-        build a fresh engine (mirrors Engine.rebind's shape check).  With
-        a bucket policy the layout derives from the BUCKETED global shape,
-        so generations inside a bucket always match."""
-        lay = build_layout(self._padded(state), self.n, bucket=self._bucket)
-        old = self.layout
-        if (lay.R_local, lay.P_local, lay.max_rf) != (
-            old.R_local, old.P_local, old.max_rf
-        ):
-            raise ValueError(
-                "shard layout changed "
-                f"{(old.R_local, old.P_local, old.max_rf)} -> "
-                f"{(lay.R_local, lay.P_local, lay.max_rf)}; build a new engine"
-            )
-        self._bind(state, lay, options)
-        return self
-
-    def _build_jits(self):
-        spec_in = P(MODEL_AXIS)
-        self._jit_init = jax.jit(
-            _shard_map(
-                self._init_fn, self.mesh,
-                in_specs=(spec_in, spec_in), out_specs=spec_in,
-            )
-        )
-        self._jit_round = jax.jit(
-            _shard_map(
-                self._round_fn, self.mesh,
-                in_specs=(spec_in, spec_in, P()), out_specs=(spec_in, spec_in),
-            )
-        )
-        # fused multi-round program (engine.py execution model): ALL rounds
-        # chain on device — the per-round host dispatch+sync of the legacy
-        # loop disappears, and the carry is donated so each restart/model
-        # shard holds one placement copy in HBM
-        self._jit_run = jax.jit(
-            _shard_map(
-                self._run_fn, self.mesh,
-                in_specs=(spec_in, spec_in, P()), out_specs=(spec_in, spec_in),
-            ),
-            donate_argnums=(1,),
-        )
-        self._jit_obj = jax.jit(
-            _shard_map(
-                self._obj_fn, self.mesh,
-                in_specs=(spec_in, spec_in), out_specs=spec_in,
-            )
-        )
-
-    # ---- traced per-shard bodies (run inside shard_map) ----
-
-    def _sharded_refresh(self, sx, carry: EngineCarry) -> EngineCarry:
-        """Re-derive aggregates: local partial segment-sums + psum over mesh."""
-        eng = self.engine
-        state = eng.carry_to_state(carry, sx)
-        agg = compute_aggregates(state)  # partials (local replicas, full B axis)
-        psum = lambda x: jax.lax.psum(x, MODEL_AXIS)  # noqa: E731
-        broker_load = psum(agg.broker_load)
-        hseg = jnp.where(
-            state.broker_valid, state.broker_host, eng.shape.num_hosts
-        )
-        host_load = jax.ops.segment_sum(
-            broker_load, hseg, num_segments=eng.shape.num_hosts + 1
-        )[: eng.shape.num_hosts]
-        return dataclasses.replace(
-            carry,
-            broker_load=broker_load,
-            broker_replica_count=psum(agg.broker_replica_count),
-            broker_leader_count=psum(agg.broker_leader_count),
-            broker_potential_nw_out=psum(agg.broker_potential_nw_out),
-            broker_leader_bytes_in=psum(agg.broker_leader_bytes_in),
-            broker_topic_count=psum(agg.broker_topic_count),
-            part_rack_count=agg.part_rack_count,  # partition axis: shard-local
-            disk_load=psum(agg.disk_load),
-            host_load=host_load,
-        )
-
-    def _sharded_objective(self, sx, carry: EngineCarry):
-        """carry_objective with the partition/replica partials psum'd."""
-        eng = self.engine
-        g = eng._globals(sx, carry)
-        b = jnp.arange(eng.shape.B)
-        terms = eng._broker_terms(
-            sx, b,
-            carry.broker_load, carry.broker_replica_count,
-            carry.broker_leader_count, carry.broker_potential_nw_out,
-            carry.broker_leader_bytes_in, g,
-        ).sum()
-        rack_local = jnp.maximum(carry.part_rack_count - 1, 0).sum().astype(jnp.float32)
-        st = sx.state
-        off_local = (
-            st.replica_valid
-            & ~(
-                st.broker_alive[carry.replica_broker]
-                & st.disk_alive[carry.replica_broker, carry.replica_disk]
-            )
-        ).sum().astype(jnp.float32)
-        partials = jax.lax.psum(jnp.stack([rack_local, off_local]), MODEL_AXIS)
-        terms += eng.w.rack * partials[0] / sx.n_valid
-        terms += eng.w.offline * partials[1] / sx.n_valid
-        terms += eng._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
-        return terms
-
-    def _sharded_step(self, sx, carry: EngineCarry, temperature, plan):
-        eng = self.engine
-        idx = jax.lax.axis_index(MODEL_AXIS)
-        r_off = idx * self.layout.R_local
-        p_off = idx * self.layout.P_local
-
-        key, k_r, k_s, k_l, k_u = jax.random.split(carry.key, 5)
-        g = eng._globals(sx, carry)
-        prop = eng._propose(sx, carry, k_r, k_s, k_l, g, plan)
-
-        delta, feas = prop["delta"], prop["feas"]
-        K = delta.shape[0]
-        u = jax.random.uniform(k_u, (K,), minval=1e-12, maxval=1.0)
-        accept = feas & (delta < -temperature * jnp.log(u) - 1e-12)
-
-        # globalize replica/partition ids, then exchange candidate METADATA
-        # (O(K) floats — never replica data) across the mesh
-        payr = dict(prop["payr"])
-        payl = {k: v for k, v in prop["payl"].items() if not isinstance(v, int)}
-        payr["r"] = payr["r"] + r_off
-        payr["part"] = payr["part"] + p_off
-        payl["rf"] = payl["rf"] + r_off
-        payl["rt"] = payl["rt"] + r_off
-
-        gather = lambda x: jax.lax.all_gather(x, MODEL_AXIS, tiled=True)  # noqa: E731
-        delta_all = gather(delta)
-        accept_all = gather(accept)
-        src_all = gather(prop["src"])
-        dst_all = gather(prop["dst"])
-        p1_all = gather(prop["part1"] + p_off)
-        p2_all = gather(prop["part2"] + p_off)
-        payr_all = {k: gather(v) for k, v in payr.items()}
-        payl_all = {k: gather(v) for k, v in payl.items()}
-
-        # identical global conflict resolution on every shard
-        survive = eng._select(
-            accept_all, delta_all, src_all, dst_all, p1_all, p2_all,
-            num_parts=self.P_total,
-        )
-        nr, ns = prop["nr"], prop["ns"]
-        sv = survive.reshape(self.n, K)
-        sv_r_ext = jnp.concatenate(
-            [sv[:, :nr], sv[:, nr: nr + ns], sv[:, nr: nr + ns]], axis=1
-        ).reshape(-1)
-        sv_l = sv[:, nr + ns:].reshape(-1)
-
-        # replicated aggregates absorb ALL rows; placement scatters translate
-        # to shard-local ids and foreign rows drop out of range
-        carry = eng._apply(
-            sx, carry, sv_r_ext, payr_all, sv_l, payl_all,
-            r_offset=r_off, p_offset=p_off,
-        )
-        carry = dataclasses.replace(carry, key=key)
-        stats = dict(
-            accepted=survive.sum(),
-            improving=(accept_all & (delta_all < 0)).sum(),
-        )
-        return carry, stats
-
-    # ---- shard_map entry points (blocks have a leading axis of 1) ----
-
-    def _unstack_carry(self, blk):
-        """Carry block -> local pytree (GridEngine strips two axes)."""
-        return _unstack(blk)
-
-    def _restack_carry(self, tree):
-        return _restack(tree)
-
-    def _restack_stats(self, tree):
-        return jax.tree.map(lambda x: x[None], tree)
-
-    def _zero_carry(self, sx, key) -> EngineCarry:
-        eng = self.engine
-        st = sx.state
-        B = eng.shape.B
-        return EngineCarry(
-            replica_broker=st.replica_broker,
-            replica_is_leader=st.replica_is_leader,
-            replica_disk=st.replica_disk,
-            broker_load=jnp.zeros((B, NUM_RESOURCES), jnp.float32),
-            broker_replica_count=jnp.zeros(B, jnp.int32),
-            broker_leader_count=jnp.zeros(B, jnp.int32),
-            broker_potential_nw_out=jnp.zeros(B, jnp.float32),
-            broker_leader_bytes_in=jnp.zeros(B, jnp.float32),
-            broker_topic_count=jnp.zeros((eng.shape.num_topics, B), jnp.int32),
-            part_rack_count=jnp.zeros(
-                (eng.shape.P, eng.shape.num_racks), jnp.int32
-            ),
-            disk_load=jnp.zeros((B, eng.shape.max_disks_per_broker), jnp.float32),
-            host_load=jnp.zeros((eng.shape.num_hosts, NUM_RESOURCES), jnp.float32),
-            key=key,
-        )
-
-    def _run_round(self, sx, carry: EngineCarry, temps):
-        """One annealing round on local blocks: plan + scan + refresh."""
-        eng = self.engine
-        plan = eng._plan_impl(sx, carry)
-        # reprice movement against the GLOBAL objective (the local plan's
-        # pricing only saw this shard's rack/offline partials)
-        unit = self._sharded_objective(sx, carry) / sx.n_valid
-        plan = dataclasses.replace(
-            plan,
-            replica_cost=eng.config.replica_move_cost * unit,
-            lead_cost=eng.config.leadership_move_cost * unit,
-        )
-
-        def body(c, t):
-            return self._sharded_step(sx, c, t, plan)
-
-        carry, stats = jax.lax.scan(body, carry, temps)
-        return self._sharded_refresh(sx, carry), stats
-
-    def _init_fn(self, sx_blk, keys_blk):
-        sx = _unstack(sx_blk)
-        carry = self._zero_carry(sx, keys_blk[0])
-        return _restack(self._sharded_refresh(sx, carry))
-
-    def _round_fn(self, sx_blk, carry_blk, temps):
-        sx = _unstack(sx_blk)
-        carry, stats = self._run_round(sx, self._unstack_carry(carry_blk), temps)
-        return self._restack_carry(carry), self._restack_stats(stats)
-
-    def _run_fn(self, sx_blk, carry_blk, temps2d):
-        """Fused multi-round body: scan over rounds, each round = plan +
-        step scan + psum'd refresh, all device-resident.  temps2d is the
-        f32[rounds, steps] schedule; per-round scalar stats (accept count,
-        SA objective) come back stacked so the host syncs ONCE."""
-        sx = _unstack(sx_blk)
-        carry = self._unstack_carry(carry_blk)
-
-        def body(c, t_row):
-            c, stats = self._run_round(sx, c, t_row)
-            # per-round SA objective (carry sufficient-statistics, O(B +
-            # R_local) + a 2-scalar psum — marginal next to the round's
-            # step scan): GridEngine's winner selection reads the last
-            # round's value and verbose histories read them all, with no
-            # extra dispatch or sync for either
-            return c, dict(
-                accepted=stats["accepted"].sum(),
-                objective=self._sharded_objective(sx, c),
-            )
-
-        carry, ys = jax.lax.scan(body, carry, temps2d)
-        return self._restack_carry(carry), self._restack_stats(ys)
-
-    def _obj_fn(self, sx_blk, carry_blk):
-        obj = self._sharded_objective(_unstack(sx_blk), self._unstack_carry(carry_blk))
-        return obj[None]
-
-    # ---- host-side driver ----
-
-    def _temp_schedule(self, t0_obj: float) -> np.ndarray:
-        """f32[rounds, steps] host-built temperature schedule (same values
-        the legacy per-round loop dispatches; last round T=0)."""
-        cfg = self.engine.config
-        temps = np.zeros((cfg.num_rounds, cfg.steps_per_round), np.float32)
-        for rnd in range(cfg.num_rounds - 1):
-            temps[rnd] = t0_obj * (cfg.temperature_decay**rnd)
-        return temps
-
-    @device_op("sharded.run")
-    def run(self, *, verbose: bool = False):
-        """Execute the annealing schedule over the sharded model.
-
-        Default (fused_rounds): ONE device-resident program runs every
-        round (plan + scan + psum'd refresh chained in-graph); the host
-        syncs twice — the initial objective for the temperature scale, and
-        the per-round scalar stats.  `fused_rounds=False` falls back to
-        the legacy one-dispatch-per-round loop.
-        """
-        cfg = self.engine.config
-        if not cfg.fused_rounds:
-            return self._run_legacy(verbose=verbose)
-        t_start = time.monotonic()
-        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), self.n)
-        carry = self._jit_init(self.statics, keys)
-        t0_obj = float(np.asarray(self._jit_obj(self.statics, carry))[0])  # sync 1
-        t0_obj *= cfg.init_temperature_scale
-        temps = self._temp_schedule(t0_obj)
-        t_disp = time.monotonic()
-        carry, ys = self._jit_run(self.statics, carry, jnp.asarray(temps))
-        ys = jax.device_get(ys)  # sync 2: O(rounds) scalars, carry stays put
-        t_sync = time.monotonic()
-        accepted = np.asarray(ys["accepted"])[0]
-        objectives = np.asarray(ys["objective"])[0]
-        history = []
-        for rnd in range(cfg.num_rounds):
-            rec = dict(
-                round=rnd,
-                temperature=float(temps[rnd, 0]),
-                accepted=int(accepted[rnd]),
-            )
-            if verbose:
-                rec["objective"] = float(objectives[rnd])
-            history.append(rec)
-        history.append(dict(
-            timing=True, fused=True, blocking_syncs=2,
-            host_dispatch_s=round(t_disp - t_start, 6),
-            device_s=round(t_sync - t_disp, 6),
-        ))
-        return self.final_state(carry), history
-
-    def _run_legacy(self, *, verbose: bool = False):
-        """Legacy per-round loop: one jitted round + one blocking stats
-        sync per round (kept for parity testing and per-round debugging)."""
-        cfg = self.engine.config
-        t_start = time.monotonic()
-        syncs = 0
-        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), self.n)
-        carry = self._jit_init(self.statics, keys)
-        t0_obj = float(np.asarray(self._jit_obj(self.statics, carry))[0])
-        syncs += 1
-        t0_obj *= cfg.init_temperature_scale
-        history = []
-        for rnd in range(cfg.num_rounds):
-            t_round = (
-                0.0 if rnd == cfg.num_rounds - 1
-                else t0_obj * (cfg.temperature_decay**rnd)
-            )
-            temps = jnp.full((cfg.steps_per_round,), t_round, jnp.float32)
-            carry, stats = self._jit_round(self.statics, carry, temps)
-            rec = dict(
-                round=rnd,
-                temperature=t_round,
-                accepted=int(np.asarray(stats["accepted"])[0].sum()),
-            )
-            syncs += 1
-            if verbose:
-                rec["objective"] = float(np.asarray(self._jit_obj(self.statics, carry))[0])
-                syncs += 1
-            history.append(rec)
-        history.append(dict(
-            timing=True, fused=False, blocking_syncs=syncs,
-            wall_s=round(time.monotonic() - t_start, 6),
-        ))
-        return self.final_state(carry), history
-
-    def objective(self, carry) -> float:
-        return float(np.asarray(self._jit_obj(self.statics, carry))[0])
-
-    def final_state(self, carry) -> ClusterState:
-        """Reassemble the optimized placement in the original replica order."""
-        lay = self.layout
-        rb = np.asarray(carry.replica_broker)  # [n, R_local]
-        rl = np.asarray(carry.replica_is_leader)
-        rd = np.asarray(carry.replica_disk)
-        st = self.global_state
-        g_rb = np.array(np.asarray(st.replica_broker))
-        g_rl = np.array(np.asarray(st.replica_is_leader))
-        g_rd = np.array(np.asarray(st.replica_disk))
-        own = lay.orig_index >= 0
-        idx = lay.orig_index[own]
-        g_rb[idx] = rb[own]
-        g_rl[idx] = rl[own]
-        g_rd[idx] = rd[own]
-        alive = np.asarray(st.broker_alive)
-        dalive = np.asarray(st.disk_alive)
-        offline = ~(alive[g_rb] & dalive[g_rb, g_rd]) & np.asarray(st.replica_valid)
-        return dataclasses.replace(
-            st,
-            replica_broker=jnp.asarray(g_rb),
-            replica_is_leader=jnp.asarray(g_rl),
-            replica_disk=jnp.asarray(g_rd),
-            replica_offline=jnp.asarray(offline),
-        )
+    Constructor contract (state, chain, mesh, constraint, options, config,
+    bucket) is inherited unchanged from MeshEngine; a 1D ``(model,)`` mesh
+    (``model_mesh()``) is normalized to the canonical 2D ``(restart=1,
+    model=n)`` layout.  ``run()`` executes the plain engine's fused
+    multi-round schedule; at n=1 the traced program IS the plain fused
+    program (no collective is emitted)."""

@@ -130,6 +130,7 @@ class CruiseControl:
             constraint=self.constraint,
             config=config.optimizer_config(),
             parallel_mode=config.parallel_mode(),
+            mesh_max_devices=config.mesh_max_devices(),
             balancedness_weights=self.balancedness_weights,
             engine_cache_size=config.get("tpu.engine.cache.size"),
             sensors=self.sensors,

@@ -9,9 +9,9 @@ say "the second engine invocation OOMs" and mean exactly that.
 
 Two injection surfaces:
 
-  * device ops — everything marked `@device_op` (Engine.run,
-    ShardedEngine.run, GridEngine.run, portfolio_run, and the watchdog's
-    trivial-op probe) routes through ONE process-wide hook
+  * device ops — everything marked `@device_op` (Engine.run, the mesh
+    layer's MeshEngine.run (sharded/grid), portfolio_run, and the
+    watchdog's trivial-op probe) routes through ONE process-wide hook
     (common/device_watchdog.set_device_op_hook).  `device_fault` installs
     an interceptor on that seam; `device_wedged` is the composite that
     models the observed failure (MULTICHIP_r05): EVERY device op —
@@ -43,7 +43,7 @@ from cruise_control_tpu.common.device_watchdog import set_device_op_hook
 #: error-class injectors must not break the recovery probe, only
 #: `device_wedged` models a device that fails the probe too)
 ENGINE_OPS = (
-    "engine.run", "sharded.run", "grid.run", "portfolio.run",
+    "engine.run", "mesh.run", "portfolio.run",
     "scenario.batch-eval",
 )
 PROBE_OP = "probe"

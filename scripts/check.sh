@@ -36,6 +36,15 @@ echo "== check.sh: bench.py --smoke (fused vs legacy perf path, CPU) =="
 GRAFT_FORCE_CPU=1 python bench.py --smoke
 smoke_rc=$?
 
+echo "== check.sh: bench.py --mesh-smoke (1-vs-8-device mesh parity, CPU) =="
+# named gate: a 1-device and an 8-virtual-device run of the same seeded
+# anneal must reproduce the plain engine's placements byte-for-byte, and
+# the per-round collective payload must match the gather-candidates-only
+# schedule (0 bytes at n=1) — the mesh engine layer's core invariants
+GRAFT_FORCE_CPU=1 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python bench.py --mesh-smoke
+mesh_rc=$?
+
 echo "== check.sh: bench.py --churn --smoke (shape-bucketed serving, CPU) =="
 GRAFT_FORCE_CPU=1 python bench.py --churn --smoke
 churn_rc=$?
@@ -117,5 +126,5 @@ python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]

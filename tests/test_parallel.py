@@ -68,3 +68,50 @@ def test_portfolio_multi_round_device_resident():
     obj_1, _, _ = DEFAULT_CHAIN.evaluate(final_1)
     # 3 greedy rounds from the same seeds can only improve on round 1
     assert float(obj_multi) <= float(obj_1) + max(1e-5, abs(float(obj_1)) * 1e-3)
+
+
+def test_mesh_modes_after_device_committed_service_run():
+    """Regression for the r4 multi-device failure: the in-process service
+    COMMITS engine arrays to one device (its single-device optimize run),
+    and the mesh programs that ran afterwards in the same process crashed
+    with a devices mismatch (r4 `portfolio.py:99`).  The mesh layer now
+    places explicit mesh-replicated copies (`MeshEngine._place_statics`),
+    so service-then-mesh must work in ONE process, in this order."""
+    from cruise_control_tpu.analyzer import DEFAULT_CHAIN as CHAIN
+    from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
+    from cruise_control_tpu.parallel.sharded import ShardedEngine, model_mesh
+    from cruise_control_tpu.service.main import build_simulated_service
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    # 1) boot the service and run one proposal computation: engine statics
+    #    and carries are now device-committed arrays on jax.devices()[0]
+    app, _fetcher, _admin, _sampler = build_simulated_service(seed=1)
+    try:
+        result = app.cc.proposals(OperationProgress())
+        assert result.proposals is not None
+    finally:
+        app.cc.shutdown()
+
+    # 2) the SAME process now runs every mesh mode on the virtual mesh —
+    #    the exact sequence that crashed in r4
+    state = random_cluster(
+        RandomClusterSpec(num_brokers=10, num_partitions=120, skew=1.5), seed=23
+    )
+    cfg = OptimizerConfig(
+        num_candidates=64, leadership_candidates=16, steps_per_round=4,
+        num_rounds=2,
+    )
+    eng = Engine(state, CHAIN, config=cfg)
+    eng.run()  # commit this engine's buffers to device 0 too
+    temps = jnp.zeros((2, 4), jnp.float32)
+    final, info = portfolio_run(eng, default_mesh(), temps, seed=1)
+    validate(final)
+    assert info["n_chains"] == len(jax.devices())
+
+    se = ShardedEngine(state, CHAIN, mesh=model_mesh(), config=cfg)
+    sharded_final, _ = se.run()
+    validate(sharded_final)
+
+    ge = GridEngine(state, CHAIN, mesh=grid_mesh(2, 4), config=cfg)
+    grid_final, _ = ge.run()
+    validate(grid_final)

@@ -1,8 +1,11 @@
-"""Model-sharded engine tests (8-device virtual CPU mesh, conftest.py).
+"""Mesh-engine tests (8-device virtual CPU mesh, conftest.py).
 
-Exercises parallel/sharded.py: the cluster model's replica/partition axes
-are explicitly sharded across the mesh (one shard per device), candidates
-are exchanged with all_gather, refresh psums partial aggregates.
+Exercises the shared mesh layer (parallel/mesh.py) through its sharded and
+grid views: the candidate axis of the anneal is sharded over MODEL_AXIS
+(full-K draws from a replicated key, per-shard delta evaluation, one tiled
+all_gather of the candidate columns), so a 1-device and an n-device run of
+the same seeded anneal are BYTE-IDENTICAL — the property pinned here and
+by `bench.py --mesh-smoke`.
 """
 
 import dataclasses
@@ -16,15 +19,19 @@ import numpy as np
 from cruise_control_tpu.analyzer import DEFAULT_CHAIN, Engine, OptimizerConfig
 from cruise_control_tpu.models.aggregates import compute_aggregates
 from cruise_control_tpu.models.state import validate
-from cruise_control_tpu.parallel.sharded import (
-    ShardedEngine,
-    build_layout,
-    model_mesh,
+from cruise_control_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    RESTART_AXIS,
+    normalize_mesh,
 )
+from cruise_control_tpu.parallel.sharded import ShardedEngine, model_mesh
 from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster
 
+# K_r=60 is deliberately NOT divisible by 8: shard slices are edge-padded
+# to n*ceil(K/n) and trimmed after the gather, and an aligned-only config
+# would leave that path untested.
 CFG = OptimizerConfig(
-    num_candidates=64,
+    num_candidates=60,
     leadership_candidates=16,
     swap_candidates=8,
     steps_per_round=6,
@@ -38,30 +45,6 @@ def _state(seed=21, brokers=12, parts=160):
         RandomClusterSpec(num_brokers=brokers, num_partitions=parts, skew=1.5),
         seed=seed,
     )
-
-
-def test_layout_partition_aligned_and_invertible():
-    state = _state()
-    n = 8
-    lay = build_layout(state, n)
-    assert lay.n_shards == n
-    total_valid = int(np.asarray(state.replica_valid).sum())
-    owned = lay.orig_index[lay.orig_index >= 0]
-    assert owned.size == total_valid
-    assert np.unique(owned).size == owned.size  # each replica exactly once
-    part = np.asarray(state.replica_partition)
-    for i in range(n):
-        idx = lay.orig_index[i][lay.orig_index[i] >= 0]
-        if idx.size:
-            p = part[idx]
-            assert p.min() >= i * lay.P_local and p.max() < (i + 1) * lay.P_local
-        ls = lay.local_states[i]
-        assert ls.shape.R == lay.R_local and ls.shape.P == lay.P_local
-        # local loads must match the original rows
-        np.testing.assert_allclose(
-            np.asarray(ls.replica_load_leader)[: idx.size],
-            np.asarray(state.replica_load_leader)[idx],
-        )
 
 
 def _rounds(history):
@@ -79,53 +62,101 @@ def test_sharded_engine_improves_and_validates():
     obj1, _, _ = DEFAULT_CHAIN.evaluate(final)
     assert float(obj1) < float(obj0)
     assert sum(h["accepted"] for h in _rounds(history)) > 0
-    # fused (default) sharded rounds: O(1) blocking syncs, not O(rounds)
+    # the whole multi-round anneal is ONE device program: a single
+    # winner/stats sync, and the timing record names the mesh
     timing = next(h for h in history if h.get("timing"))
-    assert timing["fused"] is True and timing["blocking_syncs"] == 2
+    assert timing["fused"] is True and timing["blocking_syncs"] == 1
+    assert timing["mesh_shape"] == [1, 8]
+    assert timing["collective_bytes"] > 0
 
 
-def test_sharded_fused_matches_legacy_rounds():
-    """Fused-vs-legacy parity on the SHARDED engine: at T=0 with a fixed
-    seed the device-resident multi-round program must reproduce the legacy
-    per-round dispatch loop's placement exactly."""
+def test_sharded_byte_parity_plain_vs_1_vs_8_devices():
+    """THE mesh-layer invariant: the same seeded anneal on the plain
+    engine, a 1-device mesh, and an 8-device mesh produces byte-identical
+    placements and identical per-round acceptance counts.  Full-K draws
+    from the replicated key + row-local delta math + in-order gather means
+    the mesh size can never leak into the trajectory."""
     state = _state(seed=27, brokers=10, parts=144)
-    mesh = model_mesh(np.asarray(jax.devices()[:8]))
-    base = dataclasses.replace(CFG, init_temperature_scale=0.0)
-    se_f = ShardedEngine(
-        state, DEFAULT_CHAIN, mesh=mesh,
-        config=dataclasses.replace(base, fused_rounds=True),
+    eng = Engine(state, DEFAULT_CHAIN, config=CFG)
+    plain, hist_p = eng.run()
+    se1 = ShardedEngine(
+        state, DEFAULT_CHAIN, mesh=model_mesh(np.asarray(jax.devices()[:1])),
+        config=CFG,
     )
-    final_f, hist_f = se_f.run()
-    se_l = ShardedEngine(
-        state, DEFAULT_CHAIN, mesh=mesh,
-        config=dataclasses.replace(base, fused_rounds=False),
+    s1, hist_1 = se1.run()
+    se8 = ShardedEngine(
+        state, DEFAULT_CHAIN, mesh=model_mesh(np.asarray(jax.devices()[:8])),
+        config=CFG,
     )
-    final_l, hist_l = se_l.run()
-    np.testing.assert_array_equal(
-        np.asarray(final_f.replica_broker), np.asarray(final_l.replica_broker)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(final_f.replica_is_leader), np.asarray(final_l.replica_is_leader)
-    )
-    assert [h["accepted"] for h in _rounds(hist_f)] == [
-        h["accepted"] for h in _rounds(hist_l)
-    ]
+    s8, hist_8 = se8.run()
+    for label, other in (("1-device", s1), ("8-device", s8)):
+        np.testing.assert_array_equal(
+            np.asarray(plain.replica_broker), np.asarray(other.replica_broker),
+            err_msg=f"{label} placement diverged from the plain engine",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.replica_is_leader),
+            np.asarray(other.replica_is_leader),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.replica_disk), np.asarray(other.replica_disk)
+        )
+    acc = lambda h: [r["accepted"] for r in _rounds(h)]  # noqa: E731
+    assert acc(hist_p) == acc(hist_1) == acc(hist_8)
 
 
-def test_sharded_aggregates_match_unsharded():
-    """The psum'd refresh must produce the same replicated broker aggregates
-    a single-device engine derives from the whole model."""
+def test_sharded_n1_emits_no_collective():
+    """At n=1 the shard slice is the identity and the traced program IS
+    the plain fused program — no all_gather, zero collective payload (the
+    <10% n=1 overhead guarantee rests on this)."""
+    state = _state(seed=5, brokers=8, parts=96)
+    se1 = ShardedEngine(
+        state, DEFAULT_CHAIN, mesh=model_mesh(np.asarray(jax.devices()[:1])),
+        config=CFG,
+    )
+    assert se1.collective_bytes_per_step == 0
+    se8 = ShardedEngine(
+        state, DEFAULT_CHAIN, mesh=model_mesh(np.asarray(jax.devices()[:8])),
+        config=CFG,
+    )
+    # 8 shards exchange the padded candidate columns: nonzero, and the
+    # accounting must cover the edge padding (60 -> 8*ceil(60/8) rows)
+    assert se8.collective_bytes_per_step > 0
+    assert se8.collective_bytes_per_round == (
+        se8.collective_bytes_per_step * CFG.steps_per_round
+    )
+
+
+def test_mesh_normalization():
+    devs = np.asarray(jax.devices()[:8])
+    from jax.sharding import Mesh
+
+    m1 = normalize_mesh(Mesh(devs, (MODEL_AXIS,)))
+    assert m1.shape[RESTART_AXIS] == 1 and m1.shape[MODEL_AXIS] == 8
+    m2 = normalize_mesh(Mesh(devs, (RESTART_AXIS,)))
+    assert m2.shape[RESTART_AXIS] == 8 and m2.shape[MODEL_AXIS] == 1
+    m3 = normalize_mesh(Mesh(devs.reshape(2, 4), (RESTART_AXIS, MODEL_AXIS)))
+    assert m3 is normalize_mesh(m3)  # canonical form is a fixed point
+    with pytest.raises(ValueError, match="mesh axes"):
+        normalize_mesh(Mesh(devs, ("data",)))
+
+
+def test_sharded_carry_aggregates_match_unsharded():
+    """The mesh carry is REPLICATED: its broker aggregates must equal the
+    global aggregates a single-device engine derives from the whole model
+    (no partial/psum'd state anywhere)."""
     state = _state(seed=5)
     mesh = model_mesh(np.asarray(jax.devices()[:8]))
     se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
-    keys = jax.random.split(jax.random.PRNGKey(0), se.n)
+    keys = jax.random.PRNGKey(0)[None]
     carry = se._jit_init(se.statics, keys)
 
     agg = compute_aggregates(state)
-    # stacked replicated copies: every shard must hold the global aggregates
+    # leading axis is the restart axis (1 chain); the model axis never
+    # appears in the carry because every shard holds the same replica
     bl = np.asarray(carry.broker_load)
-    for i in range(se.n):
-        np.testing.assert_allclose(bl[i], np.asarray(agg.broker_load), rtol=1e-5)
+    assert bl.shape[0] == 1
+    np.testing.assert_allclose(bl[0], np.asarray(agg.broker_load), rtol=1e-5)
     np.testing.assert_array_equal(
         np.asarray(carry.broker_replica_count)[0],
         np.asarray(agg.broker_replica_count),
@@ -134,55 +165,12 @@ def test_sharded_aggregates_match_unsharded():
         np.asarray(carry.broker_leader_count)[0],
         np.asarray(agg.broker_leader_count),
     )
-    # sharded part_rack_count concatenates to the global table (padded P)
-    prc = np.asarray(carry.part_rack_count).reshape(-1, state.shape.num_racks)
-    np.testing.assert_array_equal(
-        prc[: state.shape.P], np.asarray(agg.part_rack_count)
-    )
-
-
-def test_sharded_objective_matches_engine_objective():
-    state = _state(seed=9)
-    mesh = model_mesh(np.asarray(jax.devices()[:8]))
-    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=CFG)
-    keys = jax.random.split(jax.random.PRNGKey(0), se.n)
-    carry = se._jit_init(se.statics, keys)
-    sharded_obj = se.objective(carry)
-
-    eng = Engine(state, DEFAULT_CHAIN, config=CFG)
-    c0 = eng.init_carry(jax.random.PRNGKey(0))
-    local_obj = float(eng.carry_objective(eng.statics, c0))
-    assert abs(sharded_obj - local_obj) < max(1e-4, 1e-4 * abs(local_obj))
-
-
-def test_sharded_tracks_single_device_quality():
-    """Same budget, same seed family: the sharded run must land in the same
-    quality regime as the single-device engine (it evaluates n× candidates,
-    so equal-or-better is the expectation, with slack for stochasticity)."""
-    state = _state(seed=33, brokers=10, parts=120)
-    cfg = dataclasses.replace(CFG, num_rounds=4)
-    eng = Engine(state, DEFAULT_CHAIN, config=cfg)
-    single, _ = eng.run()
-    obj_single, _, _ = DEFAULT_CHAIN.evaluate(single)
-
-    mesh = model_mesh(np.asarray(jax.devices()[:8]))
-    se = ShardedEngine(state, DEFAULT_CHAIN, mesh=mesh, config=cfg)
-    sharded, _ = se.run()
-    validate(sharded)
-    obj_sharded, _, _ = DEFAULT_CHAIN.evaluate(sharded)
-
-    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
-    # both must improve substantially; sharded within 20% of single's gain
-    gain_single = float(obj0 - obj_single)
-    gain_sharded = float(obj0 - obj_sharded)
-    assert gain_single > 0 and gain_sharded > 0
-    assert gain_sharded >= 0.8 * gain_single
 
 
 def test_grid_engine_2d_mesh():
-    """Restart portfolio OVER model-sharded chains on a 2x4 mesh: chains
-    are isolated (different final objectives), winner validates and
-    improves the cluster."""
+    """Restart portfolio OVER candidate-sharded chains on a 2x4 mesh:
+    chains are isolated (independent keys), winner validates and improves
+    the cluster."""
     from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
 
     state = _state(seed=41, brokers=10, parts=128)
@@ -201,10 +189,19 @@ def test_grid_engine_2d_mesh():
     assert info["winner"] == int(np.argmin(info["objectives"]))
 
 
+def test_grid_engine_rejects_1d_mesh():
+    from cruise_control_tpu.parallel.grid import GridEngine
+
+    state = _state(seed=43, brokers=8, parts=96)
+    with pytest.raises(ValueError, match="grid mesh"):
+        GridEngine(state, DEFAULT_CHAIN, mesh=model_mesh(), config=CFG)
+
+
 @pytest.mark.parametrize("mode", ["sharded", "grid:2x4"])
 def test_goal_optimizer_parallel_modes(mode):
-    """tpu.parallel.mode wires the multi-device engines into the PRODUCT
-    optimizer path (GoalOptimizer -> ShardedEngine / GridEngine)."""
+    """tpu.parallel.mode wires the mesh engines into the PRODUCT optimizer
+    path (GoalOptimizer -> ShardedEngine / GridEngine), and the sharded
+    mode reproduces the single-device optimizer result exactly."""
     from cruise_control_tpu.analyzer import GoalOptimizer
 
     state = _state(seed=51, brokers=10, parts=120)
@@ -213,10 +210,41 @@ def test_goal_optimizer_parallel_modes(mode):
     validate(res.state_after)
     assert res.objective_after < res.objective_before
     assert res.proposals  # a real plan came out of the parallel engine
+    timing = next(h for h in res.history if h.get("timing"))
+    assert timing["mesh_shape"] == ([1, 8] if mode == "sharded" else [2, 4])
+    if mode == "sharded":
+        single = GoalOptimizer(config=CFG, parallel_mode="single").optimize(state)
+        np.testing.assert_array_equal(
+            np.asarray(res.state_after.replica_broker),
+            np.asarray(single.state_after.replica_broker),
+        )
+
+
+def test_goal_optimizer_mesh_max_devices():
+    """tpu.mesh.max.devices caps the mesh the service builds its engines
+    from: sharded mode on the 8-device test platform with a cap of 4 runs
+    a 4-shard mesh (byte parity keeps the result identical anyway), a cap
+    of 1 degenerates to the single-device path, and a grid mode needing
+    more devices than the cap is rejected at construction."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+
+    state = _state(seed=51, brokers=10, parts=120)
+    opt = GoalOptimizer(config=CFG, parallel_mode="sharded", mesh_max_devices=4)
+    res = opt.optimize(state)
+    timing = next(h for h in res.history if h.get("timing"))
+    assert timing["mesh_shape"] == [1, 4]
+    assert (
+        GoalOptimizer(
+            config=CFG, parallel_mode="sharded", mesh_max_devices=1
+        ).parallel_mode
+        == "single"
+    )
+    with pytest.raises(ValueError, match="devices"):
+        GoalOptimizer(config=CFG, parallel_mode="grid:2x4", mesh_max_devices=4)
 
 
 def test_parallel_engine_rebind_honors_new_options():
-    """A cached sharded engine rebound with NEW options must honor them —
+    """A cached mesh engine rebound with NEW options must honor them —
     the stale-options path would move replicas onto excluded brokers."""
     from cruise_control_tpu.analyzer import GoalOptimizer, OptimizationOptions
 
@@ -236,3 +264,18 @@ def test_parallel_engine_rebind_honors_new_options():
     assert not (np.asarray(after.replica_broker)[moved] == 0).any(), (
         "cached sharded engine ignored the new exclusion options"
     )
+
+
+def test_parallel_prewarm_through_shared_pool():
+    """GoalOptimizer.prewarm covers mesh engines: the shard_map'd
+    whole-anneal program compiles on the shared warm pool and the engine
+    lands in the parallel cache, so the next optimize() is a cache hit."""
+    from cruise_control_tpu.analyzer import GoalOptimizer
+
+    state = _state(seed=71, brokers=10, parts=120)
+    opt = GoalOptimizer(config=CFG, parallel_mode="sharded")
+    opt.prewarm(state)
+    assert opt.has_engine_for(state.shape, config=CFG)
+    res = opt.optimize(state)
+    timing = next(h for h in res.history if h.get("timing"))
+    assert timing["engine_cache_hit"] is True
