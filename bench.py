@@ -791,6 +791,98 @@ def trace_overhead() -> int:
     return 0 if ok else 1
 
 
+def fleet_smoke() -> int:
+    """`bench.py --fleet-smoke`: the fleet controller's economics gate.
+
+    Boots a 3-cluster simulated fleet (east/west share a bucketed shape,
+    south has its own) behind ONE shared AnalyzerCore and gates:
+
+      * compiled-engine count < cluster count (same-bucket clusters rebind
+        one engine — the whole point of the shared core), with at least
+        one engine-cache HIT recorded on the shared registry;
+      * per-cluster WARM proposal wall within 1.5x a single-cluster
+        baseline of the same geometry — multi-tenancy must not tax the
+        steady-state serving path (compiles excluded: both sides measure
+        after their first run).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.service.main import (
+        build_simulated_fleet,
+        build_simulated_service,
+    )
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    reps = 3
+
+    def warm_wall(fn) -> float:
+        fn()  # first run pays compile/cache-load; the gate is steady state
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.monotonic()
+            fn()
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    # single-cluster baselines, one per fleet geometry (the default
+    # build_simulated_service matches east/west; south is the bigger one)
+    geometries = {
+        "small": dict(num_brokers=6, topics={"T0": 12, "T1": 12}),
+        "large": dict(num_brokers=12, topics={"T0": 48, "T1": 48}),
+    }
+    baselines = {}
+    for name, geo in geometries.items():
+        app, fetcher, admin, sampler = build_simulated_service(seed=31, **geo)
+        baselines[name] = warm_wall(
+            lambda cc=app.cc: cc.proposals(OperationProgress(), ignore_cache=True)
+        )
+        app.stop()
+
+    app, fleet = build_simulated_fleet(seed=31)
+    opt = fleet.core.optimizer
+    per_cluster = {}
+    for cid in fleet.contexts:
+        per_cluster[cid] = warm_wall(
+            lambda cc=fleet.facade(cid): cc.proposals(
+                OperationProgress(), ignore_cache=True
+            )
+        )
+    engines = opt.cache_size
+    hits = opt.engine_cache_hits
+    ratios = {
+        cid: per_cluster[cid]
+        / max(baselines["large" if cid == "south" else "small"], 1e-9)
+        for cid in per_cluster
+    }
+    # 1.5x + a small absolute epsilon: these are ~100ms CPU walls and a
+    # scheduler hiccup must not flake the gate
+    ok_wall = all(
+        per_cluster[cid]
+        <= 1.5 * baselines["large" if cid == "south" else "small"] + 0.05
+        for cid in per_cluster
+    )
+    ok_engines = engines < len(fleet.contexts) and hits >= 1
+    ok = ok_wall and ok_engines
+    _emit(
+        metric="fleet_smoke",
+        value=round(max(per_cluster.values()), 4),
+        unit="s",
+        vs_baseline=round(max(ratios.values()), 3),
+        clusters=len(fleet.contexts),
+        compiled_engines=engines,
+        engine_cache_hits=hits,
+        per_cluster_wall_s={k: round(v, 4) for k, v in per_cluster.items()},
+        baseline_wall_s={k: round(v, 4) for k, v in baselines.items()},
+        wall_ratio={k: round(v, 3) for k, v in ratios.items()},
+        ok_engines=ok_engines,
+        ok_wall=ok_wall,
+        ok=ok,
+    )
+    fleet.shutdown()
+    return 0 if ok else 1
+
+
 def _churn_states(n_gens, *, brokers, partitions, parts_per_gen, broker_add_at, seed):
     """One synthetic churn stream: generation g has `partitions + g*delta`
     partitions (partition creates) and one broker added at broker_add_at —
@@ -1018,6 +1110,8 @@ def scenarios_bench(smoke_mode: bool) -> int:
 
 
 def main():
+    if "--fleet-smoke" in sys.argv:
+        sys.exit(fleet_smoke())
     if "--mesh-smoke" in sys.argv:
         sys.exit(mesh_smoke())
     if "--trace-overhead" in sys.argv:

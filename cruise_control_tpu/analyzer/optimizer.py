@@ -280,7 +280,11 @@ class GoalOptimizer:
     # engine cache (bounded LRU, explicit HBM release on eviction)
     # ------------------------------------------------------------------
 
-    def _cache_size(self) -> int:
+    @property
+    def cache_size(self) -> int:
+        """Compiled engines currently resident (plain + parallel) — public
+        beside engine_cache_hits/misses: the /fleet rollup and the
+        fleet-smoke bench gate read it."""
         return len(self._engines) + len(self._parallel_engines)
 
     def _record(self, hit: bool, *, count: bool = True) -> None:
@@ -293,7 +297,7 @@ class GoalOptimizer:
                 name = "hits" if hit else "misses"
                 self.sensors.counter(f"analyzer.engine-cache-{name}").inc()
         if self.sensors is not None:
-            self.sensors.gauge("analyzer.engine-cache-size").set(self._cache_size())
+            self.sensors.gauge("analyzer.engine-cache-size").set(self.cache_size)
 
     def _cache_get(self, cache, key):
         """Fetch + pin: the engine's busy count is raised under the lock so

@@ -161,6 +161,11 @@ ENDPOINTS: dict[str, dict] = {
               "params": {"--id": ("id", str),
                          "--limit": ("limit", positive_int_param)}},
     "metrics": {"method": "GET", "endpoint": "metrics", "params": {}},
+    # fleet controller: whole-instance rollup (`cccli fleet`); pair the
+    # other subcommands with the global --cluster flag to target one
+    # cluster of a fleet (e.g. `cccli --cluster east rebalance`)
+    "fleet": {"method": "GET", "endpoint": "fleet",
+              "params": {"--score": ("score", boolean_param)}},
 }
 
 
@@ -177,6 +182,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JWT bearer token (reference JwtSecurityProvider)")
     p.add_argument("--insecure", action="store_true",
                    help="skip TLS certificate verification (self-signed servers)")
+    p.add_argument("-c", "--cluster", default=None,
+                   help="fleet cluster id the request targets (fleet "
+                        "deployments; rides every endpoint as cluster=)")
     p.add_argument("--poll-interval", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--json-indent", type=int, default=2)
@@ -254,6 +262,8 @@ def main(argv=None) -> int:
         param: getattr(args, param, None)
         for _, (param, _t) in spec["params"].items()
     }
+    if args.cluster:
+        params["cluster"] = args.cluster
     client = Client(args.socket_address, args.prefix,
                     poll_interval=args.poll_interval, timeout=args.timeout,
                     user=args.user, token=args.token, insecure=args.insecure)

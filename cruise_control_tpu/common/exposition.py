@@ -83,57 +83,85 @@ def _labels(labels: dict) -> str:
     return "{" + ",".join(parts) + "}"
 
 
-def prometheus_text(registry: SensorRegistry, *, namespace: str = "cruisecontrol") -> str:
-    """Render the registry in the exposition format; ends with a newline."""
-    lines: list[str] = []
-    seen_families: dict[str, str] = {}  # family -> source sensor name
+def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
+    """Render one registry — or a sequence of them — in the exposition
+    format; ends with a newline.
 
-    def family(sensor_name: str, suffix: str, ptype: str) -> str:
+    Multi-registry rendering is the fleet controller's `/metrics` path:
+    each cluster owns a registry whose `base_labels` (e.g.
+    `{cluster: "east"}`) are stamped onto every sample, and the shared
+    core's registry rides unlabeled beside them.  All samples of one
+    family are emitted as one group (the format requires it) regardless
+    of which registry contributed them, with ONE TYPE line per family."""
+    registries = (
+        [registry] if isinstance(registry, SensorRegistry) else list(registry)
+    )
+    #: family -> {"sensor": source name, "type": ptype, "lines": [...]}
+    families: dict[str, dict] = {}
+    order: list[str] = []
+
+    def family(sensor_name: str, suffix: str, ptype: str) -> tuple[str, list]:
         fam = metric_name(sensor_name, namespace=namespace) + suffix
-        prior = seen_families.get(fam)
-        if prior is not None and prior != sensor_name:
+        info = families.get(fam)
+        if info is None:
+            info = families[fam] = {
+                "sensor": sensor_name, "type": ptype, "lines": [],
+            }
+            order.append(fam)
+        elif info["sensor"] != sensor_name:
             raise ValueError(
-                f"sensor names {prior!r} and {sensor_name!r} sanitize to the "
-                f"same Prometheus family {fam!r}; rename one"
+                f"sensor names {info['sensor']!r} and {sensor_name!r} "
+                f"sanitize to the same Prometheus family {fam!r}; rename one"
             )
-        if prior is None:
-            seen_families[fam] = sensor_name
-            lines.append(f"# HELP {fam} sensor {sensor_name}")
-            lines.append(f"# TYPE {fam} {ptype}")
-        return fam
+        return fam, info["lines"]
 
-    for name, sensor in registry.items():
-        if isinstance(sensor, Counter):
-            fam = family(name, "_total", "counter")
-            lines.append(f"{fam} {_fmt(sensor.count)}")
-        elif isinstance(sensor, Gauge):
-            fam = family(name, "", "gauge")
-            lines.append(f"{fam} {_fmt(sensor.value)}")
-        elif isinstance(sensor, Timer):
-            fam = family(name, "_seconds", "summary")
-            for q, v in sorted(sensor.quantiles().items()):
-                lines.append(f'{fam}{{quantile="{_fmt(q)}"}} {_fmt(v)}')
-            lines.append(f"{fam}_sum {_fmt(sensor.total_seconds())}")
-            lines.append(f"{fam}_count {_fmt(sensor.count)}")
-        elif isinstance(sensor, Meter):
-            fam = family(name, "_total", "counter")
-            lines.append(f"{fam} {_fmt(sensor.count)}")
-            rfam = family(name + ".rate-per-hour", "", "gauge")
-            lines.append(f"{rfam} {_fmt(sensor.rate_per_hour())}")
-        elif isinstance(sensor, Histogram):
-            fam = family(name, "", "histogram")
-            cum, total, n = sensor.cumulative()
-            for bound, c in cum:
-                le = "+Inf" if bound == float("inf") else _fmt(bound)
-                lines.append(f'{fam}_bucket{{le="{le}"}} {_fmt(c)}')
-            lines.append(f"{fam}_sum {_fmt(total)}")
-            lines.append(f"{fam}_count {_fmt(n)}")
-        elif isinstance(sensor, Collector):
-            fam = family(name, "", "gauge")
-            for labels, v in sensor.values():
-                lines.append(f"{fam}{_labels(labels)} {_fmt(v)}")
-        # unknown sensor types are skipped: the exposition only promises
-        # the documented catalog
+    for reg in registries:
+        base = dict(getattr(reg, "base_labels", None) or {})
+        blk = _labels(base)
+        for name, sensor in reg.items():
+            if isinstance(sensor, Counter):
+                fam, out = family(name, "_total", "counter")
+                out.append(f"{fam}{blk} {_fmt(sensor.count)}")
+            elif isinstance(sensor, Gauge):
+                fam, out = family(name, "", "gauge")
+                out.append(f"{fam}{blk} {_fmt(sensor.value)}")
+            elif isinstance(sensor, Timer):
+                fam, out = family(name, "_seconds", "summary")
+                for q, v in sorted(sensor.quantiles().items()):
+                    out.append(
+                        f"{fam}{_labels({**base, 'quantile': _fmt(q)})} {_fmt(v)}"
+                    )
+                out.append(f"{fam}_sum{blk} {_fmt(sensor.total_seconds())}")
+                out.append(f"{fam}_count{blk} {_fmt(sensor.count)}")
+            elif isinstance(sensor, Meter):
+                fam, out = family(name, "_total", "counter")
+                out.append(f"{fam}{blk} {_fmt(sensor.count)}")
+                rfam, rout = family(name + ".rate-per-hour", "", "gauge")
+                rout.append(f"{rfam}{blk} {_fmt(sensor.rate_per_hour())}")
+            elif isinstance(sensor, Histogram):
+                fam, out = family(name, "", "histogram")
+                cum, total, n = sensor.cumulative()
+                for bound, c in cum:
+                    le = "+Inf" if bound == float("inf") else _fmt(bound)
+                    out.append(
+                        f"{fam}_bucket{_labels({**base, 'le': le})} {_fmt(c)}"
+                    )
+                out.append(f"{fam}_sum{blk} {_fmt(total)}")
+                out.append(f"{fam}_count{blk} {_fmt(n)}")
+            elif isinstance(sensor, Collector):
+                fam, out = family(name, "", "gauge")
+                for labels, v in sensor.values():
+                    # base labels win a key clash: the registry's scope is
+                    # authoritative over what a callback claims
+                    out.append(f"{fam}{_labels({**labels, **base})} {_fmt(v)}")
+            # unknown sensor types are skipped: the exposition only
+            # promises the documented catalog
+    lines: list[str] = []
+    for fam in order:
+        info = families[fam]
+        lines.append(f"# HELP {fam} sensor {info['sensor']}")
+        lines.append(f"# TYPE {fam} {info['type']}")
+        lines.extend(info["lines"])
     return "\n".join(lines) + "\n"
 
 
@@ -243,31 +271,43 @@ def parse_exposition(text: str) -> dict[str, dict]:
             )
         families[fam]["samples"].append((name, labels, value))
 
-    # histogram structural lint: buckets cumulative + +Inf == _count
+    # histogram structural lint: buckets cumulative + +Inf == _count.
+    # Grouped by the non-`le` label set: a labeled exposition (the fleet's
+    # per-cluster series) interleaves independent bucket ladders in one
+    # family, and each ladder must hold the invariants on its own.
     for fam, info in families.items():
         if info["type"] != "histogram":
             continue
-        buckets = [
-            (labels.get("le"), v)
-            for name, labels, v in info["samples"]
-            if name == fam + "_bucket"
-        ]
-        if not buckets:
+        ladders: dict[tuple, list] = {}
+        for name, labels, v in info["samples"]:
+            if name != fam + "_bucket":
+                continue
+            key = tuple(sorted((k, x) for k, x in labels.items() if k != "le"))
+            ladders.setdefault(key, []).append((labels.get("le"), v))
+        if not ladders:
             raise ExpositionError(f"histogram {fam!r} emitted no buckets")
-        if buckets[-1][0] != "+Inf":
-            raise ExpositionError(f"histogram {fam!r} missing the +Inf bucket")
-        prev = -1.0
-        for le, v in buckets:
-            if v < prev:
+        counts_by_key = {
+            tuple(sorted(labels.items())): v
+            for name, labels, v in info["samples"]
+            if name == fam + "_count"
+        }
+        for key, buckets in ladders.items():
+            if buckets[-1][0] != "+Inf":
                 raise ExpositionError(
-                    f"histogram {fam!r} bucket le={le} decreases ({v} < {prev})"
+                    f"histogram {fam!r}{dict(key)} missing the +Inf bucket"
                 )
-            prev = v
-        counts = [
-            v for name, _, v in info["samples"] if name == fam + "_count"
-        ]
-        if counts and counts[0] != buckets[-1][1]:
-            raise ExpositionError(
-                f"histogram {fam!r}: +Inf bucket {buckets[-1][1]} != _count {counts[0]}"
-            )
+            prev = -1.0
+            for le, v in buckets:
+                if v < prev:
+                    raise ExpositionError(
+                        f"histogram {fam!r}{dict(key)} bucket le={le} "
+                        f"decreases ({v} < {prev})"
+                    )
+                prev = v
+            count = counts_by_key.get(key)
+            if count is not None and count != buckets[-1][1]:
+                raise ExpositionError(
+                    f"histogram {fam!r}{dict(key)}: +Inf bucket "
+                    f"{buckets[-1][1]} != _count {count}"
+                )
     return families

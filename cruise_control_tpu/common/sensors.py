@@ -329,11 +329,20 @@ class Collector:
 
 
 class SensorRegistry:
-    """Named sensor catalog; `snapshot()` renders the /state JSON block."""
+    """Named sensor catalog; `snapshot()` renders the /state JSON block.
 
-    def __init__(self) -> None:
+    base_labels: label set stamped onto EVERY sample this registry emits
+    in the Prometheus exposition (common/exposition.py) — the fleet
+    controller gives each cluster its own registry labeled
+    `{cluster: <id>}` so two clusters registering the same sensor family
+    stay distinct series instead of last-writer-wins colliding on one
+    name.  The JSON snapshot is unlabeled (each registry is already
+    scoped to one cluster's /state)."""
+
+    def __init__(self, base_labels: dict[str, str] | None = None) -> None:
         self._lock = threading.Lock()
         self._sensors: dict[str, object] = {}
+        self.base_labels: dict[str, str] = dict(base_labels or {})
 
     def _get(self, name: str, factory):
         with self._lock:

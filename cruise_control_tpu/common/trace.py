@@ -348,6 +348,68 @@ class Tracer:
         with self._lock:
             self._rings.clear()
 
+    def scoped(self, scope: str) -> "Tracer":
+        """Cluster-scoped view for the fleet controller: spans record into
+        the SAME store (trace ids and GET /trace replay stay fleet-global)
+        under a namespaced component (`<scope>:monitor`), so every cluster
+        gets its own per-component retention rings — one cluster's chatty
+        executor can never evict another cluster's history."""
+        if not scope:
+            return self
+        return _ScopedTracer(self, scope)
+
+
+class _ScopedTracer:
+    """Component-namespacing proxy over a shared Tracer (Tracer.scoped)."""
+
+    def __init__(self, base: Tracer, scope: str):
+        self._base = base
+        self.scope = scope
+
+    @property
+    def enabled(self) -> bool:
+        return self._base.enabled
+
+    def _component(self, component: str) -> str:
+        return f"{self.scope}:{component}"
+
+    def new_trace_id(self) -> str:
+        return self._base.new_trace_id()
+
+    def start_span(self, name, *, component="service", **kwargs):
+        return self._base.start_span(
+            name, component=self._component(component), **kwargs
+        )
+
+    def span(self, name, *, component="service", **kwargs):
+        return self._base.span(
+            name, component=self._component(component), **kwargs
+        )
+
+    def current(self):
+        return self._base.current()
+
+    def event(self, name, **attrs) -> None:
+        self._base.event(name, **attrs)
+
+    def trace(self, trace_id):
+        return self._base.trace(trace_id)
+
+    def trace_tree(self, trace_id):
+        return self._base.trace_tree(trace_id)
+
+    def recent_traces(self, limit: int = 50):
+        return self._base.recent_traces(limit)
+
+    def summarize(self, trace_id=None):
+        return self._base.summarize(trace_id)
+
+    def scoped(self, scope: str):
+        return self._base.scoped(scope)
+
+    def clear(self) -> None:
+        self._base.clear()
+
 
 #: process-wide default tracer (components accept an override; the facade
 #: builds a per-service instance from the trace.* config keys).  Enabled

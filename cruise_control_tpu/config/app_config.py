@@ -274,6 +274,52 @@ def _planner_defs() -> ConfigDef:
     return d
 
 
+#: cluster ids become journal subdirectories, sensor label values and
+#: Prometheus label data — keep them filesystem- and exposition-safe
+_CLUSTER_ID_RE = r"[A-Za-z0-9][A-Za-z0-9._-]*"
+
+
+def _fleet_defs() -> ConfigDef:
+    """Fleet controller keys (fleet/manager.py — no reference analog: one
+    reference deployment watches exactly one Kafka cluster)."""
+    import re
+
+    def _valid_cluster_ids(name, value):
+        for cid in value:
+            if not re.fullmatch(_CLUSTER_ID_RE, cid):
+                raise ConfigException(
+                    f"{name}: cluster id {cid!r} must match {_CLUSTER_ID_RE} "
+                    "(ids become journal subdirectories and metric labels)"
+                )
+        if len(set(value)) != len(value):
+            raise ConfigException(f"{name}: duplicate cluster ids in {value}")
+
+    d = ConfigDef()
+    g = "fleet"
+    d.define("fleet.clusters", T.LIST, "", I.HIGH,
+             "cluster ids this instance manages as a fleet; empty (the "
+             "default) keeps the classic single-cluster deployment "
+             "byte-for-byte unchanged.  Each id gets its own monitor, "
+             "executor (journal under <executor.journal.dir>/<id>/), "
+             "detector and sample stream behind ONE shared optimizer + "
+             "device supervisor + compiled-engine cache; per-cluster "
+             "overrides ride fleet.<id>.<key> keys (e.g. "
+             "fleet.east.bootstrap.servers) over the base config — "
+             "cluster-scoped keys only: overriding a shared-core or "
+             "webserver key (tpu.*, default.goals, balance/capacity "
+             "thresholds, planner.*, trace.*, webserver.*, ...) is "
+             "rejected because the fleet builds those once from the base",
+             _valid_cluster_ids, group=g)
+    d.define("fleet.tenant.max.pending.tasks", T.INT, 8, I.MEDIUM,
+             "per-cluster cap on concurrently Active async user tasks in "
+             "fleet mode — admission control on the async purgatory so one "
+             "noisy cluster's request storm cannot starve the other "
+             "clusters' proposal refreshes (breach: 429 + "
+             "fleet.tenant-rejections sensor); 0 disables",
+             in_range(lo=0), group=g)
+    return d
+
+
 def _monitor_defs() -> ConfigDef:
     """Reference config/constants/MonitorConfig.java."""
     d = ConfigDef()
@@ -736,6 +782,7 @@ def cruise_control_config_def() -> ConfigDef:
     return (
         _analyzer_defs()
         .merge(_observability_defs())
+        .merge(_fleet_defs())
         .merge(_planner_defs())
         .merge(_monitor_defs())
         .merge(_executor_defs())
@@ -749,8 +796,92 @@ class CruiseControlConfig(AbstractConfig):
     checks (:106-120)."""
 
     def __init__(self, props: dict[str, Any] | None = None):
+        #: raw operator props, kept for fleet per-cluster derivation
+        #: (cluster_config overlays fleet.<id>.* keys over this base)
+        self._raw_props = dict(props or {})
         super().__init__(cruise_control_config_def(), props or {})
         self._sanity_check_goals()
+        self._sanity_check_fleet_keys()
+
+    # ------------------------------------------------------------------
+    # fleet (fleet/manager.py)
+    # ------------------------------------------------------------------
+
+    def fleet_cluster_ids(self) -> list[str]:
+        return self.get("fleet.clusters")
+
+    def _sanity_check_fleet_keys(self):
+        """Every non-builtin `fleet.*` key must be a `fleet.<id>.<key>`
+        override whose <id> is in fleet.clusters — unknown keys are
+        tolerated config-wide, but a typo'd cluster prefix
+        (fleet.eastt.bootstrap.servers) would otherwise silently fold
+        nothing and the fleet would run against the base settings."""
+        ids = set(self.get("fleet.clusters"))
+        defined = self.definition.keys()
+        for k in self._raw_props:
+            if not k.startswith("fleet.") or k in defined:
+                continue
+            cid, _, rest = k[len("fleet."):].partition(".")
+            if cid not in ids or not rest:
+                raise ConfigException(
+                    f"{k!r} is not a per-cluster override: "
+                    f"{cid!r} is not in fleet.clusters ({sorted(ids)})"
+                )
+
+    #: keys the SHARED half of a fleet deployment consumes — the one
+    #: AnalyzerCore (goal chain, balancing constraint, optimizer/engine
+    #: cache, device supervisor, planner, tracer) and the one webserver /
+    #: user-task purgatory, all built from the BASE config.  A
+    #: fleet.<id>.<key> override of these would validate, fold into the
+    #: cluster's facade config, and then be silently ignored — reject it
+    #: at config time instead of misleading the operator.
+    _FLEET_SHARED_KEY_PREFIXES = (
+        "default.goals", "goal.balancedness.", "planner.", "tpu.", "trace.",
+        "webserver.", "jwt.", "basic.auth.", "max.active.user.tasks",
+        "max.cached.completed", "completed.", "two.step.",
+        "request.reason.required", "metrics.prometheus.",
+        "max.replicas.per.broker", "goal.violation.distribution.threshold",
+    )
+    _FLEET_SHARED_KEY_SUFFIXES = (  # BalancingConstraint inputs
+        ".balance.threshold", ".capacity.threshold",
+        ".low.utilization.threshold",
+    )
+
+    def cluster_config(self, cluster_id: str) -> "CruiseControlConfig":
+        """Per-cluster config: the base props with every `fleet.<id>.<key>`
+        override folded onto its bare `<key>`.  All `fleet.*` keys are
+        stripped from the derived config — a cluster-scoped config must
+        never look like a fleet of its own.  Overrides of shared-core /
+        webserver keys are rejected (see _FLEET_SHARED_KEY_PREFIXES)."""
+        if cluster_id not in self.get("fleet.clusters"):
+            raise ConfigException(
+                f"unknown fleet cluster {cluster_id!r}; "
+                f"fleet.clusters={self.get('fleet.clusters')}"
+            )
+        prefix = f"fleet.{cluster_id}."
+        base = {
+            k: v for k, v in self._raw_props.items()
+            if not k.startswith("fleet.")
+        }
+        overrides = {
+            k[len(prefix):]: v
+            for k, v in self._raw_props.items()
+            if k.startswith(prefix)
+        }
+        shared = sorted(
+            k for k in overrides
+            if k.startswith(self._FLEET_SHARED_KEY_PREFIXES)
+            or k.endswith(self._FLEET_SHARED_KEY_SUFFIXES)
+        )
+        if shared:
+            raise ConfigException(
+                f"fleet.{cluster_id}.* cannot override shared keys {shared}: "
+                "the fleet builds ONE goal chain / constraint / optimizer / "
+                "supervisor / planner / tracer / webserver from the base "
+                "config, so a per-cluster value would be silently ignored — "
+                "set these on the base config instead"
+            )
+        return CruiseControlConfig({**base, **overrides})
 
     def _sanity_check_goals(self):
         """Reference KafkaCruiseControlConfig.java:106-120 validates every

@@ -11,7 +11,14 @@ through package __init__s).
 GET_ENDPOINTS = (
     "bootstrap", "train", "load", "partition_load", "proposals", "state",
     "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
-    "trace", "metrics",
+    "trace", "metrics", "fleet",
+)
+
+#: endpoints that are fleet-GLOBAL: in fleet mode they answer for the
+#: whole instance (rollups, shared stores) and never require `cluster=`;
+#: every other endpoint is cluster-scoped and must name its cluster
+FLEET_GLOBAL_ENDPOINTS = frozenset(
+    {"fleet", "metrics", "trace", "user_tasks", "review_board", "review"}
 )
 POST_ENDPOINTS = (
     "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
@@ -50,6 +57,8 @@ ENDPOINT_TYPES = {
     # observability: trace replay + Prometheus exposition (both read-only)
     "trace": "CRUISE_CONTROL_MONITOR",
     "metrics": "CRUISE_CONTROL_MONITOR",
+    # fleet controller: whole-instance rollup over every managed cluster
+    "fleet": "CRUISE_CONTROL_MONITOR",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
 

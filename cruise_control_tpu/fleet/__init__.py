@@ -1,0 +1,5 @@
+"""Fleet controller — ONE tpu-cruise instance over N Kafka clusters."""
+
+from cruise_control_tpu.fleet.manager import ClusterContext, FleetManager
+
+__all__ = ["ClusterContext", "FleetManager"]

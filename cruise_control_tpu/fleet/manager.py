@@ -1,0 +1,211 @@
+"""Fleet controller: one service instance monitoring, rebalancing and
+rightsizing N Kafka clusters.
+
+The economics (ROADMAP item 4): the EXPENSIVE resources — the TPU, the
+compiled engines, the DeviceSupervisor's breaker — are shared through one
+`service.facade.AnalyzerCore`; the CHEAP ones — load monitors, executors
+with their durable journals, detectors, sample streams — multiply per
+cluster.  Shape buckets (PR 2) make the sharing real: clusters whose
+bucketed model shapes coincide rebind the SAME compiled engine
+(`analyzer.engine-cache-*` counters on the core registry prove it), and
+same-bucket clusters are scored in one batched device dispatch through
+the ScenarioEvaluator (`score_clusters`).
+
+Ownership map:
+
+  shared (AnalyzerCore, one per instance)    per cluster (ClusterContext)
+  ----------------------------------------  ---------------------------------
+  GoalChain + BalancingConstraint            LoadMonitor + aggregators
+  GoalOptimizer (compiled-engine LRU)        Executor (+ journal under
+  DeviceSupervisor (one circuit breaker)       <executor.journal.dir>/<id>/)
+  ScenarioEvaluator / Rightsizer             AnomalyDetector + notifier
+  Tracer store (per-cluster component        proposal cache + precompute loop
+    namespaces ride Tracer.scoped)           SensorRegistry({cluster: <id>})
+
+Admission control: the REST layer enforces `fleet.tenant.max.pending.tasks`
+per cluster on the async user-task purgatory (429 + the cluster's
+`fleet.tenant-rejections` counter on breach), so one noisy cluster cannot
+starve the other clusters' proposal refreshes out of the shared pool.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def shared_core_rollup(core, *, tenant_max_pending: int = 0) -> dict:
+    """The `shared` block of the GET /fleet payload — one builder for the
+    fleet and the single-cluster synthetic rollup (service/server.py), so
+    the two deployments can't drift apart field by field."""
+    opt = core.optimizer
+    out: dict = {
+        "compiledEngines": opt.cache_size,
+        "engineCacheHits": opt.engine_cache_hits,
+        "engineCacheMisses": opt.engine_cache_misses,
+        "degraded": core.supervisor is not None and core.supervisor.is_degraded,
+        "tenantMaxPendingTasks": tenant_max_pending,
+    }
+    if core.supervisor is not None:
+        out["supervisor"] = core.supervisor.state_json()
+    return out
+
+
+class ClusterContext:
+    """Everything ONE cluster owns inside a fleet: its facade (which holds
+    the monitor, executor, journal, detector) plus the sampling stack that
+    feeds it."""
+
+    def __init__(self, cluster_id: str, cc, *, fetcher=None, task_runner=None):
+        self.cluster_id = cluster_id
+        self.cc = cc
+        self.fetcher = fetcher
+        self.task_runner = task_runner
+
+    def rollup(self) -> dict:
+        """Cheap per-cluster state summary for the GET /fleet rollup (no
+        model build, no device work)."""
+        cc = self.cc
+        out = {
+            "proposalReady": cc._valid_cache() is not None,
+            "hasOngoingExecution": cc.executor.has_ongoing_execution,
+            "executorState": cc.executor.executor_state().get("state"),
+            "modelGeneration": str(cc.monitor.model_generation()),
+            "selfHealingBusy": cc.actions.is_busy,
+        }
+        recovery = cc.executor.recovery_info()
+        if recovery is not None:
+            out["recovered"] = True
+        return out
+
+
+class FleetManager:
+    """Owns the cluster contexts and the shared core; the REST layer
+    resolves `cluster=` through it and serves GET /fleet from it."""
+
+    def __init__(self, core, contexts: dict[str, ClusterContext], *,
+                 sensors, config):
+        """core: the shared service.facade.AnalyzerCore every context's
+        facade was built over; sensors: the fleet-level (unlabeled)
+        registry — normally the same one the core registers into."""
+        self.core = core
+        self.contexts = dict(contexts)
+        self.sensors = sensors
+        self.config = config
+        self.tenant_max_pending = config.get("fleet.tenant.max.pending.tasks")
+        sensors.gauge("fleet.clusters", lambda: len(self.contexts))
+
+    # ------------------------------------------------------------- lookup
+
+    def cluster_ids(self) -> list[str]:
+        return list(self.contexts)
+
+    def cluster(self, cluster_id: str) -> ClusterContext:
+        ctx = self.contexts.get(cluster_id)
+        if ctx is None:
+            raise KeyError(
+                f"unknown cluster {cluster_id!r}; clusters: {self.cluster_ids()}"
+            )
+        return ctx
+
+    def facade(self, cluster_id: str):
+        return self.cluster(cluster_id).cc
+
+    def registries(self) -> list:
+        """Every sensor registry of the instance, shared core first — the
+        `/metrics` exposition renders them together, each cluster's
+        samples labeled by its registry's base_labels."""
+        regs = [self.sensors]
+        if self.core.sensors is not self.sensors:
+            regs.append(self.core.sensors)
+        regs.extend(ctx.cc.sensors for ctx in self.contexts.values())
+        return regs
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start_up(self, *, detection_interval_s: float | None = None,
+                 precompute: bool = False) -> None:
+        """Start every cluster's monitor/detector (and recovery resume +
+        precompute loop) — the fleet twin of CruiseControl.start_up."""
+        for ctx in self.contexts.values():
+            ctx.cc.start_up(
+                detection_interval_s=detection_interval_s, precompute=precompute
+            )
+
+    def shutdown(self) -> None:
+        for ctx in self.contexts.values():
+            try:
+                ctx.cc.shutdown()
+            except Exception:  # noqa: BLE001 — one cluster must not wedge the rest
+                log.warning(
+                    "shutdown of cluster %s failed", ctx.cluster_id, exc_info=True
+                )
+
+    # ------------------------------------------------------------ rollups
+
+    def fleet_state(self, cluster_id: str | None = None) -> dict:
+        """The GET /fleet payload: per-cluster summaries + the shared-core
+        view (engine cache, supervisor, admission control)."""
+        ids = [cluster_id] if cluster_id else self.cluster_ids()
+        clusters = {cid: self.cluster(cid).rollup() for cid in ids}
+        return {
+            "numClusters": len(self.contexts),
+            "clusters": clusters,
+            "shared": shared_core_rollup(
+                self.core, tenant_max_pending=self.tenant_max_pending
+            ),
+        }
+
+    def score_clusters(self, *, allow_capacity_estimation: bool = True) -> dict:
+        """Score every cluster's CURRENT placement on the shared goal
+        chain, batching same-bucket clusters through the ScenarioEvaluator's
+        one-dispatch path: clusters are grouped by their (bucketed) model
+        shape, and each group rides one batched device program instead of
+        N sequential evaluations.  Returns {cluster_id: score dict}."""
+        from cruise_control_tpu.analyzer.objective import balancedness_score
+        from cruise_control_tpu.service.progress import OperationProgress
+        from cruise_control_tpu.analyzer.scenario_eval import VIOLATION_TOL
+
+        states: dict[str, object] = {}
+        out: dict[str, dict] = {}
+        for cid, ctx in self.contexts.items():
+            try:
+                states[cid] = ctx.cc._cluster_model(
+                    OperationProgress(),
+                    allow_capacity_estimation=allow_capacity_estimation,
+                )
+            except Exception as e:  # noqa: BLE001 — a cluster without a
+                # valid model yet (still sampling) must not sink the rollup
+                out[cid] = {"error": repr(e)}
+        groups: dict[object, list[str]] = {}
+        for cid, state in states.items():
+            groups.setdefault(state.shape, []).append(cid)
+        ev = self.core.scenario_evaluator
+        chain = self.core.chain
+        names = chain.names()
+        pw, sw = self.core.balancedness_weights
+        for shape, cids in groups.items():
+            objs, viols, degraded = ev.evaluate_states([states[c] for c in cids])
+            for i, cid in enumerate(cids):
+                v = viols[i]
+                out[cid] = {
+                    "objective": float(objs[i]),
+                    "balancedness": balancedness_score(
+                        v, chain, priority_weight=pw, strictness_weight=sw
+                    ),
+                    "violatedGoals": [
+                        n for n, x in zip(names, v) if x > VIOLATION_TOL
+                    ],
+                    "degraded": bool(degraded),
+                    #: how many clusters shared this batch's device
+                    #: dispatch (same bucketed shape) — 1 means this
+                    #: cluster scored alone
+                    "batchedWith": len(cids),
+                }
+        if groups:
+            self.sensors.counter("fleet.batched-score-runs").inc(len(groups))
+            self.sensors.counter("fleet.batched-score-clusters").inc(
+                sum(len(c) for c in groups.values())
+            )
+        return out

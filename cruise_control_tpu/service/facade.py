@@ -68,33 +68,37 @@ class _CachedResult:
     model_generation: object
 
 
-class CruiseControl:
-    """The service facade (reference KafkaCruiseControl.java)."""
+class AnalyzerCore:
+    """The SHARED half of the service: everything that is expensive and
+    cluster-agnostic — the goal chain, the GoalOptimizer with its compiled-
+    engine cache, the DeviceSupervisor (one circuit breaker for the whole
+    instance), the ScenarioEvaluator/Rightsizer, the tracer, and the
+    profiling surface.
+
+    A classic deployment builds one implicitly inside its CruiseControl
+    facade (behavior unchanged); the fleet controller (fleet/manager.py)
+    builds ONE explicitly and hands it to N per-cluster facades, so
+    clusters whose bucketed shapes coincide reuse the same compiled
+    engines (observable via the `analyzer.engine-cache-*` counters on
+    this core's registry) while the cheap per-cluster halves — monitors,
+    executors, journals, detectors — multiply."""
 
     def __init__(
         self,
         config: CruiseControlConfig,
-        monitor: LoadMonitor,
-        admin: ClusterAdmin,
         *,
-        chain: GoalChain | None = None,
         sensors: SensorRegistry | None = None,
+        tracer=None,
+        chain: GoalChain | None = None,
     ):
         self.config = config
-        self.monitor = monitor
-        self.admin = admin
-        #: per-instance sensor catalog (module-global registries would mix
-        #: counters across embedded instances; reference scopes its
-        #: MetricRegistry per app, KafkaCruiseControlApp.java:39-41)
         self.sensors = sensors if sensors is not None else SensorRegistry()
-        monitor.sensors = self.sensors
-        #: flight recorder (config trace.*): ONE tracer per service — the
-        #: monitor, analyzer, supervisor, executor, detector and planner
-        #: all record into the same per-component ring store, so one
-        #: rebalance correlates across every subsystem under one trace ID
-        #: (served by GET /trace; async responses carry `_traceId`)
-        self.tracer = config.tracer()
-        monitor.tracer = self.tracer
+        #: flight recorder (config trace.*): ONE tracer per service — in a
+        #: fleet every cluster facade records into this same store under a
+        #: cluster-scoped component namespace (Tracer.scoped), so one
+        #: operation's trace stays whole across shared and per-cluster
+        #: subsystems
+        self.tracer = tracer if tracer is not None else config.tracer()
         # device profiling surface: per-backend memory/live-buffer gauges
         # + per-device labeled collector, scrapeable via GET /metrics
         from cruise_control_tpu.common.profiling import register_device_gauges
@@ -109,17 +113,17 @@ class CruiseControl:
         self.constraint = config.balancing_constraint()
         self.chain = chain or GoalChain.from_names(config.get("default.goals"))
         #: reference AnalyzerConfig goal.balancedness.{priority,strictness}.weight
-        #: — used by EVERY optimizer this facade builds, including the ad-hoc
-        #: per-request ones (custom goals / rebalance_disk)
+        #: — used by EVERY optimizer built over this core, including the
+        #: ad-hoc per-request ones (custom goals / rebalance_disk)
         self.balancedness_weights = (
             config.get("goal.balancedness.priority.weight"),
             config.get("goal.balancedness.strictness.weight"),
         )
-        #: shape-bucketing policy the monitor builds models under; the
-        #: precompute loop pre-warms the NEXT bucket through it
+        #: shape-bucketing policy the monitors build models under; the
+        #: precompute loops pre-warm the NEXT bucket through it
         self.bucket_policy = config.shape_bucket_policy()
-        #: ONE supervisor for every optimizer this facade builds (default +
-        #: ad-hoc per-request ones + the precompute thread): they all feed
+        #: ONE supervisor for every optimizer over this core (default +
+        #: ad-hoc per-request ones + the precompute threads): they all feed
         #: the same circuit breaker, so a wedged device degrades the whole
         #: analyzer surface coherently instead of per-optimizer
         self.supervisor = config.device_supervisor(
@@ -141,7 +145,7 @@ class CruiseControl:
             profiler_dir=self.profiler_dir,
         )
         # per-bucket cold-start attribution as labeled /metrics series
-        # (only the facade's long-lived default optimizer feeds it; ad-hoc
+        # (only the core's long-lived default optimizer feeds it; ad-hoc
         # per-request optimizers are too short-lived to own a collector)
         self.sensors.collector(
             "analyzer.engine-compile-seconds-by-bucket",
@@ -173,6 +177,62 @@ class CruiseControl:
             bucket=self.bucket_policy,
             sensors=self.sensors,
         )
+
+
+class CruiseControl:
+    """The service facade (reference KafkaCruiseControl.java).
+
+    One facade per Kafka cluster: it OWNS the cluster-scoped subsystems
+    (monitor, executor + journal, detector, notifier, proposal cache) and
+    runs the analysis surface through an AnalyzerCore — its own private
+    one by default, or a shared one handed in by the fleet controller
+    (`core=`), in which case `cluster_id` namespaces the executor journal
+    directory and the trace components."""
+
+    def __init__(
+        self,
+        config: CruiseControlConfig,
+        monitor: LoadMonitor,
+        admin: ClusterAdmin,
+        *,
+        chain: GoalChain | None = None,
+        sensors: SensorRegistry | None = None,
+        core: AnalyzerCore | None = None,
+        cluster_id: str | None = None,
+    ):
+        self.config = config
+        self.monitor = monitor
+        self.admin = admin
+        #: per-instance sensor catalog (module-global registries would mix
+        #: counters across embedded instances; reference scopes its
+        #: MetricRegistry per app, KafkaCruiseControlApp.java:39-41).  In a
+        #: fleet this registry is cluster-labeled and distinct from the
+        #: shared core's.
+        self.sensors = sensors if sensors is not None else SensorRegistry()
+        monitor.sensors = self.sensors
+        if core is None:
+            core = AnalyzerCore(config, sensors=self.sensors, chain=chain)
+        self.core = core
+        self.cluster_id = cluster_id
+        #: cluster-scoped view of the core tracer: in a fleet, this
+        #: cluster's monitor/executor/detector spans land in their own
+        #: per-component retention rings (`<cluster>:executor`) while the
+        #: trace ids stay instance-global
+        self.tracer = (
+            core.tracer.scoped(cluster_id) if cluster_id else core.tracer
+        )
+        monitor.tracer = self.tracer
+        # shared-core aliases: every pre-fleet call site (and subclass)
+        # keeps reading these off the facade
+        self.profiler_dir = core.profiler_dir
+        self.constraint = core.constraint
+        self.chain = core.chain
+        self.balancedness_weights = core.balancedness_weights
+        self.bucket_policy = core.bucket_policy
+        self.supervisor = core.supervisor
+        self.optimizer = core.optimizer
+        self.scenario_evaluator = core.scenario_evaluator
+        self.rightsizer = core.rightsizer
         from cruise_control_tpu.executor.strategy import resolve_strategy_chain
 
         #: the configured strategy pool gates what requests may reference
@@ -190,6 +250,14 @@ class CruiseControl:
 
             from cruise_control_tpu.executor.journal import ExecutionJournal
 
+            if cluster_id:
+                # fleet: each cluster journals under its own subdirectory,
+                # and each cluster's Executor replays ONLY its own journal
+                # at construction — a fleet restart reconciles every
+                # cluster's in-flight moves without one cluster ever
+                # adopting another's (the ids are config-validated to be
+                # path-safe)
+                journal_dir = os.path.join(journal_dir, cluster_id)
             journal = ExecutionJournal(
                 os.path.join(journal_dir, "execution-journal.jsonl"),
                 fsync_batch=config.get("executor.journal.fsync.batch.size"),

@@ -26,19 +26,24 @@ from cruise_control_tpu.service.facade import CruiseControl
 from cruise_control_tpu.service.server import CruiseControlApp
 
 
-def build_service(
+def _build_cluster_stack(
     config: CruiseControlConfig,
     metadata,
     admin,
     sampler,
     *,
+    sensors,
     capacity_resolver: BrokerCapacityConfigResolver | None = None,
     sample_store=None,
     partitions_fn=None,
-) -> tuple[CruiseControlApp, MetricFetcherManager]:
-    from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
-
-    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
+    core=None,
+    cluster_id: str | None = None,
+):
+    """Wire ONE cluster's monitoring + facade stack: capacity resolver,
+    aggregators, fetcher, monitor, task runner, and the CruiseControl
+    facade.  `core`/`cluster_id` are the fleet seam — a shared
+    AnalyzerCore makes this facade one tenant of a fleet; None keeps the
+    classic self-contained build.  Returns (cc, fetcher, task_runner)."""
     if capacity_resolver is None:
         resolver_cls = config.get("broker.capacity.config.resolver.class")
         path = config.get("capacity.config.file")
@@ -63,11 +68,6 @@ def build_service(
         min_samples_per_window=config.get("min.samples.per.broker.metrics.window"),
         metric_def=KAFKA_METRIC_DEF,
     )
-    from cruise_control_tpu.common.sensors import SensorRegistry
-
-    # ONE registry shared by the fetcher and the facade stack — the monitor
-    # health gauges must surface in /state?substates=sensors
-    sensors = SensorRegistry()
     assignor_cls = config.get("metric.sampler.partition.assignor.class")
     fetcher = MetricFetcherManager(
         sampler,
@@ -150,18 +150,96 @@ def build_service(
         regression=regression,
         auto_train=config.get("use.linear.regression.model"),
     )
-    cc = CruiseControl(config, monitor, admin, sensors=sensors)
+    cc = CruiseControl(
+        config, monitor, admin, sensors=sensors, core=core, cluster_id=cluster_id
+    )
     cc.task_runner = task_runner
-    app = CruiseControlApp(cc)
     # warm restart: replay the sample store off the startup path (reference
     # SampleLoadingTask runs async; skip.loading.samples disables it)
     if sample_store is not None and not config.get("skip.loading.samples"):
         import threading
 
         threading.Thread(
-            target=task_runner.load_samples, daemon=True, name="sample-loading"
+            target=task_runner.load_samples,
+            daemon=True,
+            name=f"sample-loading{'-' + cluster_id if cluster_id else ''}",
         ).start()
+    return cc, fetcher, task_runner
+
+
+def build_service(
+    config: CruiseControlConfig,
+    metadata,
+    admin,
+    sampler,
+    *,
+    capacity_resolver: BrokerCapacityConfigResolver | None = None,
+    sample_store=None,
+    partitions_fn=None,
+) -> tuple[CruiseControlApp, MetricFetcherManager]:
+    from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
+    from cruise_control_tpu.common.sensors import SensorRegistry
+
+    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
+    # ONE registry shared by the fetcher and the facade stack — the monitor
+    # health gauges must surface in /state?substates=sensors
+    sensors = SensorRegistry()
+    cc, fetcher, _task_runner = _build_cluster_stack(
+        config, metadata, admin, sampler,
+        sensors=sensors,
+        capacity_resolver=capacity_resolver,
+        sample_store=sample_store,
+        partitions_fn=partitions_fn,
+    )
+    app = CruiseControlApp(cc)
     return app, fetcher
+
+
+def build_fleet_service(
+    config: CruiseControlConfig,
+    backends: dict,
+    *,
+    sample_stores: dict | None = None,
+) -> tuple[CruiseControlApp, "FleetManager"]:
+    """ONE service instance over N Kafka clusters (fleet/manager.py).
+
+    `backends`: {cluster_id: (metadata_provider, cluster_admin, sampler)}
+    covering every id in `fleet.clusters`.  Builds ONE shared AnalyzerCore
+    (optimizer + compiled-engine cache + device supervisor + scenario
+    evaluator + tracer) and, per cluster, its own monitor/fetcher/executor
+    stack from `config.cluster_config(id)` (base config + fleet.<id>.*
+    overrides), a cluster-labeled SensorRegistry, and a journal under
+    <executor.journal.dir>/<id>/.  Returns (app, fleet_manager)."""
+    from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
+    from cruise_control_tpu.common.sensors import SensorRegistry
+    from cruise_control_tpu.fleet.manager import ClusterContext, FleetManager
+    from cruise_control_tpu.service.facade import AnalyzerCore
+
+    ids = config.fleet_cluster_ids()
+    if not ids:
+        raise ValueError("build_fleet_service needs a non-empty fleet.clusters")
+    missing = [cid for cid in ids if cid not in backends]
+    if missing:
+        raise ValueError(f"no backend supplied for fleet clusters {missing}")
+    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
+    shared_sensors = SensorRegistry()
+    core = AnalyzerCore(config, sensors=shared_sensors)
+    contexts: dict[str, ClusterContext] = {}
+    for cid in ids:
+        metadata, admin, sampler = backends[cid]
+        cc, fetcher, task_runner = _build_cluster_stack(
+            config.cluster_config(cid), metadata, admin, sampler,
+            sensors=SensorRegistry(base_labels={"cluster": cid}),
+            sample_store=(sample_stores or {}).get(cid),
+            core=core,
+            cluster_id=cid,
+        )
+        contexts[cid] = ClusterContext(
+            cid, cc, fetcher=fetcher, task_runner=task_runner
+        )
+    fleet = FleetManager(core, contexts, sensors=shared_sensors, config=config)
+    app = CruiseControlApp(contexts[ids[0]].cc, fleet=fleet)
+    return app, fleet
 
 
 def parse_bootstrap_servers(bootstrap_servers: str) -> list[tuple[str, int]]:
@@ -306,6 +384,101 @@ def build_simulated_service(
     return app, fetcher, admin, sampler
 
 
+def build_simulated_fleet(
+    props: dict | None = None,
+    *,
+    clusters: dict[str, dict] | None = None,
+    seed: int = 0,
+    sampled_windows: int = 3,
+):
+    """Full in-process FLEET over N simulated clusters — the embedded
+    harness for fleet tests and `bench.py --fleet-smoke`.
+
+    `clusters`: {cluster_id: synthetic_topology kwargs}; the default is 3
+    clusters, two of which share a bucketed model shape (so they must
+    share one compiled engine through the fleet's AnalyzerCore)."""
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.testing.synthetic import (
+        SyntheticWorkloadSampler,
+        synthetic_topology,
+    )
+
+    clusters = clusters or {
+        # east/west: identical geometry -> identical shape bucket -> ONE
+        # compiled engine serves both
+        "east": dict(num_brokers=6, topics={"T0": 12, "T1": 12}),
+        "west": dict(num_brokers=6, topics={"T0": 12, "T1": 12}),
+        # south: a different bucket, its own engine
+        "south": dict(num_brokers=12, topics={"T0": 48, "T1": 48}),
+    }
+    base = {
+        "fleet.clusters": ",".join(clusters),
+        "partition.metrics.window.ms": 1000,
+        "min.samples.per.partition.metrics.window": 1,
+        "num.partition.metrics.windows": max(3, sampled_windows),
+        "execution.progress.check.interval.ms": 100,
+        "webserver.http.port": 0,  # ephemeral
+        "tpu.num.candidates": 128,
+        "tpu.leadership.candidates": 32,
+        "tpu.steps.per.round": 16,
+        "tpu.num.rounds": 2,
+    }
+    base.update(props or {})
+    config = CruiseControlConfig(base)
+    backends = {}
+    samplers = {}
+    for i, (cid, spec) in enumerate(clusters.items()):
+        topo = synthetic_topology(seed=seed + i, **spec)
+        metadata = StaticMetadataProvider(topo)
+        admin = SimulatedClusterAdmin(metadata, link_rate_bytes_per_s=1e12)
+        sampler = SyntheticWorkloadSampler(topo, seed=seed + i)
+        backends[cid] = (metadata, admin, sampler)
+        samplers[cid] = sampler
+    app, fleet = build_fleet_service(config, backends)
+    window_ms = config.get("partition.metrics.window.ms")
+    for cid, ctx in fleet.contexts.items():
+        parts = samplers[cid].all_partition_entities()
+        for w in range(sampled_windows + 1):
+            ctx.fetcher.fetch_once(parts, w * window_ms, (w + 1) * window_ms - 1)
+    return app, fleet
+
+
+def _kafka_cluster_backend(ccfg: CruiseControlConfig, bootstrap: str):
+    """(metadata, admin, sampler) + clients for one LIVE Kafka cluster of a
+    fleet, wired exactly like the single-cluster main() path."""
+    from cruise_control_tpu.kafka import (
+        KafkaAdminClient,
+        KafkaClusterAdmin,
+        KafkaMetadataProvider,
+    )
+    from cruise_control_tpu.kafka.transport import KafkaMetricsConsumer
+    from cruise_control_tpu.monitor.reporter_sampler import (
+        CruiseControlMetricsReporterSampler,
+    )
+
+    sasl = sasl_credentials_from_config(ccfg)
+    client = KafkaAdminClient(parse_bootstrap_servers(bootstrap), sasl=sasl)
+    client.check_api_support()
+    metadata = KafkaMetadataProvider(client)
+    admin = KafkaClusterAdmin(client)
+    serde = None
+    if ccfg.get("cruise.control.metrics.serde.format") == "reference":
+        from cruise_control_tpu.reporter.metrics import ReferenceMetricSerde
+
+        serde = ReferenceMetricSerde
+    consumer_client = KafkaAdminClient(
+        parse_bootstrap_servers(bootstrap), sasl=sasl
+    )
+    sampler = CruiseControlMetricsReporterSampler(
+        KafkaMetricsConsumer(
+            consumer_client, ccfg.get("cruise.control.metrics.topic"), serde=serde
+        ),
+        metadata.topology,
+    )
+    return (metadata, admin, sampler), [client, consumer_client]
+
+
 def main(argv=None):  # pragma: no cover — manual entry point
     """Operator entry (reference KafkaCruiseControlMain.java:26-40):
     `python -m cruise_control_tpu.service.main config/cruisecontrol.properties`.
@@ -317,6 +490,46 @@ def main(argv=None):  # pragma: no cover — manual entry point
     argv = argv if argv is not None else sys.argv[1:]
     props = load_properties(argv[0]) if argv else {}
     config = CruiseControlConfig(props)
+    if config.fleet_cluster_ids():
+        # fleet mode: ONE instance over every cluster in fleet.clusters;
+        # each cluster's bootstrap.servers comes from its
+        # fleet.<id>.bootstrap.servers override (or the base key)
+        backends = {}
+        clients = []
+        for cid in config.fleet_cluster_ids():
+            ccfg = config.cluster_config(cid)
+            cluster_bootstrap = ccfg.values().get("bootstrap.servers")
+            if not cluster_bootstrap:
+                raise SystemExit(
+                    f"fleet cluster {cid!r} has no bootstrap.servers "
+                    f"(set fleet.{cid}.bootstrap.servers)"
+                )
+            backends[cid], cluster_clients = _kafka_cluster_backend(
+                ccfg, cluster_bootstrap
+            )
+            clients.extend(cluster_clients)
+        app, fleet = build_fleet_service(config, backends)
+        fleet.start_up(precompute=True)
+        for ctx in fleet.contexts.values():
+            ctx.fetcher.start(
+                lambda fn=ctx.task_runner.partitions_fn: fn()
+            )
+        app.start()
+        print(
+            f"cruise-control-tpu fleet ({len(fleet.contexts)} clusters) "
+            f"listening on {app.host}:{app.port}{app.prefix}"
+        )
+        try:
+            import time
+
+            while True:
+                time.sleep(60)
+        except KeyboardInterrupt:
+            fleet.shutdown()
+            app.stop()
+            for client in clients:
+                client.close()
+        return
     bootstrap = props.get("bootstrap.servers")
     if bootstrap:
         from cruise_control_tpu.kafka import KafkaAdminClient

@@ -139,6 +139,12 @@ _EXECUTION = (
 _DRYRUN = Param("dryrun", _bool)
 _REVIEW_ID = Param("review_id", _int, "two-step verification approval id")
 _REASON = Param("reason", str)
+#: fleet routing: every endpoint accepts `cluster` (appended below, like
+#: `reason`).  Single-cluster deployments reject any value (no fleet
+#: configured); in fleet mode cluster-scoped endpoints require it and the
+#: fleet-global ones (fleet/metrics/trace/user_tasks/review_board/review)
+#: treat it as an optional filter
+_CLUSTER = Param("cluster", str, "fleet cluster id the request targets")
 
 #: the builtin parameter map (reference CruiseControlParametersConfig's
 #: DEFAULT_* constants tree).  Every POST endpoint accepts `reason`
@@ -158,6 +164,7 @@ _RAW_PARAMETERS: dict[str, tuple] = {
                        Param("client_ids", _str_list),
                        Param("endpoints", _str_list),
                        Param("types", _str_list),
+                       Param("clusters", _str_list),
                        Param("fetch_completed_task", _bool)),
         "review_board": (Param("review_ids", _int_list),),
         "add_broker": (Param("brokerid", _int_list), _DRYRUN, _REVIEW_ID,
@@ -213,6 +220,11 @@ _RAW_PARAMETERS: dict[str, tuple] = {
                   Param("limit", _min1_int,
                         "max recent traces listed without id (default 50)")),
         "metrics": (),
+        # --- fleet controller (whole-instance rollup) ---
+        "fleet": (Param("score", _bool,
+                        "also batch-score every cluster's current placement "
+                        "on the shared goal chain (same-bucket clusters ride "
+                        "one device dispatch); slower"),),
 }
 
 from cruise_control_tpu.config.endpoints import (  # noqa: E402
@@ -220,13 +232,16 @@ from cruise_control_tpu.config.endpoints import (  # noqa: E402
     POST_ENDPOINTS,
 )
 
+def _with_cross_cutting(ep: str, params: tuple) -> tuple:
+    """Append the cross-cutting params every endpoint accepts: `reason` on
+    POSTs (audit trail) and `cluster` everywhere (fleet routing)."""
+    if ep in POST_ENDPOINTS and not any(p.name == "reason" for p in params):
+        params = (*params, _REASON)
+    return (*params, _CLUSTER)
+
+
 ENDPOINT_PARAMETERS: dict[str, EndpointParameters] = {
-    ep: EndpointParameters(
-        ep,
-        params
-        if ep not in POST_ENDPOINTS or any(p.name == "reason" for p in params)
-        else (*params, _REASON),
-    )
+    ep: EndpointParameters(ep, _with_cross_cutting(ep, params))
     for ep, params in _RAW_PARAMETERS.items()
 }
 

@@ -157,6 +157,8 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
             # flight-recorder trace id of the operation (empty when
             # tracing is disabled)
             Field("TraceId", STR, required=False),
+            # fleet cluster the operation targeted (empty single-cluster)
+            Field("Cluster", STR, required=False),
         ))),
     )),
     "review_board": Schema((Field("requestInfo", LIST),)),
@@ -231,6 +233,17 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
     # validated by the exposition lint parser (common/exposition.py,
     # scripts/check.sh gate)
     "metrics": Schema((), allow_extra=True),
+    # --- fleet controller ---
+    # GET /fleet: whole-instance rollup — per-cluster summaries under
+    # `clusters`, the shared-core view (engine cache, supervisor,
+    # admission control) under `shared`, and with ?score=true the batched
+    # per-cluster placement scores under `scores`
+    "fleet": Schema((
+        Field("numClusters", NUM),
+        Field("clusters", DICT),
+        Field("shared", DICT),
+        Field("scores", DICT, required=False),
+    )),
 }
 
 #: non-200 body shapes (shared by every endpoint)

@@ -29,7 +29,11 @@ from cruise_control_tpu.common.resources import RESOURCE_NAMES, Resource
 from cruise_control_tpu.service.facade import CruiseControl
 from cruise_control_tpu.service.parameters import ParameterError, build_override_maps
 from cruise_control_tpu.service.purgatory import Purgatory, PurgatoryFullError
-from cruise_control_tpu.service.tasks import USER_TASK_ID_HEADER, UserTaskManager
+from cruise_control_tpu.service.tasks import (
+    USER_TASK_ID_HEADER,
+    TenantOverloadError,
+    UserTaskManager,
+)
 
 from cruise_control_tpu.config.endpoints import GET_ENDPOINTS, POST_ENDPOINTS
 
@@ -180,9 +184,17 @@ def _parse_int_list(params: dict, name: str) -> list[int]:
 
 
 class CruiseControlApp:
-    """Server wrapper (reference KafkaCruiseControlApp.java)."""
+    """Server wrapper (reference KafkaCruiseControlApp.java).
 
-    def __init__(self, cc: CruiseControl, *, port: int | None = None, host: str | None = None):
+    With `fleet=` (a fleet.FleetManager) the one server fronts N clusters:
+    every request resolves its target facade from the `cluster=` parameter
+    (bound thread-locally so the existing handlers keep reading `self.cc`),
+    `/metrics` renders every cluster's labeled registry, and new async
+    operations pass per-tenant admission control.  Without it, behavior is
+    byte-for-byte the classic single-cluster server."""
+
+    def __init__(self, cc: CruiseControl, *, port: int | None = None,
+                 host: str | None = None, fleet=None):
         from cruise_control_tpu.service.security import (
             AllowAllSecurityProvider,
             BasicSecurityProvider,
@@ -191,13 +203,24 @@ class CruiseControlApp:
             SessionManager,
         )
 
-        self.cc = cc
-        self.config = cc.config
+        self._default_cc = cc
+        self.fleet = fleet
+        #: webserver/user-task keys come from the BASE config in fleet mode
+        #: (per-cluster configs only override cluster-scoped concerns)
+        self.config = fleet.config if fleet is not None else cc.config
         # flight recorder + exposition (facade-owned; standalone facades
-        # built without the config keys fall back to the process tracer)
+        # built without the config keys fall back to the process tracer).
+        # In a fleet this is the BASE (unscoped) tracer: /trace replays the
+        # shared store; per-cluster scoped tracers mint the spans.
         from cruise_control_tpu.common.trace import TRACER
 
-        self.tracer = getattr(cc, "tracer", None) or TRACER
+        if fleet is not None:
+            self.tracer = fleet.core.tracer
+        else:
+            self.tracer = getattr(self._default_cc, "tracer", None) or TRACER
+        self.tenant_max_pending = (
+            fleet.tenant_max_pending if fleet is not None else 0
+        )
 
         def _cat_map(fmt: str) -> dict:
             cats = {
@@ -208,103 +231,140 @@ class CruiseControlApp:
             }
             out = {}
             for cat, key_part in cats.items():
-                v = cc.config.get(fmt.format(key_part))
+                v = self.config.get(fmt.format(key_part))
                 if v is not None:
                     out[cat] = v
             return out
 
         self.user_tasks = UserTaskManager(
-            max_active_tasks=cc.config.get("max.active.user.tasks"),
-            max_cached_completed=cc.config.get("max.cached.completed.user.tasks"),
-            completed_retention_ms=cc.config.get("completed.user.task.retention.time.ms"),
+            max_active_tasks=self.config.get("max.active.user.tasks"),
+            max_cached_completed=self.config.get("max.cached.completed.user.tasks"),
+            completed_retention_ms=self.config.get("completed.user.task.retention.time.ms"),
             category_max_cached=_cat_map("max.cached.completed.{}.user.tasks"),
             category_retention_ms=_cat_map("completed.{}.user.task.retention.time.ms"),
         )
         self.purgatory = Purgatory(
-            retention_ms=cc.config.get("two.step.purgatory.retention.time.ms"),
-            max_requests=cc.config.get("two.step.purgatory.max.requests"),
+            retention_ms=self.config.get("two.step.purgatory.retention.time.ms"),
+            max_requests=self.config.get("two.step.purgatory.max.requests"),
         )
-        self.two_step = cc.config.get("two.step.verification.enabled")
-        self.reason_required = cc.config.get("request.reason.required")
+        self.two_step = self.config.get("two.step.verification.enabled")
+        self.reason_required = self.config.get("request.reason.required")
         self.sessions = SessionManager(
-            max_expiry_ms=cc.config.get("webserver.session.maxExpiryPeriodMs")
+            max_expiry_ms=self.config.get("webserver.session.maxExpiryPeriodMs")
         )
-        self.session_path = cc.config.get("webserver.session.path")
+        self.session_path = self.config.get("webserver.session.path")
         # security provider selection (reference webserver.security.provider)
-        jwt_cert = cc.config.get("jwt.auth.certificate.location") or cc.config.get(
+        jwt_cert = self.config.get("jwt.auth.certificate.location") or self.config.get(
             "jwt.authentication.certificate.location"
         )
         jwt_kwargs = dict(
-            cookie_name=cc.config.get("jwt.cookie.name"),
-            expected_audiences=cc.config.get("jwt.expected.audiences") or None,
+            cookie_name=self.config.get("jwt.cookie.name"),
+            expected_audiences=self.config.get("jwt.expected.audiences") or None,
         )
-        self.auth_provider_url = cc.config.get("jwt.authentication.provider.url")
-        custom_security = cc.config.get("webserver.security.provider")
-        if not cc.config.get("webserver.security.enable"):
+        self.auth_provider_url = self.config.get("jwt.authentication.provider.url")
+        custom_security = self.config.get("webserver.security.provider")
+        if not self.config.get("webserver.security.enable"):
             self.security = AllowAllSecurityProvider()
         elif custom_security is not None:
             # pluggable provider outranks the builtin selection
             # (reference webserver.security.provider)
-            self.security = custom_security(cc.config)
+            self.security = custom_security(self.config)
         elif jwt_cert:
             # certificate-based RS256 outranks shared-secret HS256
             self.security = JwtRs256SecurityProvider(jwt_cert, **jwt_kwargs)
-        elif cc.config.get("jwt.secret.key"):
+        elif self.config.get("jwt.secret.key"):
             self.security = JwtSecurityProvider(
-                cc.config.get("jwt.secret.key"), **jwt_kwargs
+                self.config.get("jwt.secret.key"), **jwt_kwargs
             )
         else:
             # reference key name wins over the legacy alias
             self.security = BasicSecurityProvider(
-                cc.config.get("webserver.auth.credentials.file")
-                or cc.config.get("basic.auth.credentials.file")
+                self.config.get("webserver.auth.credentials.file")
+                or self.config.get("basic.auth.credentials.file")
             )
         # CORS (reference WebServerConfig webserver.http.cors.*)
         self.cors_headers: dict[str, str] = {}
-        if cc.config.get("webserver.http.cors.enabled"):
+        if self.config.get("webserver.http.cors.enabled"):
             self.cors_headers = {
-                "Access-Control-Allow-Origin": cc.config.get("webserver.http.cors.origin"),
-                "Access-Control-Allow-Methods": cc.config.get(
+                "Access-Control-Allow-Origin": self.config.get("webserver.http.cors.origin"),
+                "Access-Control-Allow-Methods": self.config.get(
                     "webserver.http.cors.allowmethods"
                 ),
-                "Access-Control-Expose-Headers": cc.config.get(
+                "Access-Control-Expose-Headers": self.config.get(
                     "webserver.http.cors.exposeheaders"
                 ),
             }
         self.access_log = (
             AccessLog(
-                cc.config.get("webserver.accesslog.path"),
-                retention_days=cc.config.get("webserver.accesslog.retention.days"),
+                self.config.get("webserver.accesslog.path"),
+                retention_days=self.config.get("webserver.accesslog.retention.days"),
             )
-            if cc.config.get("webserver.accesslog.enabled")
+            if self.config.get("webserver.accesslog.enabled")
             else None
         )
         # static UI (reference webserver.ui.{diskpath,urlprefix})
-        self.ui_diskpath = cc.config.get("webserver.ui.diskpath")
-        self.ui_prefix = (cc.config.get("webserver.ui.urlprefix") or "/ui").rstrip("/")
+        self.ui_diskpath = self.config.get("webserver.ui.diskpath")
+        self.ui_prefix = (self.config.get("webserver.ui.urlprefix") or "/ui").rstrip("/")
         # API routes are dispatched before the UI, so a UI prefix can never
         # shadow them — which also means a UI prefix NESTED UNDER the API
         # prefix would be silently unreachable; both misconfigurations fail
         # loudly at startup instead
         if self.ui_diskpath:
-            api = self.cc.config.get("webserver.api.urlprefix").rstrip("/")
+            api = self.config.get("webserver.api.urlprefix").rstrip("/")
             nested = self.ui_prefix == api or self.ui_prefix.startswith(api + "/")
             if not self.ui_prefix or nested:
                 raise ValueError(
                     "webserver.ui.urlprefix must be a non-root prefix outside "
                     f"the API prefix {api!r}, got "
-                    f"{cc.config.get('webserver.ui.urlprefix')!r}"
+                    f"{self.config.get('webserver.ui.urlprefix')!r}"
                 )
         # per-endpoint parameter/request override maps (reference
         # CruiseControlParametersConfig / CruiseControlRequestConfig)
-        self.param_parsers, self.request_handlers = build_override_maps(cc.config)
-        self.prefix = cc.config.get("webserver.api.urlprefix").rstrip("/")
-        self.host = host or cc.config.get("webserver.http.address")
-        self.port = port if port is not None else cc.config.get("webserver.http.port")
+        self.param_parsers, self.request_handlers = build_override_maps(self.config)
+        self.prefix = self.config.get("webserver.api.urlprefix").rstrip("/")
+        self.host = host or self.config.get("webserver.http.address")
+        self.port = port if port is not None else self.config.get("webserver.http.port")
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # per-request context (each request runs on its own handler thread)
         self._local = threading.local()
+
+    # ------------------------------------------------------------------
+    # fleet routing
+    # ------------------------------------------------------------------
+
+    @property
+    def cc(self) -> CruiseControl:
+        """The facade the CURRENT request targets: the thread-locally bound
+        per-cluster facade in fleet mode, else the single facade.  Bound by
+        handle() on the request thread and re-bound by _async_op's wrapper
+        on the user-task pool thread before the operation body runs."""
+        return getattr(self._local, "cc", None) or self._default_cc
+
+    def _resolve_cluster(self, endpoint: str, cluster: str | None):
+        """-> (facade, cluster_id) for this request; raises BadRequest on
+        an unknown cluster, a `cluster=` outside fleet mode, or a missing
+        one on a cluster-scoped endpoint in fleet mode."""
+        from cruise_control_tpu.config.endpoints import FLEET_GLOBAL_ENDPOINTS
+
+        if self.fleet is None:
+            if cluster:
+                raise BadRequest(
+                    f"cluster={cluster!r} but this instance manages no fleet "
+                    "(fleet.clusters is empty)"
+                )
+            return self._default_cc, ""
+        if not cluster:
+            if endpoint in FLEET_GLOBAL_ENDPOINTS:
+                return self._default_cc, ""
+            raise BadRequest(
+                f"parameter 'cluster' is required for {endpoint} in fleet "
+                f"mode; clusters: {self.fleet.cluster_ids()}"
+            )
+        try:
+            return self.fleet.facade(cluster), cluster
+        except KeyError as e:
+            raise BadRequest(str(e.args[0])) from e
 
     # ------------------------------------------------------------------
     # endpoint handlers; each returns (status, payload)
@@ -315,6 +375,12 @@ class CruiseControlApp:
             raise BadRequest(f"unknown GET endpoint {endpoint}")
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             raise BadRequest(f"unknown POST endpoint {endpoint}")
+        # fleet routing: bind the target facade for this request thread
+        # BEFORE anything touches self.cc (the 202-resume path below never
+        # does — the task already carries its operation)
+        self._local.cc, self._local.cluster_id = self._resolve_cluster(
+            endpoint, params.get("cluster", [None])[0]
+        )
         if (
             method == "POST"
             and self.reason_required
@@ -425,19 +491,29 @@ class CruiseControlApp:
             }
 
     def _async_op(self, endpoint: str, fn) -> tuple[int, dict]:
+        # fleet context: the facade resolved on the REQUEST thread rides
+        # into the pool-thread wrapper, which re-binds it thread-locally so
+        # handler bodies reading self.cc resolve the same cluster there
+        cc = self.cc
+        cluster_id = getattr(self._local, "cluster_id", "") or ""
         # flight recorder: ONE trace per submitted operation.  The id is
         # minted here (synchronously, so the UserTask carries it and the
         # very first 202 can hand it to the client); the root span opens
         # on the pool thread when the operation actually runs, and every
         # pipeline stage beneath (model build, optimize, device ops,
-        # execution) parents into it via context propagation.
-        tracer = self.tracer
+        # execution) parents into it via context propagation.  In fleet
+        # mode the facade's CLUSTER-SCOPED tracer mints the root, so the
+        # whole operation files under this cluster's trace components.
+        tracer = getattr(cc, "tracer", None) or self.tracer
         trace_id = tracer.new_trace_id() if tracer.enabled else ""
 
         def wrapped(progress, _op=fn):
+            self._local.cc = cc
+            self._local.cluster_id = cluster_id
+            span_attrs = {"cluster": cluster_id} if cluster_id else {}
             with tracer.span(
                 f"service.{endpoint}", component="service",
-                trace_id=trace_id, root=True,
+                trace_id=trace_id, root=True, **span_attrs,
             ):
                 out = _op(progress)
             # degraded serving must be visible in the ops audit trail, not
@@ -454,23 +530,39 @@ class CruiseControlApp:
         fn = wrapped
 
         def _submit():
-            return self.user_tasks.submit(
-                endpoint, fn, client_id=client, trace_id=trace_id
+            # per-tenant admission control (fleet.tenant.max.pending.tasks):
+            # enforced at SUBMISSION inside the task manager's lock (an
+            # atomic count-and-admit) — polling an already-running task is
+            # never rejected, only new work competing for the shared pool
+            cap = (
+                self.tenant_max_pending
+                if self.fleet is not None and cluster_id else 0
             )
+            try:
+                return self.user_tasks.submit(
+                    endpoint, fn, client_id=client, trace_id=trace_id,
+                    cluster_id=cluster_id, cluster_max_active=cap,
+                )
+            except TenantOverloadError:
+                cc.sensors.counter("fleet.tenant-rejections").inc()
+                raise
 
         key = getattr(self._local, "session_key", None)
         client = getattr(self._local, "client", "") or ""
-        if key is None:
-            return self._task_response(_submit())
-        # bind the session to the submitted task so a client that lost the
-        # User-Task-ID header resumes the same operation instead of
-        # re-executing it (reference servlet/SessionManager.java)
-        tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
-        task = self.user_tasks.get(tid)
-        if task is None:  # bound task evicted; start fresh
-            self.sessions.release(key)
+        try:
+            if key is None:
+                return self._task_response(_submit())
+            # bind the session to the submitted task so a client that lost
+            # the User-Task-ID header resumes the same operation instead of
+            # re-executing it (reference servlet/SessionManager.java)
             tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
             task = self.user_tasks.get(tid)
+            if task is None:  # bound task evicted; start fresh
+                self.sessions.release(key)
+                tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
+                task = self.user_tasks.get(tid)
+        except TenantOverloadError as e:
+            return 429, {"errorMessage": str(e)}
         status, payload = self._task_response(task)
         if status != 202:  # response delivered -> close the session
             self.sessions.release(key)
@@ -603,6 +695,8 @@ class CruiseControlApp:
             ("client_ids", "client_id", True),
             ("endpoints", "endpoint", False),
             ("types", "status", False),
+            # fleet: filter the task board down to one or more clusters
+            ("clusters", "cluster_id", True),
         ):
             raw = params.get(pname, [None])[0]
             if not raw:
@@ -673,17 +767,49 @@ class CruiseControlApp:
 
     def _ep_metrics(self, params) -> tuple[int, dict]:
         """GET /metrics — Prometheus text exposition of the whole sensor
-        registry (common/exposition.py); text/plain, not JSON."""
+        surface (common/exposition.py); text/plain, not JSON.  Fleet mode
+        renders EVERY registry: the shared core's unlabeled plus each
+        cluster's `{cluster=...}`-labeled one."""
         from cruise_control_tpu.common.exposition import (
             CONTENT_TYPE,
             prometheus_text,
         )
 
+        registries = (
+            self.fleet.registries() if self.fleet is not None else self.cc.sensors
+        )
         body = prometheus_text(
-            self.cc.sensors,
+            registries,
             namespace=self.config.get("metrics.prometheus.namespace"),
         )
         return 200, RawResponse(body, CONTENT_TYPE)
+
+    def _ep_fleet(self, params) -> tuple[int, dict]:
+        """GET /fleet — whole-instance rollup: per-cluster summaries + the
+        shared core (engine cache, supervisor, admission control).  With
+        ?score=true every cluster's current placement is also scored on
+        the shared goal chain, same-bucket clusters batched through one
+        device dispatch.  Single-cluster deployments answer with a
+        one-entry rollup under the id "default"."""
+        cluster = params.get("cluster", [None])[0]
+        if self.fleet is not None:
+            out = self.fleet.fleet_state(cluster)
+            if _parse_bool(params, "score", False):
+                out["scores"] = self.fleet.score_clusters()
+            return 200, out
+        # single-cluster view: same shape, one synthetic entry, so fleet
+        # dashboards work unchanged against classic deployments
+        from cruise_control_tpu.fleet.manager import (
+            ClusterContext,
+            shared_core_rollup,
+        )
+
+        cc = self.cc
+        return 200, {
+            "numClusters": 1,
+            "clusters": {"default": ClusterContext("default", cc).rollup()},
+            "shared": shared_core_rollup(cc.core),
+        }
 
     def _ep_rightsize(self, params) -> tuple[int, dict]:
         """GET /rightsize — minimum brokers satisfying all hard goals at
@@ -942,13 +1068,22 @@ class CruiseControlApp:
         # crash-safe execution: an execution journal-reconciled at
         # construction belongs in the operation audit trail — the operator
         # reading it learns the service came up mid-rebalance and is
-        # resuming (the live detail rides /state ExecutorState.recovery)
-        recovery = self.cc.executor.recovery_info()
-        if recovery is not None:
-            OPERATION_LOGGER.warning(
-                "executor recovered in-flight execution from journal: %s",
-                recovery,
-            )
+        # resuming (the live detail rides /state ExecutorState.recovery).
+        # Fleet mode reports EVERY cluster's reconciliation: each cluster
+        # replayed its own namespaced journal at facade construction.
+        facades = (
+            [(ctx.cluster_id, ctx.cc) for ctx in self.fleet.contexts.values()]
+            if self.fleet is not None
+            else [("", self._default_cc)]
+        )
+        for cid, facade in facades:
+            recovery = facade.executor.recovery_info()
+            if recovery is not None:
+                OPERATION_LOGGER.warning(
+                    "executor%s recovered in-flight execution from journal: %s",
+                    f" [cluster {cid}]" if cid else "",
+                    recovery,
+                )
         app = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -18,6 +18,12 @@ from cruise_control_tpu.service.progress import OperationProgress, Pending
 USER_TASK_ID_HEADER = "User-Task-ID"
 
 
+class TenantOverloadError(RuntimeError):
+    """Per-cluster pending-task cap breached (fleet.tenant.max.pending.
+    tasks) — surfaces as 429, never as a 500.  Raised by submit() under
+    the manager lock so concurrent submissions can't race past the cap."""
+
+
 @dataclasses.dataclass
 class UserTask:
     task_id: str
@@ -43,6 +49,10 @@ class UserTask:
     #: off) — the handle a client uses with GET /trace to replay the
     #: operation's span tree after (or while) it runs
     trace_id: str = ""
+    #: fleet cluster this operation targets (empty in single-cluster
+    #: deployments) — drives the per-tenant admission control and the
+    #: USER_TASKS `clusters` filter
+    cluster_id: str = ""
 
     @property
     def status(self) -> str:
@@ -60,6 +70,7 @@ class UserTask:
             "Status": self.status,
             "StartMs": self.created_ms,
             "TraceId": self.trace_id,
+            "Cluster": self.cluster_id,
         }
 
 
@@ -93,12 +104,31 @@ class UserTaskManager:
 
     def submit(self, endpoint: str, fn, *, request_url: str = "",
                task_id: str | None = None, client_id: str = "",
-               trace_id: str = "") -> UserTask:
-        """Run fn(progress) on the session pool; returns the UserTask."""
+               trace_id: str = "", cluster_id: str = "",
+               cluster_max_active: int = 0) -> UserTask:
+        """Run fn(progress) on the session pool; returns the UserTask.
+
+        cluster_max_active > 0 enforces the fleet's per-tenant admission
+        cap (fleet.tenant.max.pending.tasks) HERE, under the same lock
+        that creates the task — a check-then-submit at the caller would
+        let two concurrent requests both read count == cap-1 and breach
+        the cap the 429 exists to enforce."""
         with self._lock:
             active = sum(1 for t in self._tasks.values() if t.status == "Active")
             if active >= self.max_active_tasks:
                 raise RuntimeError("too many active user tasks")
+            if cluster_max_active and cluster_id:
+                tenant_active = sum(
+                    1 for t in self._tasks.values()
+                    if t.cluster_id == cluster_id and t.status == "Active"
+                )
+                if tenant_active >= cluster_max_active:
+                    raise TenantOverloadError(
+                        f"cluster {cluster_id!r} already has "
+                        f"{cluster_max_active} pending tasks "
+                        "(fleet.tenant.max.pending.tasks); retry when "
+                        "they drain"
+                    )
             tid = task_id or str(uuid.uuid4())
             progress = OperationProgress()
             progress.add_step(Pending())
@@ -112,6 +142,7 @@ class UserTaskManager:
                 request_url=request_url,
                 client_id=client_id,
                 trace_id=trace_id,
+                cluster_id=cluster_id,
             )
             # completion stamp for retention: set the moment the operation
             # finishes, so the retention window starts when the RESULT

@@ -56,6 +56,22 @@ echo "== check.sh: bench.py --scenarios --smoke (batched what-if evaluation, CPU
 GRAFT_FORCE_CPU=1 python bench.py --scenarios --smoke
 scenarios_rc=$?
 
+echo "== check.sh: bench.py --fleet-smoke (shared-engine fleet economics, CPU) =="
+# named gate: a 3-cluster fleet (2 sharing a shape bucket) must end with
+# FEWER compiled engines than clusters (the shared AnalyzerCore is real)
+# and each cluster's warm proposal wall within 1.5x a single-cluster
+# baseline — multi-tenancy must not tax steady-state serving
+GRAFT_FORCE_CPU=1 python bench.py --fleet-smoke
+fleet_smoke_rc=$?
+
+echo "== check.sh: fleet controller gate (N clusters, shared core, isolation) =="
+# named gate: shared engine-cache hits across same-bucket clusters,
+# per-cluster journal namespacing with zero cross-adoption on restart,
+# cluster= routing + per-tenant 429 admission, N-cluster /metrics lint,
+# and the 3-FakeKafkaCluster live-socket acceptance story
+python -m pytest tests/test_fleet.py -q
+fleet_rc=$?
+
 echo "== check.sh: scenario planner gate (what-if parity, forecaster, rightsizer) =="
 # named gate: the identity-scenario byte parity, dead-rack/broker-add
 # semantics, engine-cache reuse across a scenario batch, and the
@@ -126,5 +142,5 @@ python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]

@@ -87,6 +87,7 @@ CASES = [
      {"scenarios": '[{"name":"add-one","addBrokers":[{"count":1}]}]'}),
     ("rightsize", "GET", {}),
     ("trace", "GET", {}),
+    ("fleet", "GET", {}),
 ]
 # /metrics is absent from CASES on purpose: its body is Prometheus TEXT,
 # validated by the exposition lint gate (scripts/check.sh +
@@ -122,6 +123,7 @@ def test_schema_validator_catches_drift():
 
 
 def _rsa_keypair(tmp_path):
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 

@@ -196,12 +196,13 @@ def test_unknown_endpoint_and_bad_params(service):
 def test_endpoint_surface_complete():
     """The reference exposes 9 GET + 11 POST endpoints
     (CruiseControlEndPoint.java:16-37) — all must exist here, plus the
-    planner's read-only /rightsize (GET) and /simulate (POST) and the
-    observability surface /trace + /metrics (GET)."""
+    planner's read-only /rightsize (GET) and /simulate (POST), the
+    observability surface /trace + /metrics (GET), and the fleet
+    controller's /fleet rollup (GET)."""
     assert set(GET_ENDPOINTS) == {
         "bootstrap", "train", "load", "partition_load", "proposals", "state",
         "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
-        "trace", "metrics",
+        "trace", "metrics", "fleet",
     }
     assert set(POST_ENDPOINTS) == {
         "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
@@ -440,6 +441,7 @@ def test_ssl_listener():
     import ssl as ssl_mod
     import tempfile
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
