@@ -138,6 +138,17 @@ class OptimizerConfig:
     #: blocking sync per round — kept for parity testing, per-round
     #: debugging, and host-side schedule experiments.
     fused_rounds: bool = True
+    #: learned move-acceptance prior (streaming controller): replica-move
+    #: DESTINATION draws mix a per-(source-topic, destination) categorical
+    #: fitted from past anneal trajectories / executed proposals
+    #: (controller/prior.py) into the uniform draw.  Trace-static: False
+    #: (the default) keeps the traced step program byte-identical to the
+    #: pre-prior engine; True adds the prior gather/searchsorted ops but a
+    #: COLD prior (mix 0) still reproduces the uniform draw stream
+    #: bit-for-bit — the uniform branch consumes the same key with the
+    #: same arithmetic, and the prior's extra draws ride keys derived via
+    #: fold_in that no other stream reads (pinned by tests).
+    prior_enabled: bool = False
 
     def __post_init__(self):
         # round-count knobs validated in ONE place: both the in-graph
@@ -234,6 +245,8 @@ class EngineCarry:
         "n_source",
         "n_dest",
         "n_brokers",
+        "prior_dst_cdf",
+        "prior_mix",
     ],
     meta_fields=[],
 )
@@ -269,6 +282,17 @@ class EngineStatics:
     #: importance sampler's CDF search so a u ~ 1.0 edge draw resolves to
     #: the last REAL broker under any padding
     n_brokers: jax.Array
+    #: f32[T, B] per-SOURCE-TOPIC inclusive CDF over destination POSITIONS
+    #: (indices into dest_ids' real head), the learned move-acceptance
+    #: prior of the streaming controller; positions >= n_dest hold 1.0 so
+    #: an edge draw clips onto the last real destination.  A [1, 1] zero
+    #: placeholder when the engine's config has prior_enabled=False (the
+    #: compile key includes the flag, so avals stay consistent per engine).
+    prior_dst_cdf: jax.Array
+    #: f32 scalar in [0, 1] — fraction of replica-move destination draws
+    #: taken from the prior CDF instead of uniform; 0.0 (cold prior) makes
+    #: the destination stream byte-identical to the uniform-only draw
+    prior_mix: jax.Array
 
 
 @partial(
@@ -333,12 +357,26 @@ def partition_replica_table(
     return table
 
 
-def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineStatics:
+def build_statics(
+    state: ClusterState,
+    options: OptimizationOptions,
+    *,
+    prior=None,
+    prior_full_shape: bool = False,
+) -> EngineStatics:
     """Host-side (numpy) preprocessing of one model generation.
 
     Every device array this needs comes down in ONE batched device_get —
     at 500k-replica scale, per-array np.asarray syncs cost seconds each
     and dominated engine construction.
+
+    `prior` (duck-typed: `.weights` f32[T, B] in broker-id space keyed by
+    this generation's topic ids, `.mix` float) is the learned
+    move-acceptance prior; it is converted here onto destination
+    POSITIONS because only this function knows the dest_ids layout.  With
+    `prior_full_shape` False (prior_enabled=False engines) the statics
+    carry a [1, 1] placeholder so the disabled program never pays a
+    [T, B] transfer per rebind.
     """
     s = state.shape
     h_keys = (
@@ -370,6 +408,33 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
     n_valid_int = int(h["replica_valid"].sum())
     front_packed = bool(h["replica_valid"][:n_valid_int].all())
     n_source = n_valid_int if front_packed else s.R
+    n_dest_int = int(dest_idx.size)
+    if not prior_full_shape:
+        prior_cdf = np.zeros((1, 1), np.float32)
+        prior_mix = 0.0
+    else:
+        T = s.num_topics
+        prior_cdf = np.ones((T, s.B), np.float32)
+        w = None if prior is None else getattr(prior, "weights", None)
+        if w is not None:
+            w = np.asarray(w, np.float32)
+            if w.shape != (T, s.B):
+                raise ValueError(
+                    f"prior weights shape {w.shape} != model (T={T}, B={s.B})"
+                )
+            w_pos = np.maximum(w[:, dest_idx], 0.0)  # [T, n_dest]
+        else:
+            w_pos = np.zeros((T, n_dest_int), np.float32)
+        tot = w_pos.sum(1, keepdims=True)
+        # unseen topics draw uniformly over the real destination list —
+        # still a valid categorical, just a different stream than the
+        # uniform branch (the mix gate decides which branch is taken)
+        uni = np.full((T, n_dest_int), 1.0 / max(1, n_dest_int), np.float32)
+        probs = np.where(tot > 0.0, w_pos / np.maximum(tot, 1e-12), uni)
+        prior_cdf[:, :n_dest_int] = np.cumsum(probs, axis=1)
+        prior_mix = float(getattr(prior, "mix", 0.0)) if prior is not None else 0.0
+        if not 0.0 <= prior_mix <= 1.0:
+            raise ValueError(f"prior mix must be in [0, 1], got {prior_mix}")
     return EngineStatics(
         state=state,
         part_replicas=jnp.asarray(partition_replica_table(state, host=h)),
@@ -389,8 +454,10 @@ def build_statics(state: ClusterState, options: OptimizationOptions) -> EngineSt
             float((h["disk_capacity"] * dmask).sum() + 1e-12), jnp.float32
         ),
         n_source=jnp.asarray(max(1, n_source), jnp.int32),
-        n_dest=jnp.asarray(int(dest_idx.size), jnp.int32),
+        n_dest=jnp.asarray(n_dest_int, jnp.int32),
         n_brokers=jnp.asarray(max(1, int(h["broker_valid"].sum())), jnp.int32),
+        prior_dst_cdf=jnp.asarray(prior_cdf),
+        prior_mix=jnp.asarray(prior_mix, jnp.float32),
     )
 
 
@@ -561,6 +628,7 @@ class Engine:
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
+        prior=None,
     ):
         self.chain = chain
         self.constraint = constraint
@@ -580,7 +648,9 @@ class Engine:
             )
             self.K_r = config.num_candidates - self.K_l - self.K_s
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
-        self.statics = build_statics(state, options)
+        self.statics = build_statics(
+            state, options, prior=prior, prior_full_shape=config.prior_enabled
+        )
         self._scan = jax.jit(self._scan_impl)
         self._jit_refresh = jax.jit(self._refresh_impl)
         self._jit_objective = jax.jit(self._objective_impl)
@@ -589,6 +659,7 @@ class Engine:
         self._jit_cheap_violations = jax.jit(self._cheap_violations_impl)
         self._jit_round_prep = jax.jit(self._round_prep_impl)
         self._jit_init = jax.jit(self._init_impl)
+        self._jit_init_from = jax.jit(self._init_from_impl)
         self._jit_eval = jax.jit(self._eval_impl)
         # the fused whole-anneal program: the carry is DONATED — its
         # buffers are reused for the output placement, so HBM holds one
@@ -677,14 +748,22 @@ class Engine:
         return self.statics.state
 
     def rebind(
-        self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS
+        self,
+        state: ClusterState,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        prior=None,
     ) -> "Engine":
-        """Swap in a new model generation without recompiling."""
+        """Swap in a new model generation without recompiling.  `prior`
+        (see build_statics) rides the statics, so a refreshed learned
+        move-acceptance prior is a data rebind too, never a compile."""
         if state.shape != self.shape:
             raise ValueError(
                 f"shape changed {self.shape} -> {state.shape}; build a new Engine"
             )
-        self.statics = build_statics(state, options)
+        self.statics = build_statics(
+            state, options, prior=prior,
+            prior_full_shape=self.config.prior_enabled,
+        )
         return self
 
     def release(self) -> None:
@@ -718,17 +797,52 @@ class Engine:
     def init_carry(self, key: jax.Array) -> EngineCarry:
         return self._fn("_jit_init")(self.statics, key)
 
+    def init_carry_from(self, key: jax.Array, placement) -> EngineCarry:
+        """Carry seeded from a PRIOR placement — the streaming controller's
+        warm start: the previous accepted proposal's (replica_broker,
+        replica_is_leader, replica_disk) arrays become the anneal's initial
+        state while the statics keep the CURRENT cluster placement, so
+        movement pricing still charges strays against what the executor
+        would actually have to move."""
+        rb, il, dk = placement
+        # REAL copies, not views: the init program forwards these arrays
+        # into the carry, and the fused run DONATES the carry — without a
+        # copy the donated buffers would still be aliased by the caller's
+        # placement (typically a published result's state_after), which
+        # the run would then scribble over
+        return self._fn("_jit_init_from")(
+            self.statics, key,
+            jnp.array(rb, jnp.int32, copy=True),
+            jnp.array(il, bool, copy=True),
+            jnp.array(dk, jnp.int32, copy=True),
+        )
+
     def _init_impl(self, sx: EngineStatics, key: jax.Array) -> EngineCarry:
-        """Zero carry + aggregate refresh as ONE program.  Building the
-        zero arrays eagerly cost ~10 tiny jit dispatches whose sub-second
-        compiles are not persisted — several seconds of per-process warmup
-        for literal zero-fills."""
+        """Zero carry + aggregate refresh as ONE program (seeded from the
+        statics' current placement).  Building the zero arrays eagerly
+        cost ~10 tiny jit dispatches whose sub-second compiles are not
+        persisted — several seconds of per-process warmup for literal
+        zero-fills."""
         st = sx.state
+        return self._init_from_impl(
+            sx, key, st.replica_broker, st.replica_is_leader, st.replica_disk
+        )
+
+    def _init_from_impl(
+        self, sx: EngineStatics, key: jax.Array, rb: jax.Array,
+        il: jax.Array, dk: jax.Array,
+    ) -> EngineCarry:
+        """Carry seeded from an arbitrary placement (the statics' own for
+        cold starts, a prior accepted placement for warm starts);
+        aggregates are refreshed from IT, so the carry is exactly what a
+        run that produced this placement would have left.  One program,
+        one refresh (the zero aggregates are overwritten by the refresh,
+        so none are computed twice)."""
         B = self.shape.B
         zeros = EngineCarry(
-            replica_broker=st.replica_broker,
-            replica_is_leader=st.replica_is_leader,
-            replica_disk=st.replica_disk,
+            replica_broker=rb,
+            replica_is_leader=il,
+            replica_disk=dk,
             broker_load=jnp.zeros((B, NUM_RESOURCES), jnp.float32),
             broker_replica_count=jnp.zeros(B, jnp.int32),
             broker_leader_count=jnp.zeros(B, jnp.int32),
@@ -1110,6 +1224,33 @@ class Engine:
             r = jnp.concatenate([r, r_imp])
         return r
 
+    def _sample_dests(self, sx, key: jax.Array, n: int, r: jax.Array) -> jax.Array:
+        """n destination POSITIONS (indices into dest_ids) for the replica
+        moves whose sampled sources are `r`.
+
+        Default (prior_enabled=False): the uniform draw over the real
+        destination head — today's program, untouched.  With the learned
+        move-acceptance prior compiled in, each draw takes the
+        per-source-topic prior CDF with probability `prior_mix` and the
+        uniform branch otherwise.  The uniform branch consumes the SAME
+        key with the SAME arithmetic as the default, and the prior's two
+        extra draws ride a fold_in-derived key no other stream reads, so
+        a cold prior (mix 0) reproduces the uniform stream bit-for-bit —
+        the controller's parity guarantee (tests/test_controller.py).
+        """
+        uni = _uniform_idx(key, (n,), sx.n_dest)
+        if not self.config.prior_enabled:
+            return uni
+        k_m, k_p = jax.random.split(jax.random.fold_in(key, 1))
+        t = sx.state.replica_topic[jnp.minimum(r, self.shape.R - 1)]
+        cdf = sx.prior_dst_cdf[t]  # [n, B] per-topic inclusive CDF
+        u = jax.random.uniform(k_p, (n,))
+        p_idx = jnp.minimum(
+            jnp.sum(u[:, None] >= cdf, axis=-1).astype(jnp.int32), sx.n_dest - 1
+        )
+        use = jax.random.uniform(k_m, (n,)) < sx.prior_mix
+        return jnp.where(use, p_idx, uni)
+
     def _slice_draws(self, slice_, *arrays):
         """Candidate-axis sharding (parallel/mesh.py): keep only one mesh
         shard's contiguous slice of the full-K draw vectors.
@@ -1144,7 +1285,7 @@ class Engine:
         K = self.K_r
         k1, k2 = jax.random.split(key)
         r = self._sample_sources(sx, k1, K, plan)
-        dst = sx.dest_ids[_uniform_idx(k2, (K,), sx.n_dest)]
+        dst = sx.dest_ids[self._sample_dests(sx, k2, K, r)]
         r, dst = self._slice_draws(slice_, r, dst)
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
@@ -2117,7 +2258,7 @@ class Engine:
     # ------------------------------------------------------------------
 
     @device_op("engine.run")
-    def run(self, *, verbose: bool = False):
+    def run(self, *, verbose: bool = False, initial_placement=None):
         """Execute the annealing schedule; returns (final_state, history).
 
         history is a list of per-round dicts (round, temperature, accepted,
@@ -2125,16 +2266,33 @@ class Engine:
         (`timing=True`) carrying the device/host split and the number of
         blocking host<->device syncs the optimization performed — the
         fused path's contract is O(1) syncs regardless of round count.
+
+        `initial_placement` (optional (replica_broker, replica_is_leader,
+        replica_disk) triple of this shape) warm-starts the anneal from a
+        prior accepted placement instead of the statics' current one —
+        the streaming controller's incremental re-anneal.  The RNG chain,
+        schedule, and early-stop semantics are unchanged; only the round-0
+        carry differs.
         """
         if self.config.fused_rounds:
-            return self._run_fused(verbose=verbose)
-        return self._run_legacy(verbose=verbose)
+            return self._run_fused(
+                verbose=verbose, initial_placement=initial_placement
+            )
+        return self._run_legacy(
+            verbose=verbose, initial_placement=initial_placement
+        )
 
-    def _run_fused(self, *, verbose: bool = False):
+    def _init_for_run(self, initial_placement):
+        key = jax.random.PRNGKey(self.config.seed)
+        if initial_placement is None:
+            return self.init_carry(key)
+        return self.init_carry_from(key, initial_placement)
+
+    def _run_fused(self, *, verbose: bool = False, initial_placement=None):
         cfg = self.config
         sx = self.statics
         t_start = time.monotonic()
-        carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
+        carry = self._init_for_run(initial_placement)
         if verbose:
             if self._jit_run_fused_verbose is None:
                 self._jit_run_fused_verbose = jax.jit(
@@ -2180,7 +2338,7 @@ class Engine:
         ))
         return self.carry_to_state(carry), history
 
-    def _run_legacy(self, *, verbose: bool = False):
+    def _run_legacy(self, *, verbose: bool = False, initial_placement=None):
         """Legacy Python round loop: one scan dispatch + one blocking sync
         per round.  Kept behind `fused_rounds=False` for parity testing and
         per-round host-side debugging."""
@@ -2197,7 +2355,7 @@ class Engine:
             sync["s"] += time.monotonic() - t0
             return v
 
-        carry = self.init_carry(jax.random.PRNGKey(cfg.seed))
+        carry = self._init_for_run(initial_placement)
 
         t0_obj = float(fetch(self._fn("_jit_eval")(sx, carry)[0]))
         t0_obj *= cfg.init_temperature_scale

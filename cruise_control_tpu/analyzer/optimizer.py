@@ -356,6 +356,7 @@ class GoalOptimizer:
         config: OptimizerConfig,
         *,
         count: bool = True,
+        prior=None,
     ) -> tuple[Engine, dict]:
         """Cached engine for (shape, config) + a compile-vs-rebind outcome
         record ({engine_cache_hit, engine_build_s}) for the result timing.
@@ -371,7 +372,7 @@ class GoalOptimizer:
         t0 = time.monotonic()
         if hit:
             try:
-                engine.rebind(state, options)
+                engine.rebind(state, options, prior=prior)
             except BaseException:
                 # a failed rebind (bad options mask, device error) must not
                 # leave the _cache_get pin behind — a stuck pin exempts the
@@ -380,7 +381,8 @@ class GoalOptimizer:
                 raise
         else:
             engine = Engine(
-                state, self.chain, constraint=self.constraint, options=options, config=config
+                state, self.chain, constraint=self.constraint, options=options,
+                config=config, prior=prior,
             )
             self._cache_put(self._engines, key, engine)
         self._record(hit, count=count)
@@ -538,8 +540,16 @@ class GoalOptimizer:
         *,
         verbose: bool = False,
         config: OptimizerConfig | None = None,
+        initial_placement=None,
+        prior=None,
     ) -> OptimizerResult:
         """Run the goal chain; supervised when a DeviceSupervisor is wired.
+
+        `initial_placement` / `prior` are the streaming controller's
+        warm-start inputs (engine.run warm carry + the learned
+        move-acceptance prior folded into the sampling plan); both are
+        single-device-mode only and ignored by the CPU-greedy degraded
+        fallback, which always answers from the current placement.
 
         Unsupervised (offline/test default) this IS `_optimize_on_device`.
         Supervised, the whole device body — input checks, engine build/
@@ -557,7 +567,10 @@ class GoalOptimizer:
         recorder's analyzer stage."""
         cfg = config or self.config
         with self.tracer.span("analyzer.optimize", component="analyzer") as sp:
-            result = self._optimize_routed(state, options, verbose, cfg)
+            result = self._optimize_routed(
+                state, options, verbose, cfg,
+                initial_placement=initial_placement, prior=prior,
+            )
             timing = next((h for h in result.history if h.get("timing")), {})
             sp.set(
                 parallel_mode=self.parallel_mode,
@@ -582,6 +595,9 @@ class GoalOptimizer:
         options: OptimizationOptions,
         verbose: bool,
         cfg: OptimizerConfig,
+        *,
+        initial_placement=None,
+        prior=None,
     ) -> OptimizerResult:
         """Supervision routing (the pre-trace `optimize` body): device
         path under the supervisor, CPU greedy degradation on breaker-open
@@ -589,7 +605,10 @@ class GoalOptimizer:
         every route's result uniformly."""
         sup = self.supervisor
         if sup is None:
-            return self._optimize_on_device(state, options, verbose=verbose, config=cfg)
+            return self._optimize_on_device(
+                state, options, verbose=verbose, config=cfg,
+                initial_placement=initial_placement, prior=prior,
+            )
         from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
 
         self._maybe_purge_after_open()
@@ -598,7 +617,8 @@ class GoalOptimizer:
         try:
             return sup.call(
                 lambda: self._optimize_on_device(
-                    state, options, verbose=verbose, config=cfg
+                    state, options, verbose=verbose, config=cfg,
+                    initial_placement=initial_placement, prior=prior,
                 ),
                 op="optimize",
             )
@@ -672,6 +692,8 @@ class GoalOptimizer:
         *,
         verbose: bool = False,
         config: OptimizerConfig | None = None,
+        initial_placement=None,
+        prior=None,
     ) -> OptimizerResult:
         from concurrent.futures import ThreadPoolExecutor
 
@@ -698,8 +720,15 @@ class GoalOptimizer:
         cache_info = None
         try:
             if self.parallel_mode == "single":
-                engine, cache_info = self._engine_for(state, options, cfg)
+                engine, cache_info = self._engine_for(
+                    state, options, cfg, prior=prior
+                )
             else:
+                if initial_placement is not None or prior is not None:
+                    raise ValueError(
+                        "warm-start placement / move-acceptance prior are "
+                        f"single-device only (tpu.parallel.mode={self.parallel_mode!r})"
+                    )
                 engine, cache_info = self._parallel_engine(state, options, cfg)
             # only at production scale: tiny test engines compile in
             # hundreds of ms, and eagerly tracing the rarely-used
@@ -722,8 +751,13 @@ class GoalOptimizer:
                 # is the block a profiler dump illuminates
                 from cruise_control_tpu.common.profiling import profiler_trace
 
+                run_kwargs = (
+                    {"initial_placement": initial_placement}
+                    if initial_placement is not None
+                    else {}
+                )
                 with profiler_trace(self.profiler_dir):
-                    final, history = engine.run(verbose=verbose)
+                    final, history = engine.run(verbose=verbose, **run_kwargs)
                 before_host = before_host_f.result()
         finally:
             # run() is done with the engine's buffers (everything below

@@ -129,6 +129,20 @@ class ProposalSet:
         src = c["tb_old"][c["moved"]]
         return {int(b) for b in np.unique(src)}
 
+    def destination_pairs(self) -> tuple[np.ndarray, np.ndarray]:
+        """(topic_id, destination_broker) pairs of replica MOVES — brokers
+        receiving a replica of the partition they did not hold before.
+        The observation unit of the learned move-acceptance prior
+        (controller/prior.py); columnar, no object materialization."""
+        c = self._c
+        nb, ob = c["nb"], c["ob"]  # [N, max_rf], -1 pads
+        incoming = (nb >= 0) & ~(nb[:, :, None] == ob[:, None, :]).any(-1)
+        rows, cols = np.nonzero(incoming)
+        return (
+            c["topic"][rows].astype(np.int64),
+            nb[rows, cols].astype(np.int64),
+        )
+
     # ---------------------------------------------------- materialization
 
     def _rows(self, ks) -> list[ExecutionProposal]:

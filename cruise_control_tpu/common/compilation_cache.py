@@ -7,6 +7,12 @@ compilation cache writes compiled executables to disk keyed by HLO
 fingerprint, so a restarted service (same shapes, same jax/XLA version)
 reloads them in milliseconds.
 
+Boot observability (config tpu.compile.cache.dir): enabling the cache
+records its on-disk entry inventory; `boot_report()` later diffs against
+it so the service can log, after the first proposal pass, how many
+executables were loaded warm from disk (hits) vs compiled fresh (misses)
+— the number ROADMAP item 2's restart SLO is built on.
+
 Reference analog: none — a JVM has no compile step to amortize; this is a
 TPU-framework concern (the proposal-precompute thread
 GoalOptimizer.java:124-175 amortizes model generations, not compilation).
@@ -14,18 +20,43 @@ GoalOptimizer.java:124-175 amortizes model generations, not compilation).
 
 from __future__ import annotations
 
+import logging
 import os
 
+log = logging.getLogger(__name__)
+
 _enabled = False
+#: entry names present on disk when the cache was enabled (boot inventory)
+_boot_entries: set[str] | None = None
+_cache_dir: str | None = None
+
+
+def _scan(cache_dir: str) -> tuple[set[str], int]:
+    """(entry names, total bytes) currently on disk; tolerant of races."""
+    entries: set[str] = set()
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(cache_dir):
+            for fn in files:
+                path = os.path.join(root, fn)
+                entries.add(os.path.relpath(path, cache_dir))
+                try:
+                    total += os.path.getsize(path)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return entries, total
 
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Idempotently point JAX at a durable on-disk compilation cache.
 
     Returns the directory used, or None when disabled (empty dir given or
-    an old jax without the feature).
+    an old jax without the feature).  Logs the boot inventory — how many
+    cached executables a restart can reload instead of re-tracing.
     """
-    global _enabled
+    global _enabled, _boot_entries, _cache_dir
     if not cache_dir:
         return None
     cache_dir = os.path.expanduser(cache_dir)
@@ -42,6 +73,29 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _enabled = True
+        _cache_dir = cache_dir
+        _boot_entries, total = _scan(cache_dir)
+        log.info(
+            "persistent XLA compile cache at %s: %d cached executables "
+            "(%.1f MB) available warm at boot",
+            cache_dir, len(_boot_entries), total / 1e6,
+        )
         return cache_dir
     except Exception:  # pragma: no cover — very old jax
         return None
+
+
+def boot_report() -> dict | None:
+    """Hit/miss view since boot: entries present at enable time (warm,
+    reloadable = hits for re-traced programs) vs entries written since
+    (fresh compiles = misses).  None when the cache is disabled."""
+    if not _enabled or _cache_dir is None or _boot_entries is None:
+        return None
+    now, total = _scan(_cache_dir)
+    return {
+        "dir": _cache_dir,
+        "entriesAtBoot": len(_boot_entries),
+        "newCompiles": len(now - _boot_entries),
+        "entries": len(now),
+        "bytes": total,
+    }

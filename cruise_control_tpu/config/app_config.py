@@ -146,6 +146,14 @@ def _analyzer_defs() -> ConfigDef:
              "~/.cache/cruise_control_tpu/xla", I.LOW,
              "persistent XLA compilation cache directory; empty disables "
              "(compiled programs survive service restarts)", group=g)
+    d.define("tpu.compile.cache.dir", T.STRING, None, I.LOW,
+             "preferred spelling of tpu.compilation.cache.dir (takes "
+             "precedence when both are set): the on-disk XLA executable "
+             "cache a restarted service/controller reloads instead of "
+             "re-tracing unchanged shape buckets; boot logs the cache's "
+             "entry count and the first proposal pass logs how many "
+             "executables were compiled fresh (misses) vs available warm",
+             group=g)
     # --- supervised optimizer runtime (common/device_watchdog.py) ---
     g = "analyzer.tpu.supervisor"
     d.define("tpu.supervisor.enabled", T.BOOLEAN, True, I.MEDIUM,
@@ -194,6 +202,53 @@ def _analyzer_defs() -> ConfigDef:
              "/tmp/cruise-control-tpu-profiler", I.LOW,
              "directory jax.profiler trace dumps land in when "
              "tpu.profiler.enabled is on", group=g)
+    return d
+
+
+def _controller_defs() -> ConfigDef:
+    """Streaming-controller keys (controller/streaming.py — no reference
+    analog: the reference recomputes proposals from scratch on a timer)."""
+    d = ConfigDef()
+    g = "controller"
+    d.define("controller.enabled", T.BOOLEAN, False, I.MEDIUM,
+             "run the always-on streaming controller: the flattened "
+             "cluster model stays device-resident, metric-window deltas "
+             "apply in place (no re-flatten while the shape bucket holds) "
+             "and every window roll re-anneals incrementally — warm-"
+             "started from the previous accepted placement and the "
+             "learned move-acceptance prior — publishing into the "
+             "proposal cache.  Replaces the legacy proposal-precompute "
+             "loop while on", group=g)
+    d.define("controller.poll.interval.ms", T.LONG, 1_000, I.MEDIUM,
+             "how often the controller checks the partition aggregator "
+             "for a rolled metric window (cheap generation reads; the "
+             "expensive work only runs on an actual roll)",
+             in_range(lo=10), group=g)
+    d.define("controller.warm.start.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "seed each incremental anneal's carry from the previous "
+             "accepted placement instead of the current cluster placement "
+             "(movement pricing still charges strays against the real "
+             "cluster); off = every anneal is cold", group=g)
+    d.define("controller.delta.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "apply metric-window deltas to the device-resident model in "
+             "place; off forces a full model re-flatten every window roll "
+             "(the parity/diagnosis mode the streaming bench gates "
+             "against)", group=g)
+    d.define("controller.prior.mix", T.DOUBLE, 0.5, I.MEDIUM,
+             "fraction of the annealer's replica-move DESTINATION draws "
+             "taken from the learned per-topic-pair move-acceptance "
+             "prior once it is ready; 0 disables prior sampling entirely "
+             "(the engine program stays byte-identical to the request "
+             "path's)", in_range(lo=0.0, hi=1.0), group=g)
+    d.define("controller.prior.decay", T.DOUBLE, 0.9, I.LOW,
+             "exponential decay applied to the prior's acceptance counts "
+             "per observation batch, so stale traffic patterns fade",
+             in_range(lo=0.01, hi=1.0), group=g)
+    d.define("controller.prior.min.observations", T.INT, 64, I.LOW,
+             "decayed (topic, destination) observations required before "
+             "the prior's mix turns on; below it the prior is COLD and "
+             "destination draws reproduce the uniform stream byte-for-"
+             "byte", in_range(lo=0), group=g)
     return d
 
 
@@ -781,6 +836,7 @@ def _webserver_defs() -> ConfigDef:
 def cruise_control_config_def() -> ConfigDef:
     return (
         _analyzer_defs()
+        .merge(_controller_defs())
         .merge(_observability_defs())
         .merge(_fleet_defs())
         .merge(_planner_defs())
@@ -943,6 +999,17 @@ class CruiseControlConfig(AbstractConfig):
             leadership_move_cost=g("tpu.leadership.move.cost"),
             importance_fraction=g("tpu.importance.fraction"),
         )
+
+    def compile_cache_dir(self) -> str | None:
+        """Persistent XLA compile-cache directory: the preferred
+        tpu.compile.cache.dir when SET (an explicitly empty value
+        disables the cache — it must not fall through to the legacy
+        key's non-empty default), else the legacy
+        tpu.compilation.cache.dir (empty/None disables)."""
+        v = self.get("tpu.compile.cache.dir")
+        if v is not None:
+            return v or None
+        return self.get("tpu.compilation.cache.dir") or None
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")

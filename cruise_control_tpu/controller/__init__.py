@@ -1,0 +1,15 @@
+"""Streaming controller — always-on incremental rebalancing.
+
+The fifth subsystem beside monitor/analyzer/executor/planner/detector
+(ROADMAP item 3): an always-on control loop that keeps the flattened
+ClusterState device-resident, applies metric-window deltas in place
+(models/whatif.py LiveState), re-anneals incrementally on every window
+roll (warm-start carry + the learned move-acceptance prior of
+controller/prior.py), and publishes each result into the facade's
+proposal cache so the service always holds a continuously-fresh proposal.
+"""
+
+from cruise_control_tpu.controller.prior import MoveAcceptancePrior, PriorTable
+from cruise_control_tpu.controller.streaming import StreamingController
+
+__all__ = ["MoveAcceptancePrior", "PriorTable", "StreamingController"]

@@ -1,0 +1,443 @@
+"""StreamingController — the always-on incremental rebalancing loop.
+
+Today's proposal path re-flattens the whole ClusterModel and anneals from
+scratch on every request; under heavy traffic the service repays the full
+model-build + anneal bill on every window roll.  The controller inverts
+that: it owns a device-resident flattened ClusterState (models/whatif.py
+LiveState) and, each time the partition aggregator rolls a metric window,
+
+  1. extracts the window DELTA from two WindowedHistory snapshots
+     (monitor/delta.py) — honoring the completeness mask, so half-sampled
+     windows never read as traffic drops — and scatters only the changed
+     partitions' loads into the live arrays (donated buffers, the fused
+     anneal's trick; no re-flatten while the shape bucket holds);
+  2. re-anneals INCREMENTALLY: the previous accepted placement seeds the
+     carry (engine.init_carry_from) and the learned per-topic-pair
+     move-acceptance prior (controller/prior.py) is folded into the
+     engine's destination sampling, so converged regions are not
+     re-derived from uniform luck;
+  3. publishes the result into the facade's proposal cache
+     (CruiseControl.publish_proposal), superseding any staler cached
+     proposal — `/proposals` always serves the freshest answer `/state`
+     reports.
+
+Topology deltas: a broker death/revival applies in place
+(LiveState.set_broker_liveness); entity churn (topics/partitions created
+or deleted) and metadata-generation bumps force a full re-flatten —
+counted by `controller.full-reflattens`, which the streaming bench gate
+asserts stays at the initial 1 across metric-only windows.
+
+Cold-parity contract: with warm starts off, the delta path off, and a
+cold prior, one controller cycle is byte-for-byte today's
+re-flatten-and-anneal (gated by `bench.py --streaming` and
+tests/test_controller.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+import numpy as np
+
+from cruise_control_tpu.controller.prior import MoveAcceptancePrior
+from cruise_control_tpu.models.whatif import LiveState
+from cruise_control_tpu.monitor import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.delta import extract_window_delta
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _ModelIndex:
+    """Host-side join index of one flatten: everything needed to map a
+    window delta's (topic, partition) entities onto replica rows without
+    touching the device."""
+
+    topology_generation: int
+    catalog: object
+    history: object  # WindowedHistory the live arrays are synced to
+    part_rows: np.ndarray  # i32[P, max_rf] replica rows per pid (R pads)
+    part_lookup: dict  # (first-seen topic_id, partition_number) -> pid
+    #: ReducedLoads of `history` — cached so the next cycle's diff does
+    #: not re-reduce the [E, W, 4] tensor it already reduced as `cur`
+    reduced: object = None
+
+    def model_generation(self):
+        """The generation the live model REFLECTS right now: the topology
+        generation it was flattened from + the aggregator generation of
+        the window snapshot its loads are synced to (both counters are
+        the same ones LoadMonitor.model_generation reports, so publish
+        freshness comparisons stay meaningful across sources).  Advances
+        with every delta cycle — a publish must never be stamped with the
+        reflatten-time generation or the first unrelated model build
+        (detector rounds) would sideline the controller permanently."""
+        from cruise_control_tpu.monitor.load_monitor import ModelGeneration
+
+        return ModelGeneration(
+            metadata_generation=self.topology_generation,
+            load_generation=int(self.history.generation),
+        )
+
+
+class StreamingController:
+    """One per cluster facade; the fleet manager's per-cluster facades
+    each own one (CruiseControl builds it when `controller.enabled`)."""
+
+    def __init__(self, cc):
+        cfg = cc.config
+        self.cc = cc
+        self.monitor = cc.monitor
+        self.optimizer = cc.optimizer
+        self.sensors = cc.sensors
+        self.tracer = cc.tracer
+        self.poll_interval_s = cfg.get("controller.poll.interval.ms") / 1000.0
+        self.warm_start = cfg.get("controller.warm.start.enabled")
+        self.delta_enabled = cfg.get("controller.delta.enabled")
+        self.prior = MoveAcceptancePrior(
+            mix=cfg.get("controller.prior.mix"),
+            decay=cfg.get("controller.prior.decay"),
+            min_observations=cfg.get("controller.prior.min.observations"),
+        )
+        # warm-start carry and the move-acceptance prior are single-device
+        # engine features; under a mesh mode the controller still runs —
+        # device-resident deltas + always-fresh publishes — but each
+        # anneal is cold (passing warm inputs would make EVERY cycle
+        # raise and the "always-on" loop would be permanently dead)
+        if self.optimizer.parallel_mode != "single":
+            if self.warm_start or self.prior.mix > 0.0:
+                log.warning(
+                    "streaming controller: warm starts and the move-"
+                    "acceptance prior are disabled under "
+                    "tpu.parallel.mode=%r (single-device features)",
+                    self.optimizer.parallel_mode,
+                )
+            self.warm_start = False
+            self.prior.mix = 0.0
+        #: prior sampling is compiled in only when a non-zero mix could
+        #: ever apply — mix 0 keeps the engine program (and its cache key)
+        #: byte-identical to the request path's
+        self._opt_config = dataclasses.replace(
+            cfg.optimizer_config(), prior_enabled=self.prior.mix > 0.0
+        )
+        self._requirements = ModelCompletenessRequirements(
+            min_required_num_windows=1,
+            min_monitored_partitions_percentage=cfg.get(
+                "min.valid.partition.ratio"
+            ),
+        )
+        self._live: LiveState | None = None
+        self._index: _ModelIndex | None = None
+        self._warm = None  # (shape, replica_broker, replica_is_leader, replica_disk)
+        self._last_window: int | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()  # one cycle at a time (thread + run_once)
+        # /state ControllerState internals (sensors carry the same counts
+        # as monotonic series; these are the structured view)
+        self._stats = dict(
+            windowRolls=0, deltaApplies=0, fullReflattens=0,
+            incrementalAnneals=0, warmStarts=0, proposalsPublished=0,
+            lastRounds=None, lastObjective=None, lastWallSeconds=None,
+            lastWindowIndex=None, lastPublishMs=None, lastError=None,
+            loopFailures=0,
+        )
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="streaming-controller"
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout_s)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.run_once()
+            except Exception as e:  # noqa: BLE001 — the loop must keep ticking
+                self._stats["loopFailures"] += 1
+                self._stats["lastError"] = repr(e)
+                self.sensors.counter("controller.loop-failures").inc()
+                log.warning("streaming controller cycle failed", exc_info=True)
+
+    # ------------------------------------------------------------- one tick
+
+    def run_once(self):
+        """One control cycle; returns a cycle-info dict when a window roll
+        was processed, None when there was nothing to do.  Public so tests
+        and the streaming bench drive the loop deterministically."""
+        with self._lock:
+            return self._run_once_locked()
+
+    def _run_once_locked(self):
+        agg = self.monitor.partition_aggregator
+        cur_w = agg.current_window_index
+        if cur_w is None:
+            return None
+        if (
+            self._last_window is not None
+            and cur_w <= self._last_window
+            and self._live is not None
+        ):
+            return None  # no window roll since the last cycle
+        try:
+            history = agg.history_snapshot()
+        except ValueError:
+            return None  # no completed window yet
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "controller.window-roll", component="controller",
+            window_index=int(cur_w),
+        ) as sp:
+            info = self._cycle(history, sp)
+        self._last_window = cur_w
+        self._stats["windowRolls"] += 1
+        self._stats["lastWindowIndex"] = int(cur_w)
+        self._stats["lastWallSeconds"] = round(time.monotonic() - t0, 6)
+        self.sensors.counter("controller.window-rolls").inc()
+        return info
+
+    def _cycle(self, history, sp) -> dict:
+        info: dict = dict(reflattened=False, delta_partitions=0)
+        topo_gen = self.monitor.metadata.topology().generation
+        idx = self._index
+        if (
+            self._live is None
+            or idx is None
+            or not self.delta_enabled
+            or topo_gen != idx.topology_generation
+        ):
+            # topology outranks delta-disabled: the reason decides whether
+            # the warm placement survives, and a membership change must
+            # clear it in EVERY mode (a stale warm start could
+            # double-place a partition)
+            if self._live is None or idx is None:
+                reason = "initial"
+            elif topo_gen != idx.topology_generation:
+                reason = "topology"
+            else:
+                reason = "delta-disabled"
+            self._reflatten(history, reason=reason)
+            info["reflattened"] = True
+            info["reflatten_reason"] = reason
+        else:
+            delta = extract_window_delta(
+                idx.history, history,
+                self.monitor.partition_aggregator.metric_def,
+                prev_reduced=idx.reduced,
+            )
+            if delta.requires_reflatten:
+                # topics/partitions appeared or vanished mid-stream: the
+                # in-place path cannot express membership churn
+                self._reflatten(history, reason="entities")
+                info["reflattened"] = True
+                info["reflatten_reason"] = "entities"
+            else:
+                info["delta_partitions"] = self._apply_delta(delta)
+                idx.history = history
+                idx.reduced = delta.reduced
+        sp.set(
+            reflattened=info["reflattened"],
+            delta_partitions=info["delta_partitions"],
+        )
+        info.update(self._anneal(sp))
+        return info
+
+    # ----------------------------------------------------- flatten / delta
+
+    def _reflatten(self, history, *, reason: str) -> None:
+        """Full model build — the slow path the delta machinery exists to
+        avoid; every occurrence is counted and reasoned."""
+        from cruise_control_tpu.analyzer.engine import partition_replica_table
+
+        # generation BEFORE the build: if a metadata refresh lands while
+        # the model builds, this stamp is older than what the build
+        # consumed and the next cycle's generation check re-flattens —
+        # the safe direction (stamping the AFTER generation could pin a
+        # pre-refresh model as current until the next topology bump)
+        topo_gen = self.monitor.metadata.topology().generation
+        with self.monitor.acquire_for_model_generation():
+            state = self.monitor.cluster_model(self._requirements)
+        catalog = self.monitor.last_catalog
+        # aggregator entities carry FIRST-SEEN topology topic ids (the
+        # sampler/partitions_fn space the monitor's own load join uses);
+        # the catalog/state ids are name-rank.  The lookup must bridge the
+        # two spaces or a cluster whose topics first appear out of name
+        # order scatters window loads onto the wrong topics' replicas.
+        lookup = {}
+        if catalog is not None:
+            parts = self.monitor.metadata.topology().partitions
+            if self.monitor.topic_filter is not None:
+                parts = tuple(
+                    p for p in parts if self.monitor.topic_filter(p.topic)
+                )
+            first_seen: dict = {}
+            for p in parts:
+                first_seen.setdefault(p.topic, len(first_seen))
+            pid_by_name = {
+                (tname, int(pnum)): pid
+                for pid, (tname, pnum) in enumerate(catalog.partitions)
+            }
+            for p in parts:
+                pid = pid_by_name.get((p.topic, int(p.partition)))
+                if pid is not None:
+                    lookup[(first_seen[p.topic], int(p.partition))] = pid
+        self._live = LiveState(state)
+        self._index = _ModelIndex(
+            topology_generation=topo_gen,
+            catalog=catalog,
+            history=history,
+            part_rows=partition_replica_table(state),
+            part_lookup=lookup,
+        )
+        if self._warm is not None and self._warm[0] != state.shape:
+            self._warm = None  # bucket changed: the placement axes moved
+        if reason in ("topology", "entities"):
+            # membership may have changed under the old placement — a
+            # stale warm start could double-place a partition
+            self._warm = None
+        self._stats["fullReflattens"] += 1
+        self.sensors.counter("controller.full-reflattens").inc()
+        self.sensors.counter(f"controller.reflatten.{reason}").inc()
+
+    def _apply_delta(self, delta) -> int:
+        """Scatter one window's changed partition loads into the live
+        arrays; returns how many partitions were touched."""
+        idx = self._index
+        changed = delta.changed
+        if not changed.any():
+            self._stats["deltaApplies"] += 1
+            self.sensors.counter("controller.delta-applies").inc()
+            return 0
+        ents = [e for e, c in zip(delta.entities, changed) if c]
+        ll = delta.loads[changed]
+        pids = []
+        keep = []
+        for i, e in enumerate(ents):
+            pid = idx.part_lookup.get((int(e.topic), int(e.partition)))
+            if pid is not None:
+                pids.append(pid)
+                keep.append(i)
+        if not pids:
+            self._stats["deltaApplies"] += 1
+            self.sensors.counter("controller.delta-applies").inc()
+            return 0
+        ll = ll[keep]
+        fl = self.monitor.follower_loads(ll)
+        rows_p = idx.part_rows[np.asarray(pids)]  # [n, max_rf], R pads
+        R = self._live.shape.R
+        valid = rows_p < R
+        counts = valid.sum(1)
+        rows = rows_p[valid].astype(np.int32)
+        ll_rows = np.repeat(ll, counts, axis=0)
+        fl_rows = np.repeat(fl, counts, axis=0)
+        self._live.set_partition_loads(rows, ll_rows, fl_rows)
+        self._stats["deltaApplies"] += 1
+        self.sensors.counter("controller.delta-applies").inc()
+        self.sensors.counter("controller.delta-partitions").inc(len(pids))
+        return len(pids)
+
+    # -------------------------------------------------------------- anneal
+
+    def _anneal(self, sp) -> dict:
+        state = self._live.state
+        catalog = self._index.catalog
+        warm = None
+        if self.warm_start and self._warm is not None and self._warm[0] == state.shape:
+            warm = self._warm[1:]
+        prior_table = (
+            self.prior.table(catalog, state.shape)
+            if self._opt_config.prior_enabled
+            else None
+        )
+        options = self.cc._build_options(state)
+        with self.sensors.timer("controller.anneal-timer").time():
+            result = self.optimizer.optimize(
+                state,
+                options=options,
+                config=self._opt_config,
+                initial_placement=warm,
+                prior=prior_table,
+            )
+        rounds = sum(1 for h in result.history if not h.get("timing"))
+        after = result.state_after
+        self._warm = (
+            state.shape, after.replica_broker, after.replica_is_leader,
+            after.replica_disk,
+        )
+        observed = self.prior.observe_proposals(result.proposals, catalog)
+        published = self.cc.publish_proposal(
+            result, generation=self._index.model_generation()
+        )
+        self._stats["incrementalAnneals"] += 1
+        self._stats["lastRounds"] = rounds
+        self._stats["lastObjective"] = result.objective_after
+        if warm is not None:
+            self._stats["warmStarts"] += 1
+            self.sensors.counter("controller.warm-starts").inc()
+        if published:
+            self._stats["proposalsPublished"] += 1
+            self._stats["lastPublishMs"] = int(time.time() * 1000)
+            self.sensors.counter("controller.proposals-published").inc()
+        self.sensors.counter("controller.incremental-anneals").inc()
+        self.sensors.gauge("controller.rounds-last").set(rounds)
+        self.sensors.gauge("controller.prior-observations").set(
+            self.prior.observations
+        )
+        sp.set(
+            rounds=rounds,
+            warm_start=warm is not None,
+            prior_mix=(prior_table.mix if prior_table is not None else 0.0),
+            published=published,
+            objective_after=result.objective_after,
+        )
+        return dict(
+            rounds=rounds,
+            warm_start=warm is not None,
+            objective=result.objective_after,
+            prior_observed=observed,
+            published=published,
+            result=result,
+        )
+
+    # ---------------------------------------------------- executor feedback
+
+    def observe_executed(self, proposals) -> None:
+        """Executed proposals are the strongest acceptance signal the
+        prior gets (facade._execute feeds every execution through here)."""
+        idx = self._index
+        catalog = idx.catalog if idx is not None else self.monitor.last_catalog
+        if catalog is None:
+            return
+        self.prior.observe_executed(proposals, catalog)
+        self.sensors.gauge("controller.prior-observations").set(
+            self.prior.observations
+        )
+
+    # ---------------------------------------------------------------- state
+
+    def state_json(self) -> dict:
+        out = dict(self._stats)
+        out["running"] = self.running
+        out["warmStartEnabled"] = self.warm_start
+        out["deltaEnabled"] = self.delta_enabled
+        out["prior"] = self.prior.state_json()
+        return out

@@ -74,6 +74,13 @@ class ClusterContext:
             "modelGeneration": str(cc.monitor.model_generation()),
             "selfHealingBusy": cc.actions.is_busy,
         }
+        if cc.controller is not None:
+            # per-cluster streaming controller (each facade builds its own
+            # from its cluster config; the fleet start_up fans them out)
+            out["controller"] = {
+                "running": cc.controller.running,
+                "windowRolls": cc.controller.state_json()["windowRolls"],
+            }
         recovery = cc.executor.recovery_info()
         if recovery is not None:
             out["recovered"] = True

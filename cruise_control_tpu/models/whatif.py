@@ -274,6 +274,157 @@ class HostState:
         fl[rows] = np.maximum(fl[rows] + fd, 0.0)
 
 
+# ----------------------------------------------------------------------
+# live-state primitives (streaming controller)
+# ----------------------------------------------------------------------
+
+
+def _round_up_pow2(n: int, floor: int = 64) -> int:
+    n = max(int(n), 1)
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class LiveState:
+    """Device-resident flattened ClusterState + IN-PLACE delta primitives.
+
+    Where HostState serves the planner's hypothetical futures (host copy,
+    mutate, re-materialize a scenario state), LiveState is the streaming
+    controller's (controller/streaming.py) view of the REAL cluster: the
+    padded arrays stay on device across metric windows and each window
+    roll scatters only the changed cells into them — donated buffers, the
+    same trick as the fused anneal, so no full model re-flatten happens
+    while the shape bucket holds.
+
+    Ownership contract: each update DONATES exactly the arrays it
+    rewrites (never the whole pytree — XLA's buffer reuse across a
+    donated set may re-book a pass-through buffer for a different
+    same-shape output, scribbling arrays other references still read).
+    Donation still invalidates the previous Array objects of the
+    rewritten leaves, so the controller is the state's sole owner —
+    anything it published earlier (an OptimizerResult's state_before
+    rides these arrays) must be consumed through host-side fields
+    (summary, proposals) only.  The facade honors this by parking its
+    bucket-prewarm path while the controller runs.
+
+    Scatter index vectors are padded to power-of-two buckets with the
+    out-of-range sentinel (dropped by the scatter), so successive windows
+    of different delta sizes reuse one compiled program.
+    """
+
+    def __init__(self, state: ClusterState):
+        self.state = state
+
+    @property
+    def shape(self) -> ClusterShape:
+        return self.state.shape
+
+    def set_partition_loads(
+        self, rows: np.ndarray, leader_loads: np.ndarray,
+        follower_loads: np.ndarray,
+    ) -> int:
+        """Scatter new ABSOLUTE per-replica loads (leader + follower
+        variants) into the live arrays; rows are replica indices.  Returns
+        the padded scatter width (observability: the compiled-program
+        bucket this window landed in)."""
+        import jax.numpy as jnp
+
+        R = self.state.shape.R
+        n = int(len(rows))
+        width = _round_up_pow2(max(n, 1))
+        pad = width - n
+        rows = np.concatenate([np.asarray(rows, np.int32), np.full(pad, R, np.int32)])
+        ll = np.concatenate(
+            [np.asarray(leader_loads, np.float32),
+             np.zeros((pad, NUM_RESOURCES), np.float32)]
+        )
+        fl = np.concatenate(
+            [np.asarray(follower_loads, np.float32),
+             np.zeros((pad, NUM_RESOURCES), np.float32)]
+        )
+        st = self.state
+        new_ll, new_fl = _scatter_partition_loads(
+            st.replica_load_leader, st.replica_load_follower,
+            jnp.asarray(rows), jnp.asarray(ll), jnp.asarray(fl),
+        )
+        import dataclasses as _dc
+
+        self.state = _dc.replace(
+            st, replica_load_leader=new_ll, replica_load_follower=new_fl
+        )
+        return width
+
+    def set_broker_liveness(self, alive: np.ndarray) -> None:
+        """Replace the broker_alive vector in place and re-derive
+        replica_offline from it (a broker death/revival between windows is
+        a topology delta that needs no re-flatten)."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        st = self.state
+        alive = jnp.asarray(alive, bool)
+        off = _with_broker_alive(
+            st.replica_broker, st.replica_disk, st.replica_offline,
+            st.replica_valid, st.disk_alive, alive,
+        )
+        self.state = _dc.replace(st, broker_alive=alive, replica_offline=off)
+
+
+def _make_scatter_partition_loads():
+    """Donate ONLY the two arrays being rewritten.  Donating the whole
+    state pytree is tempting but wrong: the untouched leaves would pass
+    through as donated identity outputs, and XLA's buffer reuse across a
+    donated set can re-book a pass-through buffer for a different
+    same-shape output — scribbling placement arrays other live references
+    (the published result, the warm-start placement) still read."""
+    from functools import partial as _partial
+
+    import jax
+
+    @_partial(jax.jit, donate_argnums=(0, 1))
+    def fn(ll, fl, rows, new_ll, new_fl):
+        drop = dict(mode="drop")
+        return ll.at[rows].set(new_ll, **drop), fl.at[rows].set(new_fl, **drop)
+
+    return fn
+
+
+def _make_with_broker_alive():
+    """replica_offline is rewritten (donated); broker_alive is replaced
+    by the new vector outright, everything else is untouched."""
+    from functools import partial as _partial
+
+    import jax
+
+    @_partial(jax.jit, donate_argnums=(2,))
+    def fn(rb, rd, offline, valid, disk_alive, alive):
+        off = valid & ~(alive[rb] & disk_alive[rb, rd])
+        return off
+
+    return fn
+
+
+class _Lazy:
+    """Deferred jitted-program construction: importing this module must
+    not touch jax (the planner imports it host-side only)."""
+
+    def __init__(self, make):
+        self._make = make
+        self._fn = None
+
+    def __call__(self, *args):
+        if self._fn is None:
+            self._fn = self._make()
+        return self._fn(*args)
+
+
+_scatter_partition_loads = _Lazy(_make_scatter_partition_loads)
+_with_broker_alive = _Lazy(_make_with_broker_alive)
+
+
 def default_capacity_profile(h: HostState) -> np.ndarray:
     """Capacity for an added broker with no explicit profile: the
     per-resource MEDIAN over live brokers — the honest 'another one like

@@ -276,25 +276,16 @@ class LoadMonitor:
         AVG-strategy resources average over valid windows; DISK (LATEST)
         takes the newest valid window (reference model/Load.expectedUtilizationFor,
         model/Load.java:84-118 — AVG vs LATEST per KafkaMetricDef strategy).
+        The reduction itself is monitor/delta.py's `reduce_windowed_loads`
+        — ONE implementation for the model build and the streaming
+        controller's delta path, so the two cannot drift.
         """
+        from cruise_control_tpu.monitor.delta import reduce_windowed_loads
+
         # slice the 4 consumed metric columns FIRST: the reduction then
         # runs on [E, W, 4] instead of the full [E, W, M] tensor
         cols = [self._cpu_id, self._nwin_id, self._nwout_id, self._disk_id]
-        values = agg.values[:, :, cols]  # [E, W, 4]
-        valid = agg.window_valid  # [E, W]
-        n_valid = np.maximum(valid.sum(1), 1)  # [E]
-
-        mean = (values * valid[..., None]).sum(1) / n_valid[:, None]  # [E, 4]
-        # newest valid window per entity (window axis is newest -> oldest)
-        first_valid = np.argmax(valid, axis=1)  # [E]
-        latest = values[np.arange(values.shape[0]), first_valid]  # [E, 4]
-
-        load = np.empty((values.shape[0], NUM_RESOURCES), np.float32)
-        load[:, Resource.CPU] = mean[:, 0]
-        load[:, Resource.NW_IN] = mean[:, 1]
-        load[:, Resource.NW_OUT] = mean[:, 2]
-        load[:, Resource.DISK] = latest[:, 3]
-        return load
+        return reduce_windowed_loads(agg.values[:, :, cols], agg.window_valid)
 
     def _build_state(
         self,
@@ -336,14 +327,6 @@ class LoadMonitor:
                 )
             )
 
-        leader_cpu = loads[:, Resource.CPU]
-        if self.regression is not None and self.regression.trained:
-            follower_cpu = self.regression.follower_cpu_array(loads)
-        else:
-            follower_cpu = follower_cpu_util_array(
-                loads, leader_cpu, weights=self.cpu_weights
-            )
-
         # columnar join: topology partitions -> aggregator entity rows.
         # Unmonitored partitions get zero load (reference populates only
         # monitored partitions; include_all_topics keeps them in the model).
@@ -363,11 +346,8 @@ class LoadMonitor:
         if np.any(monitored):
             m_rows = row_of_part[monitored]
             ll = loads[m_rows]
-            fl = ll.copy()
-            fl[:, Resource.NW_OUT] = 0.0
-            fl[:, Resource.CPU] = follower_cpu[m_rows]
             leader_load[monitored] = ll
-            follower_load[monitored] = fl
+            follower_load[monitored] = self.follower_loads(ll)
 
         from cruise_control_tpu.models.builder import build_state_columnar
 
@@ -381,6 +361,24 @@ class LoadMonitor:
         )
         self.last_catalog = catalog
         return state
+
+    def follower_loads(self, loads: np.ndarray) -> np.ndarray:
+        """[N, 4] follower twin of per-partition leader loads: NW_OUT
+        zeroed, CPU the follower share (the trained regression when
+        available, else the static coefficients) — ONE function for the
+        model build and the streaming controller's in-place delta path,
+        so the two can never disagree on follower semantics."""
+        loads = np.asarray(loads, np.float32)
+        if self.regression is not None and self.regression.trained:
+            follower_cpu = self.regression.follower_cpu_array(loads)
+        else:
+            follower_cpu = follower_cpu_util_array(
+                loads, loads[:, Resource.CPU], weights=self.cpu_weights
+            )
+        fl = loads.copy()
+        fl[:, Resource.NW_OUT] = 0.0
+        fl[:, Resource.CPU] = follower_cpu
+        return fl
 
     # ------------------------------------------------------------------
 

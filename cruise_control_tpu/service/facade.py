@@ -66,6 +66,10 @@ class _CachedResult:
     #: not make cached proposals immortal (or instantly stale)
     computed_mono: float
     model_generation: object
+    #: who filled this slot: "optimizer" (request/precompute path) or
+    #: "controller" (streaming controller publish) — surfaced in /state
+    #: so an operator can tell which pipeline the served proposal rode
+    source: str = "optimizer"
 
 
 class AnalyzerCore:
@@ -332,6 +336,22 @@ class CruiseControl:
         self._stop_precompute = threading.Event()
         #: LoadMonitorTaskRunner attached by build_service (bootstrap/train)
         self.task_runner = None
+        #: streaming controller (controller/streaming.py, config
+        #: controller.*): the always-on incremental rebalancing loop.
+        #: While it runs it REPLACES the legacy proposal-precompute loop
+        #: (it publishes a fresh proposal every window roll) and the
+        #: bucket-prewarm path stands down (the controller's donated
+        #: in-place updates invalidate published state arrays, which
+        #: prewarm would otherwise re-pad).  In a fleet, every cluster
+        #: facade builds its own instance from its cluster config.
+        self.controller = None
+        if config.get("controller.enabled"):
+            from cruise_control_tpu.controller.streaming import (
+                StreamingController,
+            )
+
+            self.controller = StreamingController(self)
+        self._compile_cache_reported = False
 
     def _detect_optimizer_degraded(self):
         """OPTIMIZER_DEGRADED anomaly, once per breaker-open episode.
@@ -541,7 +561,12 @@ class CruiseControl:
                 daemon=True,
                 name="executor-recovery",
             ).start()
-        if precompute:
+        if self.controller is not None:
+            # the streaming controller IS the always-on precompute: it
+            # publishes a fresh proposal every window roll, so the legacy
+            # timer loop would only burn duplicate anneals beside it
+            self.controller.start()
+        elif precompute:
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, daemon=True, name="proposal-precompute"
             )
@@ -549,6 +574,8 @@ class CruiseControl:
 
     def shutdown(self):
         self._stop_precompute.set()
+        if self.controller is not None:
+            self.controller.stop()
         self.anomaly_detector.shutdown()
 
     def _precompute_loop(self):
@@ -570,6 +597,7 @@ class CruiseControl:
                 )
                 consecutive = 0
                 streak_gauge.set(0)
+                self._log_compile_cache_report()
             except Exception:  # noqa: BLE001 — the loop must keep ticking,
                 # but a permanently broken precompute must be VISIBLE:
                 # every failure counts, and three in a row start WARN
@@ -592,6 +620,24 @@ class CruiseControl:
             if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
                 return
 
+    def _log_compile_cache_report(self):
+        """After the first proposal pass: how many XLA executables loaded
+        warm from the persistent compile cache (hits) vs compiled fresh
+        (misses) — the observable half of tpu.compile.cache.dir."""
+        if self._compile_cache_reported:
+            return
+        from cruise_control_tpu.common.compilation_cache import boot_report
+
+        report = boot_report()
+        self._compile_cache_reported = True
+        if report is not None:
+            log.info(
+                "persistent compile cache after first proposal pass: "
+                "%d executables compiled fresh (misses), %d were available "
+                "warm at boot (%s)",
+                report["newCompiles"], report["entriesAtBoot"], report["dir"],
+            )
+
     def _prewarm_next_bucket(self):
         """Background-compile the engine for the NEXT shape bucket up.
 
@@ -603,6 +649,11 @@ class CruiseControl:
         `Engine` programs never depend on the padding data, only the shape.
         """
         if not self.bucket_policy.enabled or self.optimizer.parallel_mode != "single":
+            return
+        if self.controller is not None and self.controller.running:
+            # the controller's donated in-place updates invalidate the
+            # cached result's state_before buffers — padding them here
+            # would read deleted arrays (LiveState ownership contract)
             return
         with self._cache_lock:
             cached = self._cache
@@ -720,6 +771,46 @@ class CruiseControl:
                 )
         return result
 
+    def publish_proposal(
+        self,
+        result: OptimizerResult,
+        *,
+        source: str = "controller",
+        generation=None,
+    ) -> bool:
+        """Publish a freshly computed result into the proposal cache —
+        the streaming controller's output path.  `generation` is the
+        model generation the result was COMPUTED FROM (the controller
+        captures it when it syncs its live model); omitting it falls
+        back to a publish-time read, which can overstate freshness when
+        a window rolls mid-anneal.  The freshest generation wins: a
+        publish STRICTLY older than the cached result is dropped
+        (False); same-or-newer SUPERSEDES the cached proposal — a fresher
+        anneal of the same generation replaces it, so `/proposals` can
+        never serve a staler result than `/state`'s ControllerState
+        reports."""
+        gen = generation if generation is not None else self.monitor.model_generation()
+        new_key = (gen.metadata_generation, gen.load_generation)
+        with self._cache_lock:
+            c = self._cache
+            if c is not None and c.model_generation is not None:
+                old = c.model_generation
+                old_key = (old.metadata_generation, old.load_generation)
+                if old_key > new_key:
+                    return False  # cached proposal is already fresher
+            self._cache = _CachedResult(
+                result,
+                int(time.time() * 1000),
+                time.monotonic(),
+                gen,
+                source=source,
+            )
+        # the controller replaces the precompute loop, so the first
+        # published anneal is this deployment's "first proposal pass" —
+        # report the persistent compile cache's hit/miss split here too
+        self._log_compile_cache_report()
+        return True
+
     def _valid_cache(self) -> OptimizerResult | None:
         with self._cache_lock:
             c = self._cache
@@ -728,7 +819,20 @@ class CruiseControl:
             expired = (
                 time.monotonic() - c.computed_mono
             ) * 1000.0 > self._proposal_expiration_ms
-            stale = c.model_generation != self.monitor.model_generation()
+            if c.source == "controller":
+                # controller results refresh every window roll and are
+                # stamped with the generation their live model REFLECTS;
+                # an unrelated model build (detector round, cache-miss
+                # request) bumping the monitor's load generation must not
+                # sideline them — only a TOPOLOGY change (or expiry)
+                # invalidates, and the controller re-flattens and
+                # republishes on exactly that signal
+                stale = (
+                    c.model_generation.metadata_generation
+                    != self.monitor.metadata.topology().generation
+                )
+            else:
+                stale = c.model_generation != self.monitor.model_generation()
             if expired or stale:
                 self._cache = None
                 return None
@@ -773,6 +877,14 @@ class CruiseControl:
             removed_brokers=removed, demoted_brokers=demoted,
             strategy=strategy,
         )
+        if self.controller is not None:
+            # executed proposals are the strongest signal the learned
+            # move-acceptance prior gets (controller/prior.py)
+            try:
+                self.controller.observe_executed(proposals)
+            except Exception:  # noqa: BLE001 — prior fitting is best-effort
+                log.warning("controller prior execution feedback failed",
+                            exc_info=True)
         self.invalidate_proposal_cache()
         return {
             "completed": out.completed,
@@ -1303,7 +1415,8 @@ class CruiseControl:
             s.lower()
             for s in (
                 substates
-                or ["monitor", "executor", "analyzer", "anomaly_detector", "sensors"]
+                or ["monitor", "executor", "analyzer", "controller",
+                    "anomaly_detector", "sensors"]
             )
         ]
         out: dict = {"version": 1}
@@ -1328,6 +1441,9 @@ class CruiseControl:
                 "isProposalReady": cache is not None,
                 "readyGoals": self.chain.names() if cache is not None else [],
                 "goalReadiness": self.chain.names(),
+                # which pipeline filled the cached proposal: "optimizer"
+                # (request/precompute) or "controller" (streaming publish)
+                "proposalSource": cache.source if cache is not None else None,
                 # degraded-serving surface (supervised optimizer runtime):
                 # degraded=true means proposals are currently CPU-greedy
                 # because the device breaker is not closed
@@ -1339,6 +1455,8 @@ class CruiseControl:
             }
             if self.supervisor is not None:
                 out["AnalyzerState"]["supervisor"] = self.supervisor.state_json()
+        if "controller" in substates and self.controller is not None:
+            out["ControllerState"] = self.controller.state_json()
         if "anomaly_detector" in substates:
             out["AnomalyDetectorState"] = self.anomaly_detector.detector_state()
         return out

@@ -180,7 +180,7 @@ def build_service(
     from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
     from cruise_control_tpu.common.sensors import SensorRegistry
 
-    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
+    enable_persistent_cache(config.compile_cache_dir())
     # ONE registry shared by the fetcher and the facade stack — the monitor
     # health gauges must surface in /state?substates=sensors
     sensors = SensorRegistry()
@@ -221,7 +221,7 @@ def build_fleet_service(
     missing = [cid for cid in ids if cid not in backends]
     if missing:
         raise ValueError(f"no backend supplied for fleet clusters {missing}")
-    enable_persistent_cache(config.get("tpu.compilation.cache.dir"))
+    enable_persistent_cache(config.compile_cache_dir())
     shared_sensors = SensorRegistry()
     core = AnalyzerCore(config, sensors=shared_sensors)
     contexts: dict[str, ClusterContext] = {}

@@ -111,6 +111,9 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("MonitorState", DICT, required=False),
         Field("ExecutorState", DICT, required=False),
         Field("AnalyzerState", DICT, required=False),
+        # streaming-controller block (controller/streaming.py), present
+        # only when controller.enabled
+        Field("ControllerState", DICT, required=False),
         Field("AnomalyDetectorState", DICT, required=False),
         Field("Sensors", DICT, required=False),
     )),

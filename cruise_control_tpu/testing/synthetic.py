@@ -118,6 +118,16 @@ class SyntheticWorkloadSampler:
             broker_samples.append(MetricSample(BrokerEntity(b), t, vals))
         return SamplingResult(samples, broker_samples)
 
+    def drift(self, factor: float, topic: str | None = None) -> None:
+        """Scale the per-partition base rates in place — a deterministic
+        load trend for streaming-controller tests and `bench.py
+        --streaming` (real clusters drift between metric windows; the
+        static base would make every window's delta zero)."""
+        tid = None if topic is None else self._topic_ids.get(topic)
+        for (t, _p), base in self._base.items():
+            if tid is None or t == tid:
+                base *= factor
+
     def all_partition_entities(self) -> list[PartitionEntity]:
         return [
             PartitionEntity(self._topic_ids[p.topic], p.partition)

@@ -56,6 +56,23 @@ echo "== check.sh: bench.py --scenarios --smoke (batched what-if evaluation, CPU
 GRAFT_FORCE_CPU=1 python bench.py --scenarios --smoke
 scenarios_rc=$?
 
+echo "== check.sh: bench.py --streaming --smoke (incremental controller replay, CPU) =="
+# named gate: a multi-window streaming replay must show (a) the COLD
+# controller cycle reproduces today's flatten-and-anneal byte-for-byte,
+# (b) warm-started incremental anneals converge in measurably fewer
+# rounds at equal goal quality, and (c) zero full re-flattens across
+# metric-only windows (the in-place delta contract, asserted via sensors)
+GRAFT_FORCE_CPU=1 python bench.py --streaming --smoke
+streaming_rc=$?
+
+echo "== check.sh: streaming controller gate (prior parity, warm start, delta path) =="
+# named gate: cold-prior byte parity, warm-start carry (fused==legacy,
+# no donated-buffer corruption), move-acceptance prior fitting/decay,
+# WindowedHistory delta extraction under topic churn + partial windows,
+# LiveState in-place updates, publish/supersede
+python -m pytest tests/test_controller.py -q
+controller_rc=$?
+
 echo "== check.sh: bench.py --fleet-smoke (shared-engine fleet economics, CPU) =="
 # named gate: a 3-cluster fleet (2 sharing a shape bucket) must end with
 # FEWER compiled engines than clusters (the shared AnalyzerCore is real)
@@ -142,5 +159,5 @@ python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
