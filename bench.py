@@ -27,6 +27,11 @@ BENCH_SCALE=north_star|mid|small retained for the headline fixture size.
 N generations with partition creates (+ a broker add) served bucketed vs
 exact, gating on "churned generations compile zero engines" (see churn()).
 
+`bench.py --coldstart [--smoke]` runs the restart-SLO ladder instead: a
+child process per phase (truly cold / XLA-cache-only / manifest+AOT)
+measures cold-start-to-first-proposal and gates the manifest+AOT phase
+on zero fresh traces for manifest buckets (see coldstart()).
+
 warmup_s on the headline is the FIRST optimize() call in a fresh process
 with a warm persistent XLA cache: engine statics build + program
 trace/lower + cache-hit compile + one full proposal computation.  It is
@@ -1267,7 +1272,224 @@ def streaming(smoke_mode: bool) -> int:
     return 0 if ok else 1
 
 
+def _coldstart_child() -> int:
+    """`bench.py --coldstart-child` (internal): ONE restart phase in a
+    truly fresh process.  Builds the simulated service against the
+    parent's cache/manifest directories, runs start_up (the boot-prewarm
+    path under test), serves one proposal, and emits the honest
+    cold-start-to-first-proposal wall + the compile-cache boot report
+    (fresh-trace vs AOT-load counts per bucket)."""
+    t0 = time.monotonic()
+    import jax
+
+    if os.environ.get("COLDSTART_SMOKE"):
+        jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.common import compilation_cache
+    from cruise_control_tpu.config.app_config import CruiseControlConfig
+    from cruise_control_tpu.service.main import build_simulated_service
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    phase = os.environ["COLDSTART_PHASE"]
+    smoke = bool(os.environ.get("COLDSTART_SMOKE"))
+    props = {
+        "partition.metrics.window.ms": 1000,
+        "min.samples.per.partition.metrics.window": 1,
+        "num.partition.metrics.windows": 3,
+        "webserver.http.port": 0,
+        "tpu.compile.cache.dir": os.environ["COLDSTART_CACHE_DIR"],
+        "tpu.prewarm.manifest.dir": os.environ["COLDSTART_MANIFEST_DIR"],
+        # the xla-cache-only phase is PR 9's slice: persistent compile
+        # cache on, no manifest, no AOT — tracing is paid again
+        "tpu.prewarm.enabled": phase != "xla-cache",
+        "anomaly.detection.interval.ms": 3_600_000,
+    }
+    if smoke:
+        props.update({
+            # candidates >= engine.AOT_MIN_CANDIDATES: the smoke engine
+            # must be AOT-worthy or phase 1 writes no artifact to gate on
+            "tpu.num.candidates": 1024, "tpu.leadership.candidates": 128,
+            "tpu.swap.candidates": 64, "tpu.steps.per.round": 16,
+            "tpu.num.rounds": 3,
+        })
+        geometry = dict(num_brokers=6, topics={"T0": 12, "T1": 12})
+    else:
+        props.update({
+            "tpu.num.candidates": 2048, "tpu.leadership.candidates": 512,
+            "tpu.steps.per.round": 64, "tpu.num.rounds": 6,
+        })
+        geometry = dict(num_brokers=24, topics={"T0": 96, "T1": 96, "T2": 48})
+    app, fetcher, admin, sampler = build_simulated_service(
+        CruiseControlConfig(props), seed=3, **geometry
+    )
+    cc = app.cc
+    cc.start_up(detection_interval_s=3600)
+    # deterministic gate: wait for the manifest replay to ENQUEUE its
+    # engines (compiles continue on the warm pool; the request below
+    # waits per-program exactly like any warm start)
+    cc._boot_prewarm_done.wait(timeout=300)
+    prewarm_wait_s = time.monotonic() - t0
+    res = cc.proposals(OperationProgress(), ignore_cache=True)
+    wall = time.monotonic() - t0
+    report = compilation_cache.boot_report() or {}
+    store = cc.core.prewarm_store
+    manifest_buckets = []
+    if store is not None:
+        # flush background AOT exports so the NEXT phase finds artifacts
+        # (after the measurement — exports are never on the serving path)
+        store.drain(300)
+        manifest_buckets = sorted(set(store.manifest_bucket_keys()))
+    from cruise_control_tpu.analyzer.prewarm import bucket_key
+
+    _emit(
+        metric="coldstart_phase",
+        phase=phase,
+        value=round(wall, 3),
+        unit="s",
+        cold_start_to_first_proposal_s=round(wall, 3),
+        boot_prewarm_wait_s=round(prewarm_wait_s, 3),
+        served_bucket=bucket_key(res.state_before.shape),
+        manifest_buckets=manifest_buckets,
+        engine_traces=report.get("engineTraces", {}),
+        xla_entries_at_boot=report.get("entriesAtBoot"),
+        xla_new_compiles=report.get("newCompiles"),
+        objective_after=res.objective_after,
+        num_proposals=len(res.proposals),
+        prewarmed_buckets=int(
+            cc.sensors.snapshot()
+            .get("analyzer.boot-prewarm-buckets", {})
+            .get("count", 0)
+        ),
+    )
+    cc.shutdown()
+    return 0
+
+
+def coldstart(smoke_mode: bool) -> int:
+    """`bench.py --coldstart [--smoke]`: the restart SLO gate.
+
+    Spawns a CHILD PROCESS per phase against one shared on-disk
+    cache/manifest directory — process boundaries are the only honest way
+    to measure cold starts (jit caches, tracing, and module imports are
+    all per-process):
+
+      1. cold         — empty disk: full trace + XLA compile bill
+                        (manifest + AOT artifacts are WRITTEN here, off
+                        the serving path);
+      2. xla-cache    — PR 9's slice: compile skipped, tracing paid,
+                        nothing prewarmed until the request asks;
+      3. manifest-aot — this PR: boot prewarm replays the manifest and
+                        deserializes the fused program, so the request
+                        hits a compiling-or-compiled engine with ZERO
+                        fresh traces for manifest buckets.
+
+    Gates (--smoke, wired into scripts/check.sh): the manifest-aot phase
+    reports zero fresh traces for every manifest-listed bucket, its
+    cold-start-to-first-proposal wall is strictly below the truly-cold
+    phase, and all three phases produce the identical objective (the AOT
+    path must not change results).  Headline mode reports the three walls
+    for BENCHLOG.md without the CPU-noise-sensitive wall gate.
+    """
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="cc-coldstart-")
+    cache_dir = os.path.join(tmp, "xla")
+    manifest_dir = os.path.join(tmp, "prewarm")
+    phases = ("cold", "xla-cache", "manifest-aot")
+    out: dict[str, dict] = {}
+    try:
+        for phase in phases:
+            env = dict(os.environ)
+            env.update(
+                COLDSTART_PHASE=phase,
+                COLDSTART_CACHE_DIR=cache_dir,
+                COLDSTART_MANIFEST_DIR=manifest_dir,
+            )
+            if smoke_mode:
+                env.update(COLDSTART_SMOKE="1", GRAFT_FORCE_CPU="1",
+                           JAX_PLATFORMS="cpu")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--coldstart-child"],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            line = next(
+                (ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")),
+                None,
+            )
+            if proc.returncode != 0 or line is None:
+                print(f"coldstart phase {phase} failed (rc={proc.returncode}):\n"
+                      f"{proc.stderr[-4000:]}", file=sys.stderr)
+                _emit(metric="coldstart_to_first_proposal", value=-1.0,
+                      unit="s", vs_baseline=-1.0, failed_phase=phase, ok=False)
+                return 1
+            out[phase] = json.loads(line)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    aot = out["manifest-aot"]
+    cold = out["cold"]
+    # zero fresh traces for every manifest-listed bucket on the AOT phase
+    traces = aot["engine_traces"]
+    fresh_by_bucket = {
+        b: traces.get(b, {}).get("fresh", 0) for b in aot["manifest_buckets"]
+    }
+    traces_ok = bool(aot["manifest_buckets"]) and all(
+        v == 0 for v in fresh_by_bucket.values()
+    )
+    aot_loads = sum(
+        traces.get(b, {}).get("aot", 0) for b in aot["manifest_buckets"]
+    )
+    wall_ok = aot["cold_start_to_first_proposal_s"] < cold[
+        "cold_start_to_first_proposal_s"
+    ]
+    # vs the xla-cache phase: reported, not gated — the acceptance gate
+    # is vs truly-cold (at smoke scale the two warm phases sit within
+    # CPU-scheduler noise of each other; the trace-skip proof is the
+    # zero-fresh-traces count, which cannot be noise)
+    wall_below_xla = aot["cold_start_to_first_proposal_s"] < out["xla-cache"][
+        "cold_start_to_first_proposal_s"
+    ]
+    obj_ok = (
+        out["cold"]["objective_after"]
+        == out["xla-cache"]["objective_after"]
+        == aot["objective_after"]
+    )
+    prewarm_ok = aot["prewarmed_buckets"] >= 1
+    ok = traces_ok and obj_ok and prewarm_ok and (wall_ok or not smoke_mode)
+    _emit(
+        metric="coldstart_to_first_proposal",
+        value=aot["cold_start_to_first_proposal_s"],
+        unit="s",
+        vs_baseline=round(
+            aot["cold_start_to_first_proposal_s"]
+            / max(cold["cold_start_to_first_proposal_s"], 1e-9),
+            4,
+        ),
+        cold_start_to_first_proposal_s={
+            p: out[p]["cold_start_to_first_proposal_s"] for p in phases
+        },
+        xla_new_compiles={p: out[p]["xla_new_compiles"] for p in phases},
+        manifest_buckets=aot["manifest_buckets"],
+        fresh_traces_manifest_buckets=fresh_by_bucket,
+        aot_loads_manifest_buckets=aot_loads,
+        prewarmed_buckets=aot["prewarmed_buckets"],
+        zero_fresh_traces=traces_ok,
+        wall_below_cold=wall_ok,
+        wall_below_xla_cache=wall_below_xla,
+        objective_parity=obj_ok,
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 def main():
+    if "--coldstart-child" in sys.argv:
+        sys.exit(_coldstart_child())
+    if "--coldstart" in sys.argv:
+        sys.exit(coldstart("--smoke" in sys.argv))
     if "--streaming" in sys.argv:
         sys.exit(streaming("--smoke" in sys.argv))
     if "--fleet-smoke" in sys.argv:

@@ -149,6 +149,7 @@ class GoalOptimizer:
         degraded_budget_s: float = 30.0,
         tracer=None,
         profiler_dir: str | None = None,
+        prewarm_store=None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (candidate axis sharded over the mesh,
@@ -191,7 +192,16 @@ class GoalOptimizer:
 
         profiler_dir (config tpu.profiler.*): when set, every engine run
         is wrapped in a jax.profiler trace dumped there — the XLA-level
-        view for slow-run forensics.  None (default) profiles nothing."""
+        view for slow-run forensics.  None (default) profiles nothing.
+
+        prewarm_store (config tpu.prewarm.*, analyzer/prewarm.py): the
+        durable boot-prewarm manifest + AOT artifact store.  When bound,
+        every engine build/rebind records its (bucket, config) working
+        set, single-device engines try/save AOT-serialized fused
+        programs through their warm pool, and `start_up()` replays the
+        manifest so a restart's active buckets compile before the first
+        proposal.  None (offline/test/ad-hoc optimizers) records and
+        loads nothing."""
         import threading
 
         import jax
@@ -241,6 +251,7 @@ class GoalOptimizer:
         self.sensors = sensors
         self.supervisor = supervisor
         self.degraded_budget_s = degraded_budget_s
+        self.prewarm_store = prewarm_store
         from cruise_control_tpu.common.trace import TRACER
 
         self.tracer = tracer if tracer is not None else TRACER
@@ -382,13 +393,33 @@ class GoalOptimizer:
         else:
             engine = Engine(
                 state, self.chain, constraint=self.constraint, options=options,
-                config=config, prior=prior,
+                config=config, prior=prior, prewarm_store=self.prewarm_store,
             )
             self._cache_put(self._engines, key, engine)
         self._record(hit, count=count)
+        self._note_prewarm(engine, config)
         return engine, dict(
             engine_cache_hit=hit, engine_build_s=round(time.monotonic() - t0, 6)
         )
+
+    def _note_prewarm(self, engine, config, *, parallel_mode: str = "single") -> None:
+        """Record this engine's (bucket, config) in the boot-prewarm
+        manifest — the ACTIVE working set a restart replays.  Best-effort;
+        hits refresh recency (throttled on disk), misses write through."""
+        store = self.prewarm_store
+        if store is None:
+            return
+        try:
+            # the partition-replica table's width (max observed RF) is the
+            # one data-dependent aval axis the shape alone does not pin —
+            # a prewarm at the wrong width compiles the wrong program
+            inner = getattr(engine, "engine", engine)  # mesh engines wrap one
+            max_rf = int(inner.statics.part_replicas.shape[1])
+            store.note(
+                inner.shape, max_rf, config, parallel_mode=parallel_mode
+            )
+        except Exception:  # noqa: BLE001 — the manifest is best-effort
+            pass
 
     def _parallel_engine(
         self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
@@ -406,6 +437,7 @@ class GoalOptimizer:
             try:
                 engine = engine.rebind(state, options)
                 self._record(True)
+                self._note_prewarm(engine, config, parallel_mode=self.parallel_mode)
                 return engine, dict(
                     engine_cache_hit=True,
                     engine_build_s=round(time.monotonic() - t0, 6),
@@ -418,6 +450,7 @@ class GoalOptimizer:
         engine = self._build_parallel_engine(state, options, config)
         self._cache_put(self._parallel_engines, key, engine)
         self._record(False)
+        self._note_prewarm(engine, config, parallel_mode=self.parallel_mode)
         return engine, dict(
             engine_cache_hit=False, engine_build_s=round(time.monotonic() - t0, 6)
         )
@@ -438,6 +471,7 @@ class GoalOptimizer:
         options: OptimizationOptions = DEFAULT_OPTIONS,
         *,
         config: OptimizerConfig | None = None,
+        priority: int = 0,
     ) -> None:
         """Build + background-compile the engine for `state`'s shape without
         running it (the facade pre-warms the NEXT shape bucket with a padded
@@ -453,10 +487,14 @@ class GoalOptimizer:
         or device failure during the build is bounded + classified instead
         of wedging the facade's precompute thread forever.  Degradation
         here has no fallback — a skipped prewarm just means the next
-        bucket overflow pays its compile."""
+        bucket overflow pays its compile.
+
+        `priority` orders this prewarm's compiles on the shared warm pool
+        (boot prewarm: the ACTIVE bucket at 0, manifest speculation after
+        it, the facade's next-bucket speculation last)."""
         sup = self.supervisor
         if sup is None:
-            self._prewarm_on_device(state, options, config=config)
+            self._prewarm_on_device(state, options, config=config, priority=priority)
             return
         from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
 
@@ -465,7 +503,9 @@ class GoalOptimizer:
             return
         try:
             sup.call(
-                lambda: self._prewarm_on_device(state, options, config=config),
+                lambda: self._prewarm_on_device(
+                    state, options, config=config, priority=priority
+                ),
                 op="prewarm",
             )
         except DeviceDegradedError:
@@ -477,6 +517,7 @@ class GoalOptimizer:
         options: OptimizationOptions = DEFAULT_OPTIONS,
         *,
         config: OptimizerConfig | None = None,
+        priority: int = 0,
     ) -> None:
         cfg = config or self.config
         key = (state.shape, cfg)
@@ -493,13 +534,14 @@ class GoalOptimizer:
             else Engine(
                 state, self.chain, constraint=self.constraint,
                 options=options, config=cfg,
+                prewarm_store=self.prewarm_store,
             )
         )
         if not self._cache_put(cache, key, engine, if_absent=True):
             return  # a foreground request built the engine first
         self._record(False, count=False)
         try:
-            engine.precompile_async()
+            engine.precompile_async(priority=priority)
         finally:
             self._unpin(engine)
 
@@ -635,7 +677,12 @@ class GoalOptimizer:
 
     @staticmethod
     def _bucket_key(shape) -> str:
-        return f"R{shape.R}.B{shape.B}.P{shape.P}.T{shape.num_topics}"
+        # one definition (analyzer/prewarm.py): compile attribution, the
+        # boot-prewarm manifest, and the coldstart bench's trace report
+        # must all name a bucket the same way
+        from cruise_control_tpu.analyzer.prewarm import bucket_key
+
+        return bucket_key(shape)
 
     def _attribute_cold_run(self, shape, *, wall_s: float, build_s: float) -> None:
         with self._cache_lock:
@@ -736,8 +783,21 @@ class GoalOptimizer:
             # the overlap wins.  Plain and mesh engines warm through the
             # SAME pool (engine.start_warm_pool), so the sharded variants'
             # shard_map tracing overlaps the report tracing below exactly
-            # like the single-device warm start.
-            if state.shape.R >= 65_536 or cfg.num_candidates >= 8_192:
+            # like the single-device warm start.  An AOT-worthy engine
+            # under a bound prewarm store also warms: the warm pool is
+            # where artifacts are loaded/exported, and the restart SLO
+            # depends on that happening for every active bucket that
+            # would pay a real tracing bill.
+            aot_worthy = getattr(engine, "aot_worthwhile", None)
+            if (
+                state.shape.R >= 65_536
+                or cfg.num_candidates >= 8_192
+                or (
+                    self.prewarm_store is not None
+                    and aot_worthy is not None
+                    and aot_worthy()
+                )
+            ):
                 engine.precompile_async()
             (obj_b, viol_b), stats_b = self._report(state)
             # the proposal diff needs bulk BEFORE-state arrays on host;

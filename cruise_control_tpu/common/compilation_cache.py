@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 log = logging.getLogger(__name__)
 
@@ -30,13 +31,50 @@ _enabled = False
 _boot_entries: set[str] | None = None
 _cache_dir: str | None = None
 
+#: per-bucket engine-program trace accounting since process start:
+#: bucket key -> {"fresh": python-traced-and-compiled, "aot": loaded from
+#: a serialized jax.export artifact (no Python trace)}.  The restart-SLO
+#: gate (bench.py --coldstart) asserts "fresh" stays ZERO for every
+#: manifest-listed bucket on a warm-disk restart.
+_trace_lock = threading.Lock()
+_engine_traces: dict[str, dict[str, int]] = {}
+
+
+def record_engine_trace(bucket: str, *, source: str) -> None:
+    """Count one fused-engine-program acquisition for `bucket`.
+
+    source: "fresh" (Python trace + compile — the cost AOT exists to
+    kill) or "aot" (deserialized artifact; compile may still be an XLA
+    disk-cache hit).  Counted independently of the persistent cache
+    being enabled so tests can assert the fallback ladder."""
+    with _trace_lock:
+        row = _engine_traces.setdefault(bucket, {"fresh": 0, "aot": 0})
+        row[source] = row.get(source, 0) + 1
+
+
+def engine_trace_counts() -> dict[str, dict[str, int]]:
+    with _trace_lock:
+        return {k: dict(v) for k, v in _engine_traces.items()}
+
+
+def reset_engine_trace_counts() -> None:
+    """Test seam only — boot accounting is per-process in production."""
+    with _trace_lock:
+        _engine_traces.clear()
+
 
 def _scan(cache_dir: str) -> tuple[set[str], int]:
-    """(entry names, total bytes) currently on disk; tolerant of races."""
+    """(entry names, total bytes) currently on disk; tolerant of races.
+
+    Prunes the `prewarm` subdirectory: the boot-prewarm manifest and AOT
+    artifacts (analyzer/prewarm.py) live INSIDE the cache dir by default
+    so they share its mount/durability, and their writes must not read
+    as XLA compile-cache hits/misses in boot_report()."""
     entries: set[str] = set()
     total = 0
     try:
         for root, _dirs, files in os.walk(cache_dir):
+            _dirs[:] = [d for d in _dirs if d != "prewarm"]
             for fn in files:
                 path = os.path.join(root, fn)
                 entries.add(os.path.relpath(path, cache_dir))
@@ -98,4 +136,8 @@ def boot_report() -> dict | None:
         "newCompiles": len(now - _boot_entries),
         "entries": len(now),
         "bytes": total,
+        # fresh-trace vs AOT-load split per engine bucket: the number the
+        # --coldstart SLO gate reads (zero "fresh" for manifest buckets
+        # on a manifest+AOT restart)
+        "engineTraces": engine_trace_counts(),
     }

@@ -202,6 +202,34 @@ def _analyzer_defs() -> ConfigDef:
              "/tmp/cruise-control-tpu-profiler", I.LOW,
              "directory jax.profiler trace dumps land in when "
              "tpu.profiler.enabled is on", group=g)
+    # --- boot prewarm manifest + AOT programs (analyzer/prewarm.py) ---
+    g = "analyzer.tpu.prewarm"
+    d.define("tpu.prewarm.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "persist the active engine working set (bucketed shape + "
+             "search config) to a durable manifest on every engine "
+             "build, and replay it on start_up so a restarted service's "
+             "active buckets are compiling BEFORE the first proposal is "
+             "needed — the cold-start-to-first-proposal SLO "
+             "(bench.py --coldstart)", group=g)
+    d.define("tpu.prewarm.manifest.dir", T.STRING, None, I.LOW,
+             "directory of the boot-prewarm manifest and AOT-serialized "
+             "engine programs; unset derives the 'prewarm' subdirectory "
+             "inside the persistent XLA compile cache "
+             "(tpu.compile.cache.dir — same mount, one durability "
+             "story; the cache's boot inventory prunes it), empty "
+             "disables prewarm even when tpu.prewarm.enabled is on",
+             group=g)
+    d.define("tpu.prewarm.aot.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "serialize the fused anneal program per (bucket, config "
+             "fingerprint) via jax.export so a warm-disk restart skips "
+             "Python tracing too; artifacts load only on warm-pool "
+             "workers and any version/aval/checksum mismatch falls back "
+             "to the plain jit path — correctness never depends on an "
+             "artifact", group=g)
+    d.define("tpu.prewarm.max.entries", T.INT, 6, I.LOW,
+             "manifest entries kept (most-recently-used buckets win) — "
+             "bounds how many engines a boot prewarm compiles",
+             in_range(lo=1), group=g)
     return d
 
 
@@ -1010,6 +1038,28 @@ class CruiseControlConfig(AbstractConfig):
         if v is not None:
             return v or None
         return self.get("tpu.compilation.cache.dir") or None
+
+    def prewarm_manifest_dir(self) -> str | None:
+        """Directory of the boot-prewarm manifest + AOT artifacts, or
+        None when prewarm is off.  Unset derives the 'prewarm'
+        subdirectory INSIDE the persistent compile cache — the same
+        mount, so they share one durability story (a sibling of the
+        cache dir could land outside the operator's volume when the
+        volume is mounted exactly at the cache path); the cache's boot
+        inventory scan prunes the subdirectory so manifest/artifact
+        writes never count as XLA cache entries.  An explicitly empty
+        value disables, like compile_cache_dir."""
+        import os
+
+        if not self.get("tpu.prewarm.enabled"):
+            return None
+        v = self.get("tpu.prewarm.manifest.dir")
+        if v is not None:
+            return v or None
+        cache = self.compile_cache_dir()
+        if not cache:
+            return None
+        return os.path.join(os.path.expanduser(cache), "prewarm")
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")

@@ -134,6 +134,7 @@ class StreamingController:
         self._last_window: int | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._boot_gate: threading.Event | None = None
         self._lock = threading.Lock()  # one cycle at a time (thread + run_once)
         # /state ControllerState internals (sensors carry the same counts
         # as monotonic series; these are the structured view)
@@ -152,9 +153,17 @@ class StreamingController:
         t = self._thread
         return t is not None and t.is_alive()
 
-    def start(self) -> None:
+    def start(self, *, boot_gate: threading.Event | None = None) -> None:
+        """`boot_gate` (facade start_up): the boot-time manifest prewarm's
+        completion event.  The loop thread starts immediately (running is
+        True) but waits — bounded — for the gate before its first cycle,
+        so the active buckets' compiles are already in flight on the warm
+        pool when the controller takes ownership of proposal publishing
+        (PR 9 parks the bucket-prewarm path while the controller runs;
+        boot is the one window the manifest prewarm has)."""
         if self.running:
             return
+        self._boot_gate = boot_gate
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="streaming-controller"
@@ -169,6 +178,18 @@ class StreamingController:
         self._thread = None
 
     def _loop(self) -> None:
+        gate = getattr(self, "_boot_gate", None)
+        if gate is not None:
+            # bounded: a wedged prewarm must not keep the always-on loop
+            # parked forever — after the budget the controller proceeds
+            # and the remaining compiles just overlap its first cycles
+            deadline = time.monotonic() + 120.0
+            while (
+                not gate.is_set()
+                and not self._stop.is_set()
+                and time.monotonic() < deadline
+            ):
+                gate.wait(0.2)
         while not self._stop.wait(self.poll_interval_s):
             try:
                 self.run_once()

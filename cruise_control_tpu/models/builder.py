@@ -350,6 +350,60 @@ def pad_state(state: ClusterState, shape: ClusterShape) -> ClusterState:
     return dataclasses.replace(state, shape=shape, **kw)
 
 
+def prewarm_state(shape: ClusterShape, *, max_rf: int = 1) -> ClusterState:
+    """A minimal VALID ClusterState of `shape` for boot-time engine
+    prewarm (analyzer/prewarm.py manifest replay).
+
+    Engine programs specialize on shapes only — cluster data rides in as
+    runtime arguments — so a placeholder is enough to trace+compile the
+    exact programs the live model of the same bucket will run.  The one
+    data-dependent aval axis is the partition replica table's width
+    (max observed replication factor), so `max_rf` replicas of one
+    partition are materialized on distinct brokers; everything else is
+    zeros/defaults, front-packed so sampling-bound derivation matches a
+    real monitor build.
+    """
+    import jax.numpy as jnp
+
+    R, B, D = shape.R, shape.B, shape.max_disks_per_broker
+    max_rf = max(1, min(int(max_rf), R, B))
+    n = max_rf  # valid replicas: one partition, rf = max_rf
+    r_broker = np.zeros(R, np.int32)
+    r_broker[:n] = np.arange(n, dtype=np.int32)
+    r_pos = np.zeros(R, np.int32)
+    r_pos[:n] = np.arange(n, dtype=np.int32)
+    r_leader = np.zeros(R, bool)
+    r_leader[0] = True
+    r_valid = np.zeros(R, bool)
+    r_valid[:n] = True
+    zeros_load = np.zeros((R, NUM_RESOURCES), np.float32)
+    broker_valid = np.ones(B, bool)
+    return ClusterState(
+        replica_broker=jnp.asarray(r_broker),
+        replica_partition=jnp.asarray(np.zeros(R, np.int32)),
+        replica_topic=jnp.asarray(np.zeros(R, np.int32)),
+        replica_pos=jnp.asarray(r_pos),
+        replica_is_leader=jnp.asarray(r_leader),
+        replica_valid=jnp.asarray(r_valid),
+        replica_orig_broker=jnp.asarray(r_broker.copy()),
+        replica_offline=jnp.asarray(np.zeros(R, bool)),
+        replica_disk=jnp.asarray(np.zeros(R, np.int32)),
+        replica_load_leader=jnp.asarray(zeros_load),
+        replica_load_follower=jnp.asarray(zeros_load.copy()),
+        broker_capacity=jnp.asarray(np.ones((B, NUM_RESOURCES), np.float32)),
+        broker_rack=jnp.asarray(np.zeros(B, np.int32)),
+        broker_host=jnp.asarray(
+            np.arange(B, dtype=np.int32) % max(1, shape.num_hosts)
+        ),
+        broker_alive=jnp.asarray(np.ones(B, bool)),
+        broker_new=jnp.asarray(np.zeros(B, bool)),
+        broker_valid=jnp.asarray(broker_valid),
+        disk_capacity=jnp.asarray(np.ones((B, D), np.float32)),
+        disk_alive=jnp.asarray(np.ones((B, D), bool)),
+        shape=shape,
+    )
+
+
 class ClusterModelBuilder:
     def __init__(
         self,

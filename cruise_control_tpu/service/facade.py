@@ -133,6 +133,24 @@ class AnalyzerCore:
         self.supervisor = config.device_supervisor(
             sensors=self.sensors, tracer=self.tracer
         )
+        #: boot-prewarm manifest + AOT artifact store (tpu.prewarm.*,
+        #: analyzer/prewarm.py): ONE per core, so N fleet facades MERGE
+        #: their bucket working sets into one manifest instead of
+        #: last-writer-wins, and a restart replays every cluster's
+        #: buckets through claim_boot_entries() exactly once
+        self.prewarm_store = None
+        prewarm_dir = config.prewarm_manifest_dir()
+        if prewarm_dir:
+            from cruise_control_tpu.analyzer.prewarm import PrewarmStore
+
+            self.prewarm_store = PrewarmStore(
+                prewarm_dir,
+                chain=self.chain,
+                constraint=self.constraint,
+                aot_enabled=config.get("tpu.prewarm.aot.enabled"),
+                max_entries=config.get("tpu.prewarm.max.entries"),
+                sensors=self.sensors,
+            )
         self.optimizer = GoalOptimizer(
             chain=self.chain,
             constraint=self.constraint,
@@ -147,6 +165,7 @@ class AnalyzerCore:
             degraded_budget_s=config.get("tpu.supervisor.degraded.greedy.budget.s"),
             tracer=self.tracer,
             profiler_dir=self.profiler_dir,
+            prewarm_store=self.prewarm_store,
         )
         # per-bucket cold-start attribution as labeled /metrics series
         # (only the core's long-lived default optimizer feeds it; ad-hoc
@@ -352,6 +371,12 @@ class CruiseControl:
 
             self.controller = StreamingController(self)
         self._compile_cache_reported = False
+        #: set once the boot-time manifest prewarm has ENQUEUED its
+        #: engines (compiles continue on the warm pool); pre-set so
+        #: facades that never start_up (tests, bench drivers) and
+        #: deployments without a manifest behave exactly as today
+        self._boot_prewarm_done = threading.Event()
+        self._boot_prewarm_done.set()
 
     def _detect_optimizer_degraded(self):
         """OPTIMIZER_DEGRADED anomaly, once per breaker-open episode.
@@ -551,6 +576,17 @@ class CruiseControl:
             detection_interval_s
             or self.config.get("anomaly.detection.interval.ms") / 1000.0
         )
+        # boot prewarm (analyzer/prewarm.py): replay the durable manifest
+        # through the warm pool so the ACTIVE buckets are compiling before
+        # resume_recovered_execution() or the controller's first cycle
+        # needs a proposal.  One claim per store: in a fleet, every
+        # facade's start_up races here and exactly one runs the replay.
+        store = getattr(self.optimizer, "prewarm_store", None)
+        if store is not None:
+            self._boot_prewarm_done.clear()
+            threading.Thread(
+                target=self._boot_prewarm, daemon=True, name="boot-prewarm"
+            ).start()
         if self.executor.has_recovered_execution:
             # drive the journal-reconciled remainder off the startup path:
             # re-adopted moves progress without resubmission while the
@@ -564,8 +600,12 @@ class CruiseControl:
         if self.controller is not None:
             # the streaming controller IS the always-on precompute: it
             # publishes a fresh proposal every window roll, so the legacy
-            # timer loop would only burn duplicate anneals beside it
-            self.controller.start()
+            # timer loop would only burn duplicate anneals beside it.
+            # It starts immediately but lets the boot-time manifest
+            # prewarm COMPLETE (bounded) before its first cycle takes
+            # ownership — its donated in-place updates park the bucket
+            # prewarm path, so boot is the one window this prewarm has.
+            self.controller.start(boot_gate=self._boot_prewarm_done)
         elif precompute:
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, daemon=True, name="proposal-precompute"
@@ -620,6 +660,55 @@ class CruiseControl:
             if self._stop_precompute.wait(self._proposal_expiration_ms / 2000.0):
                 return
 
+    def _boot_prewarm(self):
+        """Replay the boot-prewarm manifest (analyzer/prewarm.py) through
+        `GoalOptimizer.prewarm`, most-recent bucket first — the ACTIVE
+        bucket's programs compile before any speculation (warm-pool
+        priority = manifest rank).  Each entry builds a placeholder state
+        of the recorded bucket shape (+ max_rf) and reconstructs the
+        recorded OptimizerConfig, so the compiled programs are exactly
+        the ones the live model of that bucket will run; entries from a
+        different parallel mode are skipped.  Failures are counted, never
+        fatal — a failed prewarm just means that bucket pays its cold
+        compile like today."""
+        t0 = time.monotonic()
+        enqueued = 0
+        try:
+            store = self.optimizer.prewarm_store
+            entries = store.claim_boot_entries() if store is not None else []
+            for rank, entry in enumerate(entries):
+                try:
+                    shape, max_rf, cfg, pmode = store.entry_engine_inputs(entry)
+                    if pmode != self.optimizer.parallel_mode:
+                        continue
+                    from cruise_control_tpu.models.builder import prewarm_state
+
+                    self.optimizer.prewarm(
+                        prewarm_state(shape, max_rf=max_rf),
+                        config=cfg,
+                        priority=rank,
+                    )
+                    enqueued += 1
+                    self.sensors.counter("analyzer.boot-prewarm-buckets").inc()
+                except Exception:  # noqa: BLE001 — per-entry, keep replaying
+                    self.sensors.counter("analyzer.boot-prewarm-failures").inc()
+                    log.warning(
+                        "boot prewarm of manifest entry failed", exc_info=True
+                    )
+            if enqueued:
+                log.info(
+                    "boot prewarm: %d manifest bucket(s) compiling in the "
+                    "background", enqueued,
+                )
+        except Exception:  # noqa: BLE001 — boot must never hang on prewarm
+            self.sensors.counter("analyzer.boot-prewarm-failures").inc()
+            log.warning("boot prewarm failed", exc_info=True)
+        finally:
+            self.sensors.gauge("analyzer.boot-prewarm-seconds").set(
+                round(time.monotonic() - t0, 6)
+            )
+            self._boot_prewarm_done.set()
+
     def _log_compile_cache_report(self):
         """After the first proposal pass: how many XLA executables loaded
         warm from the persistent compile cache (hits) vs compiled fresh
@@ -668,7 +757,10 @@ class CruiseControl:
             return
         from cruise_control_tpu.models.builder import pad_state
 
-        self.optimizer.prewarm(pad_state(state, nxt))
+        # speculation compiles AFTER anything the boot prewarm or a
+        # request enqueued (warm-pool priority ordering): the active
+        # bucket's programs must never wait behind a next-bucket guess
+        self.optimizer.prewarm(pad_state(state, nxt), priority=100)
 
     # ------------------------------------------------------------------
     # proposal computation + cache (reference optimizations():276-324,493)

@@ -73,6 +73,24 @@ echo "== check.sh: streaming controller gate (prior parity, warm start, delta pa
 python -m pytest tests/test_controller.py -q
 controller_rc=$?
 
+echo "== check.sh: bench.py --coldstart --smoke (restart SLO: manifest+AOT prewarm, CPU) =="
+# named gate: one child process per restart phase (truly cold /
+# XLA-cache-only / manifest+AOT); the manifest+AOT phase must report
+# ZERO fresh engine traces for manifest-listed buckets, a strictly
+# lower cold-start-to-first-proposal wall than truly-cold, and the
+# identical objective (the AOT path must never change results)
+GRAFT_FORCE_CPU=1 python bench.py --coldstart --smoke
+coldstart_rc=$?
+
+echo "== check.sh: cold-start prewarm gate (manifest, AOT fallback ladder, warm pool) =="
+# named gate: manifest round-trip + fingerprint rejection, corrupt/
+# truncated AOT artifact -> plain-jit fallback (no crash, sensor
+# incremented), aval-drift fallback (the r4 regression class),
+# never-on-the-request-path, warm-pool priority ordering, fleet
+# manifest merging
+python -m pytest tests/test_prewarm.py -q
+prewarm_rc=$?
+
 echo "== check.sh: bench.py --fleet-smoke (shared-engine fleet economics, CPU) =="
 # named gate: a 3-cluster fleet (2 sharing a shape bucket) must end with
 # FEWER compiled engines than clusters (the shared AnalyzerCore is real)
@@ -159,5 +177,5 @@ python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc coldstart=$coldstart_rc prewarm=$prewarm_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$coldstart_rc" -eq 0 ] && [ "$prewarm_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
