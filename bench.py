@@ -888,6 +888,122 @@ def fleet_smoke() -> int:
     return 0 if ok else 1
 
 
+def ha_smoke() -> int:
+    """`bench.py --ha-smoke`: the fleet-HA takeover SLO gate.
+
+    Two in-process instances (A, B) share ONE lease/journal directory and
+    one set of 3 simulated clusters — the exact coordination surface real
+    instances share.  A starts first and owns everything; B stands by,
+    heart-beating but unable to steal a live lease.  Then A is killed
+    (heartbeats stop, nothing released — a crash, not a shutdown) and the
+    gate holds that:
+
+      * B acquires every cluster and serves its first post-takeover
+        proposal within the budget (lease expiry + heartbeat + CPU
+        compile headroom) — the measured time-to-takeover SLO;
+      * the lease store's audit trail proves at most one holder per
+        cluster at any instant across the whole run (the single-holder
+        invariant, checked mechanically, not trusted).
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.fleet.leases import single_holder_violations
+    from cruise_control_tpu.monitor.topology import StaticMetadataProvider
+    from cruise_control_tpu.service.main import build_simulated_fleet
+    from cruise_control_tpu.service.progress import OperationProgress
+    from cruise_control_tpu.testing.synthetic import (
+        SyntheticWorkloadSampler,
+        synthetic_topology,
+    )
+
+    ttl, renew, slack = 1.5, 0.4, 0.2
+    journal_dir = tempfile.mkdtemp(prefix="cc-ha-smoke-")
+    backends = {}
+    for i, cid in enumerate(("c1", "c2", "c3")):
+        topo = synthetic_topology(
+            num_brokers=6, topics={"T0": 12, "T1": 12}, seed=41 + i
+        )
+        meta = StaticMetadataProvider(topo)
+        backends[cid] = (
+            meta,
+            SimulatedClusterAdmin(meta, link_rate_bytes_per_s=1e12),
+            SyntheticWorkloadSampler(topo, seed=41 + i),
+        )
+
+    def instance(iid):
+        return build_simulated_fleet({
+            "fleet.clusters": "c1,c2,c3",
+            "fleet.ha.enabled": "true",
+            "fleet.ha.instance.id": iid,
+            "fleet.ha.lease.ttl.s": ttl,
+            "fleet.ha.renew.s": renew,
+            "fleet.ha.skew.slack.s": slack,
+            "executor.journal.dir": journal_dir,
+            "anomaly.detection.interval.ms": 3_600_000,
+            "tpu.prewarm.enabled": "false",
+        }, backends=backends)
+
+    app_a, fleet_a = instance("A")
+    app_b, fleet_b = instance("B")
+    lm_a, lm_b = fleet_a.lease_manager, fleet_b.lease_manager
+
+    fleet_a.start_up()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(lm_a.owned_clusters()) < 3:
+        time.sleep(0.02)
+    owned_a = sorted(lm_a.owned_clusters())
+
+    fleet_b.start_up()  # stands by: a live lease cannot be stolen
+    time.sleep(3 * renew)
+    stolen = sorted(lm_b.owned_clusters())
+
+    t_kill = time.monotonic()
+    lm_a.kill()  # crash: no release — B must wait out the TTL
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and len(lm_b.owned_clusters()) < 3:
+        time.sleep(0.02)
+    takeover_s = time.monotonic() - t_kill
+    owned_b = sorted(lm_b.owned_clusters())
+    fleet_b.facade("c1").proposals(OperationProgress(), ignore_cache=True)
+    first_proposal_s = time.monotonic() - t_kill
+
+    violations = single_holder_violations(lm_b.store.audit_events())
+    # lease expiry (ttl + slack past A's last renewal, found within one
+    # heartbeat) + the takeover's reconciliation/activation + one cold
+    # CPU engine compile for the first proposal
+    budget = ttl + slack + 2 * renew + 45.0
+    ok = (
+        owned_a == ["c1", "c2", "c3"]
+        and stolen == []
+        and owned_b == ["c1", "c2", "c3"]
+        and first_proposal_s <= budget
+        and violations == []
+    )
+    _emit(
+        metric="ha_smoke",
+        value=round(first_proposal_s, 3),
+        unit="s",
+        vs_baseline=round(first_proposal_s / budget, 3),
+        takeover_s=round(takeover_s, 3),
+        time_to_first_proposal_s=round(first_proposal_s, 3),
+        budget_s=budget,
+        lease_ttl_s=ttl,
+        owned_before_kill=owned_a,
+        stolen_while_alive=stolen,
+        owned_after_takeover=owned_b,
+        single_holder_violations=violations,
+        audit_events=len(lm_b.store.audit_events()),
+        ok=ok,
+    )
+    fleet_b.shutdown()
+    fleet_a.shutdown()
+    return 0 if ok else 1
+
+
 def _churn_states(n_gens, *, brokers, partitions, parts_per_gen, broker_add_at, seed):
     """One synthetic churn stream: generation g has `partitions + g*delta`
     partitions (partition creates) and one broker added at broker_add_at —
@@ -1494,6 +1610,8 @@ def main():
         sys.exit(streaming("--smoke" in sys.argv))
     if "--fleet-smoke" in sys.argv:
         sys.exit(fleet_smoke())
+    if "--ha-smoke" in sys.argv:
+        sys.exit(ha_smoke())
     if "--mesh-smoke" in sys.argv:
         sys.exit(mesh_smoke())
     if "--trace-overhead" in sys.argv:

@@ -374,6 +374,13 @@ def _fleet_defs() -> ConfigDef:
                     f"{name}: cluster id {cid!r} must match {_CLUSTER_ID_RE} "
                     "(ids become journal subdirectories and metric labels)"
                 )
+            if cid == "ha":
+                # fleet.ha.* are the HA keys themselves — a cluster named
+                # "ha" would make its fleet.ha.<key> overrides ambiguous
+                raise ConfigException(
+                    f"{name}: cluster id 'ha' is reserved (fleet.ha.* are "
+                    "the lease-ownership keys)"
+                )
         if len(set(value)) != len(value):
             raise ConfigException(f"{name}: duplicate cluster ids in {value}")
 
@@ -400,6 +407,39 @@ def _fleet_defs() -> ConfigDef:
              "clusters' proposal refreshes (breach: 429 + "
              "fleet.tenant-rejections sensor); 0 disables",
              in_range(lo=0), group=g)
+    # --- fleet HA: lease-sharded ownership (fleet/leases.py) ---
+    g = "fleet.ha"
+    d.define("fleet.ha.enabled", T.BOOLEAN, False, I.HIGH,
+             "lease-sharded cluster ownership: M instances jointly serve "
+             "one fleet.clusters set, each cluster owned (executed "
+             "against) by exactly the instance holding its lease — "
+             "per-cluster leases with monotonically increasing fencing "
+             "epochs live in <executor.journal.dir>/_leases, every "
+             "journal append and cluster mutation is fenced on the "
+             "epoch, and a lost lease steps the cluster down to "
+             "read-only degraded mode.  Requires executor.journal.dir "
+             "(the lease store shares the journal's durability).  Off "
+             "(the default): single-instance and classic fleet "
+             "deployments run byte-for-byte unchanged with no lease "
+             "store on disk", group=g)
+    d.define("fleet.ha.lease.ttl.s", T.DOUBLE, 30.0, I.MEDIUM,
+             "lease lifetime granted per acquisition/renewal; a peer may "
+             "take a cluster over once its lease has been expired for "
+             "fleet.ha.skew.slack.s", in_range(lo=0.1), group=g)
+    d.define("fleet.ha.renew.s", T.DOUBLE, 10.0, I.MEDIUM,
+             "renewal-heartbeat cadence; must be well below the ttl so "
+             "transient store hiccups don't cost the lease",
+             in_range(lo=0.01), group=g)
+    d.define("fleet.ha.skew.slack.s", T.DOUBLE, 2.0, I.MEDIUM,
+             "tolerated per-instance clock error: a holder's fence "
+             "self-revokes at deadline - slack (on ITS clock) while "
+             "takeover is only granted after deadline + slack (on the "
+             "acquirer's) — skew within the slack cannot create two "
+             "writers", in_range(lo=0.0), group=g)
+    d.define("fleet.ha.instance.id", T.STRING, None, I.MEDIUM,
+             "this instance's lease holder id; unset derives "
+             "<hostname>-<pid>.  Must be unique across the instances "
+             "sharing one lease store", group=g)
     return d
 
 
@@ -577,6 +617,15 @@ def _executor_defs() -> ConfigDef:
              "record durable before the next cluster mutation (execution "
              "start, throttle and reaper records always fsync regardless)",
              in_range(lo=1), group=g)
+    d.define("executor.journal.retention.count", T.INT, 64, I.LOW,
+             "terminal (cleanly finished) journal archives kept per "
+             "cluster; older ones are pruned during start-up "
+             "reconciliation.  Unfinished journals awaiting recovery are "
+             "NEVER pruned", in_range(lo=0), group=g)
+    d.define("executor.journal.retention.hours", T.DOUBLE, 168.0, I.LOW,
+             "terminal journal archives older than this are pruned during "
+             "start-up reconciliation regardless of count (default 7 "
+             "days)", in_range(lo=0.0), group=g)
     # --- stuck-move reaper ---
     g = "executor.reaper"
     d.define("executor.reaper.enabled", T.BOOLEAN, True, I.MEDIUM,

@@ -30,6 +30,10 @@ class AnomalyType(enum.Enum):
     #: the executor's stuck-move reaper cancelled a reassignment whose
     #: progress watermark stalled past executor.reaper.stuck.timeout.s
     EXECUTION_STUCK = 6
+    #: this instance lost a cluster's ownership lease (fleet HA) — the
+    #: cluster stepped down to read-only degraded mode while a peer
+    #: instance takes over execution
+    FLEET_LEASE_LOST = 7
 
     @property
     def priority(self) -> int:
@@ -169,6 +173,31 @@ class ExecutionStuck(Anomaly):
             f"ExecutionStuck({self.topic}-{self.partition}, "
             f"task={self.execution_id}, stalled={self.stalled_s:.0f}s, "
             f"{'rolled back' if self.rolled_back else 'DEAD'})"
+        )
+
+
+@dataclasses.dataclass
+class FleetLeaseLost(Anomaly):
+    """This instance's lease on a cluster expired or was taken over
+    (fleet/leases.py) — the cluster is now in read-only degraded mode
+    here: proposals//state//fleet keep serving, the executor halted via
+    the force-stop path, and every further journal append or cluster
+    mutation is fenced on the stale epoch.
+
+    Not self-healable: recovery is either re-acquiring the lease (the
+    heartbeat keeps trying) or the peer holder serving the cluster —
+    alert-only, like OPTIMIZER_DEGRADED."""
+
+    anomaly_type: AnomalyType = AnomalyType.FLEET_LEASE_LOST
+    cluster_id: str = ""
+    instance_id: str = ""
+    epoch: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"FleetLeaseLost(cluster={self.cluster_id}, "
+            f"instance={self.instance_id}, epoch={self.epoch})"
         )
 
 

@@ -314,6 +314,11 @@ class AnomalyDetector:
     # ------------------------------------------------------------------
 
     def start(self, interval_s: float = 30.0):
+        if self._thread is not None and self._thread.is_alive():
+            # double-start guard: a retried facade start_up (e.g. fleet-HA
+            # activation after a partial failure) must not leak a second
+            # detection loop thread
+            return
         # detectors without an explicit cadence run at the base interval;
         # the loop wakes often enough to honor the shortest cadence
         self._detectors = [

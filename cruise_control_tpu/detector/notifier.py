@@ -21,6 +21,7 @@ from cruise_control_tpu.detector.anomalies import (
     AnomalyType,
     BrokerFailures,
     ExecutionStuck,
+    FleetLeaseLost,
     OptimizerDegraded,
 )
 
@@ -98,10 +99,12 @@ class SelfHealingNotifier:
     def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
         if isinstance(anomaly, BrokerFailures):
             return self._on_broker_failure(anomaly)
-        if isinstance(anomaly, (OptimizerDegraded, ExecutionStuck)):
+        if isinstance(anomaly, (OptimizerDegraded, ExecutionStuck,
+                                FleetLeaseLost)):
             # nothing to fix (the supervisor's half-open probe / the
-            # executor's reaper already IS the recovery path) but operators
-            # must hear about it immediately — alert, then ignore
+            # executor's reaper / the lease heartbeat's re-acquisition
+            # already IS the recovery path) but operators must hear about
+            # it immediately — alert, then ignore
             self._send_alert(anomaly, False)
             return AnomalyNotificationResult.ignore()
         if not self._enabled.get(anomaly.anomaly_type, False) or not anomaly.fixable:

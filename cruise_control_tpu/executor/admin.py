@@ -83,6 +83,49 @@ class ClusterAdmin(Protocol):
     #       cancellation; cancel_reassignments above nukes everything)
 
 
+#: ClusterAdmin methods that MUTATE the cluster — the full fencing
+#: surface (fleet HA): a deposed lease holder must not be able to touch
+#: the cluster through any of these
+_MUTATING_ADMIN_OPS = frozenset({
+    "reassign_partitions",
+    "cancel_reassignments",
+    "cancel_partition_reassignments",
+    "elect_leaders",
+    "alter_replica_logdirs",
+    "set_replication_throttle",
+    "clear_replication_throttle",
+})
+
+
+class FencedClusterAdmin:
+    """ClusterAdmin decorator stamping the lease fence onto every cluster
+    MUTATION (fleet/leases.py): each call in `_MUTATING_ADMIN_OPS` first
+    runs `fence.check()` — a stale/absent lease epoch raises `FencedError`
+    before anything reaches the cluster, so a zombie instance whose lease
+    was taken over can neither submit, cancel, elect, move logdirs nor
+    touch throttles.  Reads (topology, in-progress listings, watermarks)
+    pass through unfenced — the degraded read-only mode keeps serving
+    them — and optional capabilities (`tick`, `reassignment_remaining_
+    bytes`, `logdir_of`, ...) delegate transparently so `hasattr` probes
+    see exactly the wrapped admin's surface."""
+
+    def __init__(self, admin: "ClusterAdmin", fence):
+        self._admin = admin
+        self._fence = fence
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._admin, name)
+        if name in _MUTATING_ADMIN_OPS and callable(attr):
+            fence = self._fence
+
+            def fenced(*args, __attr=attr, __name=name, **kwargs):
+                fence.check(op=f"admin.{__name}")
+                return __attr(*args, **kwargs)
+
+            return fenced
+        return attr
+
+
 @dataclasses.dataclass
 class _Inflight:
     spec: ReassignmentSpec

@@ -315,6 +315,7 @@ class Executor:
         clock=None,
         anomaly_sink=None,
         tracer=None,
+        defer_recovery: bool = False,
     ):
         """notifier (reference ExecutorConfig executor.notifier.class): an
         object with on_execution_finished(result, uuid), called after every
@@ -333,7 +334,12 @@ class Executor:
         `executor.execution` span whose EVENTS are the task transitions
         (riding the same ExecutionTask.observer hook the journal uses),
         reaper actions and adaptive-cap changes; defaults to the
-        process-wide TRACER."""
+        process-wide TRACER.
+
+        defer_recovery (fleet HA): skip the journal replay at
+        construction — reconciliation touches the cluster (throttle
+        sweep) and MUST wait for lease acquisition; the fleet manager
+        calls reconcile_journal() once the lease is held."""
         from cruise_control_tpu.common.sensors import REGISTRY
         from cruise_control_tpu.common.trace import TRACER
 
@@ -389,8 +395,50 @@ class Executor:
         #: stashed remainder of a reconciled execution, consumed by
         #: resume_recovered_execution()
         self._resume_state: tuple | None = None
-        if journal is not None:
+        #: True after a FencedError aborted an execution (lease lost
+        #: mid-batch); cleared when a new execution starts
+        self._fenced_abort = False
+        if journal is not None and not defer_recovery:
+            self.reconcile_journal()
+
+    def reconcile_journal(self) -> None:
+        """Replay the journal and reconcile any unfinished execution
+        against the live cluster (see _reconcile_journal), then prune
+        terminal journal archives per the retention bounds.  Runs at
+        construction by default; fleet HA defers it to lease acquisition
+        (and re-runs it on every re-acquisition) — refuses while an
+        execution is ongoing.
+
+        The executor is parked in RECOVERING for the DURATION of the
+        replay: reconciliation sweeps throttles and rebuilds the tracker,
+        so a request-path execution starting mid-sweep would race it —
+        the state guard makes execute_proposals reject until the
+        reconcile settles."""
+        if self.journal is None:
+            return
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError(
+                    "cannot reconcile the journal mid-execution"
+                )
+            self.state = ExecutorState.RECOVERING
+        settled = False
+        try:
             self._reconcile_journal()
+            settled = True
+        finally:
+            with self._lock:
+                # _reconcile_journal leaves RECOVERING only when a resume
+                # remainder exists (or set NO_TASK itself on the
+                # everything-landed path); a clean/failed replay must not
+                # leave the guard state wedged
+                if self._resume_state is None and (
+                    not settled or self.state == ExecutorState.RECOVERING
+                ):
+                    self.state = ExecutorState.NO_TASK_IN_PROGRESS
+        pruned = self.journal.prune_archives(now_ms=self._clock())
+        if pruned:
+            self.sensors.counter("executor.journal-archives-pruned").inc(pruned)
 
     # ------------------------------------------------------------------
     # journal hooks
@@ -599,19 +647,27 @@ class Executor:
         with self._lock:
             if self._resume_state is None:
                 return None
-            options, adopted, adopted_intra, adaptive = self._resume_state
+            stash = self._resume_state
             self._resume_state = None
-            # do NOT reset _stop_requested/_force_stop: an operator stop
-            # issued while the executor sat RECOVERING must be honored —
-            # the loop below then drains (or force-cancels) the adopted
-            # moves instead of driving the recovery to completion
-            self.num_executions_started += 1
-            self.sensors.counter("executor.execution-started").inc()
-            planner = ExecutionTaskPlanner(self.strategy)
-            planner.adopt_tasks(self.tracker.tasks(state=TaskState.PENDING))
-            self._planner = planner
-            self._reexecutions = {}
-            self._intra_unknown = {}
+            try:
+                options, adopted, adopted_intra, adaptive = stash
+                # do NOT reset _stop_requested/_force_stop: an operator stop
+                # issued while the executor sat RECOVERING must be honored —
+                # the loop below then drains (or force-cancels) the adopted
+                # moves instead of driving the recovery to completion
+                self.num_executions_started += 1
+                self.sensors.counter("executor.execution-started").inc()
+                self._fenced_abort = False
+                planner = ExecutionTaskPlanner(self.strategy)
+                planner.adopt_tasks(self.tracker.tasks(state=TaskState.PENDING))
+                self._planner = planner
+                self._reexecutions = {}
+                self._intra_unknown = {}
+            except BaseException:
+                # setup failed: put the remainder back so a retried resume
+                # (or the next reconciliation) still sees it
+                self._resume_state = stash
+                raise
         live_proposals = [
             t.proposal for t in self.tracker.tasks() if t.state not in _TERMINAL
         ]
@@ -782,49 +838,66 @@ class Executor:
 
         strategy: per-execution ordering override (reference per-request
         replica_movement_strategies); falls back to the configured default."""
+        from cruise_control_tpu.fleet.leases import FencedError
+
         options = options or ExecutionOptions()
         with self._lock:
             if self.has_ongoing_execution:
                 raise OngoingExecutionError("an execution is already in progress")
             self.state = ExecutorState.STARTING_EXECUTION
-            self._stop_requested = False
-            self._force_stop = False
-            self._uuid = uuid
-            self.num_executions_started += 1
-            # reference Executor execution-started sensor (:118-125)
-            self.sensors.counter("executor.execution-started").inc()
-            now = self._clock()
-            for b in removed_brokers or ():
-                self._removed_history[b] = now
-            for b in demoted_brokers or ():
-                self._demoted_history[b] = now
-            self.tracker = ExecutionTaskTracker(observer=self._journal_task)
-            self._reexecutions = {}
-            self._intra_unknown = {}
-            self._requested = {}  # overrides die with the previous execution
-            self._recovery = None
-            self._planner = ExecutionTaskPlanner(strategy or self.strategy)
-            tasks = self._planner.add_execution_proposals(proposals, strategy_context)
-            for t in tasks:
-                self.tracker.add(t)
-            if self.journal is not None:
-                # durable BEFORE the first cluster mutation: a crash at any
-                # later point finds every task + reservation in the journal
-                self.journal.start_execution({
-                    "uuid": uuid,
-                    "ms": now,
-                    "options": dataclasses.asdict(options),
-                    "tasks": [
-                        task_to_journal(t, self._partition_key(t.proposal))
-                        for t in tasks
-                    ],
-                    "removed": {
-                        str(b): ms for b, ms in self._removed_history.items()
-                    },
-                    "demoted": {
-                        str(b): ms for b, ms in self._demoted_history.items()
-                    },
-                })
+            try:
+                self._stop_requested = False
+                self._force_stop = False
+                self._uuid = uuid
+                self.num_executions_started += 1
+                # reference Executor execution-started sensor (:118-125)
+                self.sensors.counter("executor.execution-started").inc()
+                now = self._clock()
+                for b in removed_brokers or ():
+                    self._removed_history[b] = now
+                for b in demoted_brokers or ():
+                    self._demoted_history[b] = now
+                self.tracker = ExecutionTaskTracker(observer=self._journal_task)
+                self._reexecutions = {}
+                self._intra_unknown = {}
+                self._requested = {}  # overrides die with the previous one
+                self._recovery = None
+                self._fenced_abort = False
+                self._planner = ExecutionTaskPlanner(strategy or self.strategy)
+                tasks = self._planner.add_execution_proposals(
+                    proposals, strategy_context
+                )
+                for t in tasks:
+                    self.tracker.add(t)
+                if self.journal is not None:
+                    # durable BEFORE the first cluster mutation: a crash at
+                    # any later point finds every task + reservation in the
+                    # journal
+                    self.journal.start_execution({
+                        "uuid": uuid,
+                        "ms": now,
+                        "options": dataclasses.asdict(options),
+                        "tasks": [
+                            task_to_journal(t, self._partition_key(t.proposal))
+                            for t in tasks
+                        ],
+                        "removed": {
+                            str(b): ms for b, ms in self._removed_history.items()
+                        },
+                        "demoted": {
+                            str(b): ms for b, ms in self._demoted_history.items()
+                        },
+                    })
+            except BaseException as e:
+                # a setup failure (bad proposals, fenced journal start, ...)
+                # must not wedge the executor in STARTING_EXECUTION — that
+                # state blocks every later execution AND reconciliation
+                self.state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._planner = None
+                if isinstance(e, FencedError):
+                    self._fenced_abort = True
+                    self.sensors.counter("executor.fenced-aborts").inc()
+                raise
         with self.tracer.span(
             "executor.execution",
             component="executor",
@@ -853,27 +926,45 @@ class Executor:
     ) -> ExecutionResult:
         """Throttle lifecycle + state reset around the execution loop, in
         try/finally so no exit path — exception included — leaks a
-        replication throttle onto the brokers or wedges the executor state."""
+        replication throttle onto the brokers or wedges the executor state.
+
+        FencedError (fleet HA) aborts the batch cleanly: the zombie's
+        cleanup calls are themselves fenced (it must not clear a throttle
+        the NEW holder's reconciliation is about to sweep), the local
+        state still resets, nothing is journaled, and the error
+        propagates so the caller knows the lease is gone."""
+        from cruise_control_tpu.fleet.leases import FencedError
+
         throttle = ReplicationThrottleHelper(
             self.admin, options.replication_throttle_bytes_per_s,
             journal=self.journal,
         )
         uuid = self._uuid
         try:
-            throttle.set_throttles(proposals, self.topic_names)
-            result = self._run(
-                options, in_flight=in_flight, intra_in_flight=intra_in_flight,
-                adaptive_initial=adaptive_initial,
-            )
-        finally:
             try:
-                throttle.clear_throttles()
+                throttle.set_throttles(proposals, self.topic_names)
+                result = self._run(
+                    options, in_flight=in_flight,
+                    intra_in_flight=intra_in_flight,
+                    adaptive_initial=adaptive_initial,
+                )
             finally:
-                with self._lock:
-                    self.state = ExecutorState.NO_TASK_IN_PROGRESS
-                    self._planner = None
-                    self._adjuster = None
-        self._finish_execution(result, uuid)
+                try:
+                    throttle.clear_throttles()
+                finally:
+                    with self._lock:
+                        self.state = ExecutorState.NO_TASK_IN_PROGRESS
+                        self._planner = None
+                        self._adjuster = None
+            # inside the guard: a lease lost between the last task and the
+            # finished-record append is STILL a fenced abort, not an
+            # anonymous exception
+            self._finish_execution(result, uuid)
+        except FencedError:
+            with self._lock:
+                self._fenced_abort = True
+            self.sensors.counter("executor.fenced-aborts").inc()
+            raise
         return result
 
     def _result(self, *, ticks: int) -> ExecutionResult:
@@ -1470,4 +1561,8 @@ class Executor:
         recovery = self.recovery_info()
         if recovery is not None:
             out["recovery"] = recovery
+        with self._lock:
+            if self._fenced_abort:
+                # the last execution aborted on a lost lease (fleet HA)
+                out["fencedAbort"] = True
         return out

@@ -25,7 +25,28 @@ Writes are batched then flush+fsync'd (`executor.journal.fsync.batch.size`;
 `start`, throttle, `reaped` and `finished` records always fsync — they are
 the records recovery correctness depends on.  Replay tolerates a torn
 final line (the crash happened mid-write): decoding stops at the first
-malformed line and everything before it is trusted.
+malformed line and everything before it is trusted.  A zero-length file —
+a crash between file creation and the fsync'd start record — means "no
+unfinished execution", never an error.
+
+Fencing (fleet HA, fleet/leases.py): with a `fence` attached, every
+append first checks the lease (`Fence.check` raises `FencedError` for a
+deposed holder — nothing is written) and stamps the live lease `epoch`
+into the record.  Replay tracks a running high-water epoch: a record
+whose epoch is BELOW an epoch already seen earlier in the file is a
+zombie's late write that slipped in before its fence tripped, and is
+ignored so it can never poison reconciliation.  Legitimate mixed epochs
+(a takeover resuming its predecessor's execution appends at a higher
+epoch) replay in full.
+
+Retention: `start_execution` rotates a FINISHED predecessor into an
+archive file (`<journal path>.<ms>.<id>.done`) instead of discarding
+it, so the journal dir accumulates one file per terminal execution;
+`prune_archives` (run during start-up reconciliation and after each
+rotation, per `executor.journal.retention.{count,hours}`) deletes
+terminal archives beyond the bounds and NEVER touches a file without a
+`finished` record — an unfinished journal awaiting recovery is
+sacrosanct.
 """
 
 from __future__ import annotations
@@ -34,6 +55,7 @@ import dataclasses
 import json
 import os
 import threading
+import uuid as uuid_mod
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
@@ -99,10 +121,19 @@ class ExecutionJournal:
     calls may append concurrently.
     """
 
-    def __init__(self, path: str, *, fsync_batch: int = 1):
+    def __init__(self, path: str, *, fsync_batch: int = 1, fence=None,
+                 retention_count: int | None = None,
+                 retention_hours: float | None = None):
+        """fence: fleet/leases.py Fence (or None outside fleet HA) — every
+        append checks it (FencedError for a deposed holder) and stamps its
+        epoch into the record.  retention_count/retention_hours bound the
+        archived terminal journals prune_archives() keeps."""
         self.path = os.path.abspath(os.path.expanduser(path))
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self.fsync_batch = max(1, int(fsync_batch))
+        self.fence = fence
+        self.retention_count = retention_count
+        self.retention_hours = retention_hours
         self._lock = threading.Lock()
         self._file = None  # opened lazily in append mode
         self._pending = 0
@@ -143,6 +174,12 @@ class ExecutionJournal:
                 f.truncate(good)
 
     def append(self, record: dict) -> None:
+        if self.fence is not None:
+            # the fence check happens BEFORE anything touches the file: a
+            # deposed holder's append raises FencedError and writes nothing;
+            # the live epoch is stamped so replay can spot any write that
+            # still raced the handover (prefix high-water filter)
+            record = dict(record, epoch=self.fence.check(op="journal.append"))
         line = json.dumps(record, separators=(",", ":"))
         with self._lock:
             self._ensure_open()
@@ -164,15 +201,102 @@ class ExecutionJournal:
                 self._fsync_locked()
 
     def start_execution(self, record: dict) -> None:
-        """Begin a new execution: truncate (the previous execution either
-        finished or was already reconciled) and durably write the start
-        record before any cluster mutation happens."""
+        """Begin a new execution: rotate a cleanly-FINISHED predecessor
+        into a terminal archive (`<path>.<ms>.<id>.done`, pruned by
+        prune_archives), truncate otherwise (an unfinished predecessor was
+        already reconciled), and durably write the start record before any
+        cluster mutation happens."""
+        if self.fence is not None:
+            # fenced BEFORE the rotation/truncation: a deposed holder must
+            # not destroy the journal the new holder will reconcile from
+            self.fence.check(op="journal.start")
         with self._lock:
             if self._file is not None:
                 self._file.close()
+                self._file = None
+            rotated = self._rotate_terminal_locked()
             self._file = open(self.path, "w", encoding="utf-8")  # noqa: SIM115
             self._pending = 0
+        if rotated:
+            # opportunistic retention at rotation time too: a long-lived
+            # process running many executions must not accumulate archives
+            # unboundedly between restarts
+            try:
+                self.prune_archives()
+            except OSError:
+                pass
         self.append(dict(record, t="start"))
+
+    def _rotate_terminal_locked(self) -> bool:
+        """Archive the previous journal file IF it recorded a finished
+        execution; unfinished (reconciled) or empty predecessors are
+        simply overwritten, exactly as before.  True if a file rotated."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        if not data or b'"t":"finished"' not in data:
+            return False
+        try:
+            ms = int(os.path.getmtime(self.path) * 1000)
+        except OSError:
+            ms = 0
+        archive = f"{self.path}.{ms}.{uuid_mod.uuid4().hex[:8]}.done"
+        try:
+            os.replace(self.path, archive)
+        except OSError:
+            return False  # rotation is best-effort; truncation proceeds
+        return True
+
+    def prune_archives(self, *, now_ms: int | None = None) -> int:
+        """Delete terminal journal archives beyond
+        `executor.journal.retention.{count,hours}`.  Runs during start-up
+        reconciliation.  Only files that verifiably contain a `finished`
+        record are ever removed — the live journal and anything unfinished
+        (a journal awaiting recovery) are untouched.  Returns the number
+        of files pruned."""
+        import time as _time
+
+        if self.retention_count is None and self.retention_hours is None:
+            return 0
+        d = os.path.dirname(self.path)
+        base = os.path.basename(self.path) + "."
+        archives: list[tuple[float, str]] = []
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return 0
+        for fn in names:
+            if fn.startswith(base) and fn.endswith(".done"):
+                p = os.path.join(d, fn)
+                try:
+                    archives.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+        archives.sort(reverse=True)  # newest first
+        doomed: set[str] = set()
+        if self.retention_count is not None:
+            doomed.update(p for _m, p in archives[max(0, self.retention_count):])
+        if self.retention_hours is not None:
+            now_s = (now_ms / 1000.0) if now_ms is not None else _time.time()
+            cutoff = now_s - self.retention_hours * 3600.0
+            doomed.update(p for m, p in archives if m < cutoff)
+        pruned = 0
+        for p in doomed:
+            try:
+                with open(p, "rb") as f:
+                    terminal = b'"t":"finished"' in f.read()
+            except OSError:
+                continue
+            if not terminal:
+                continue  # unfinished journals are never retention-pruned
+            try:
+                os.remove(p)
+                pruned += 1
+            except OSError:
+                pass
+        return pruned
 
     def close(self) -> None:
         with self._lock:
@@ -187,8 +311,16 @@ class ExecutionJournal:
     def replay(self) -> list[dict]:
         """Decode the journal, tolerating crash truncation: a torn final
         line (or any garbage after it) ends the replay; every record
-        before it is returned."""
+        before it is returned.  A zero-length file (crash between file
+        creation and the fsync'd start record) decodes to [].
+
+        Fencing: records carry the writer's lease epoch (fleet HA).  A
+        record whose epoch is BELOW the running high-water epoch of the
+        records before it is a deposed holder's late write — dropped, so
+        a zombie can never poison reconciliation.  Epoch-less records
+        (single-instance deployments) always replay."""
         records: list[dict] = []
+        high_water: int | None = None
         try:
             with open(self.path, encoding="utf-8", errors="replace") as f:
                 for line in f:
@@ -201,6 +333,11 @@ class ExecutionJournal:
                         break  # torn tail — trust only what decoded
                     if not isinstance(rec, dict) or "t" not in rec:
                         break
+                    epoch = rec.get("epoch")
+                    if isinstance(epoch, int):
+                        if high_water is not None and epoch < high_water:
+                            continue  # fenced below high water: zombie write
+                        high_water = epoch
                     records.append(rec)
         except OSError:
             return []
@@ -208,7 +345,8 @@ class ExecutionJournal:
 
     def unfinished_execution(self) -> "JournaledExecution | None":
         """The in-flight execution a crashed predecessor left behind, or
-        None when the journal is absent/empty/cleanly finished."""
+        None when the journal is absent, zero-length (created but never
+        started), or cleanly finished."""
         records = self.replay()
         if not records or records[0].get("t") != "start":
             return None

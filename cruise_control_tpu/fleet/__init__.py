@@ -1,5 +1,20 @@
 """Fleet controller — ONE tpu-cruise instance over N Kafka clusters."""
 
+from cruise_control_tpu.fleet.leases import (
+    FencedError,
+    FileLeaseStore,
+    Lease,
+    LeaseManager,
+    LeaseStore,
+)
 from cruise_control_tpu.fleet.manager import ClusterContext, FleetManager
 
-__all__ = ["ClusterContext", "FleetManager"]
+__all__ = [
+    "ClusterContext",
+    "FencedError",
+    "FileLeaseStore",
+    "FleetManager",
+    "Lease",
+    "LeaseManager",
+    "LeaseStore",
+]

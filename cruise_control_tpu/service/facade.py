@@ -222,10 +222,17 @@ class CruiseControl:
         sensors: SensorRegistry | None = None,
         core: AnalyzerCore | None = None,
         cluster_id: str | None = None,
+        fence=None,
     ):
+        """fence (fleet HA, fleet/leases.py): this cluster's lease fence.
+        When set, the execution journal stamps/checks its epoch, journal
+        reconciliation is DEFERRED until the fleet manager activates the
+        cluster post-acquisition, and every execution start gates on it —
+        a facade without the lease serves read-only."""
         self.config = config
         self.monitor = monitor
         self.admin = admin
+        self.fence = fence
         #: per-instance sensor catalog (module-global registries would mix
         #: counters across embedded instances; reference scopes its
         #: MetricRegistry per app, KafkaCruiseControlApp.java:39-41).  In a
@@ -284,6 +291,9 @@ class CruiseControl:
             journal = ExecutionJournal(
                 os.path.join(journal_dir, "execution-journal.jsonl"),
                 fsync_batch=config.get("executor.journal.fsync.batch.size"),
+                fence=fence,
+                retention_count=config.get("executor.journal.retention.count"),
+                retention_hours=config.get("executor.journal.retention.hours"),
             )
         self.executor = Executor(
             admin,
@@ -301,6 +311,9 @@ class CruiseControl:
             ),
             notifier=notifier_cls() if notifier_cls is not None else None,
             journal=journal,
+            # HA: reconciliation sweeps throttles on the live cluster —
+            # it must wait for lease acquisition (FleetManager activates)
+            defer_recovery=fence is not None,
         )
         if self.executor.recovery_info() is not None:
             log.warning(
@@ -592,11 +605,7 @@ class CruiseControl:
             # re-adopted moves progress without resubmission while the
             # service comes up (reference resumes its persisted execution
             # the same way)
-            threading.Thread(
-                target=self.executor.resume_recovered_execution,
-                daemon=True,
-                name="executor-recovery",
-            ).start()
+            self.resume_recovered_async()
         if self.controller is not None:
             # the streaming controller IS the always-on precompute: it
             # publishes a fresh proposal every window roll, so the legacy
@@ -611,6 +620,26 @@ class CruiseControl:
                 target=self._precompute_loop, daemon=True, name="proposal-precompute"
             )
             self._precompute_thread.start()
+
+    def resume_recovered_async(self):
+        """Background-drive a journal-reconciled execution remainder.
+        FencedError mid-resume (fleet HA: the lease was lost again) is an
+        ordinary step-down, not a crashed thread."""
+
+        def run():
+            try:
+                self.executor.resume_recovered_execution()
+            except Exception as e:  # noqa: BLE001 — classify below
+                from cruise_control_tpu.fleet.leases import FencedError
+
+                if isinstance(e, FencedError):
+                    log.warning(
+                        "recovery resume fenced (lease lost): %s", e
+                    )
+                else:
+                    log.warning("recovery resume failed", exc_info=True)
+
+        threading.Thread(target=run, daemon=True, name="executor-recovery").start()
 
     def shutdown(self):
         self._stop_precompute.set()
@@ -954,6 +983,11 @@ class CruiseControl:
         movements_per_broker, concurrent_leader_movements,
         replication_throttle)."""
         progress.add_step(ExecutingProposals())
+        if self.fence is not None:
+            # fleet HA: only the lease holder may start an execution — a
+            # degraded (read-only) facade fails the request up front with
+            # FencedError instead of fencing mid-batch
+            self.fence.check(op="execute")
         ov = execution_overrides or {}
         proposals = list(result.proposals) + list(extra_proposals or [])
         strategy = None
@@ -1302,6 +1336,8 @@ class CruiseControl:
             "proposals": [p.to_json() for p in proposals[:100]],
         }
         if not dryrun and proposals:
+            if self.fence is not None:
+                self.fence.check(op="execute")
             self.executor.catalog = self.monitor.last_catalog
             progress.add_step(ExecutingProposals())
             r = self.executor.execute_proposals(
@@ -1332,6 +1368,8 @@ class CruiseControl:
             "proposals": [p.to_json() for p in proposals[:100]],
         }
         if not dryrun and proposals:
+            if self.fence is not None:
+                self.fence.check(op="execute")
             self.executor.catalog = self.monitor.last_catalog
             progress.add_step(ExecutingProposals())
             r = self.executor.execute_proposals(proposals, self._exec_options())

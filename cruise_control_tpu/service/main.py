@@ -38,12 +38,15 @@ def _build_cluster_stack(
     partitions_fn=None,
     core=None,
     cluster_id: str | None = None,
+    fence=None,
 ):
     """Wire ONE cluster's monitoring + facade stack: capacity resolver,
     aggregators, fetcher, monitor, task runner, and the CruiseControl
     facade.  `core`/`cluster_id` are the fleet seam — a shared
     AnalyzerCore makes this facade one tenant of a fleet; None keeps the
-    classic self-contained build.  Returns (cc, fetcher, task_runner)."""
+    classic self-contained build.  `fence` (fleet HA) is the cluster's
+    lease fence — the journal stamps it and recovery defers to lease
+    acquisition.  Returns (cc, fetcher, task_runner)."""
     if capacity_resolver is None:
         resolver_cls = config.get("broker.capacity.config.resolver.class")
         path = config.get("capacity.config.file")
@@ -151,7 +154,8 @@ def _build_cluster_stack(
         auto_train=config.get("use.linear.regression.model"),
     )
     cc = CruiseControl(
-        config, monitor, admin, sensors=sensors, core=core, cluster_id=cluster_id
+        config, monitor, admin, sensors=sensors, core=core,
+        cluster_id=cluster_id, fence=fence,
     )
     cc.task_runner = task_runner
     # warm restart: replay the sample store off the startup path (reference
@@ -200,6 +204,7 @@ def build_fleet_service(
     backends: dict,
     *,
     sample_stores: dict | None = None,
+    ha_clock=None,
 ) -> tuple[CruiseControlApp, "FleetManager"]:
     """ONE service instance over N Kafka clusters (fleet/manager.py).
 
@@ -209,7 +214,14 @@ def build_fleet_service(
     evaluator + tracer) and, per cluster, its own monitor/fetcher/executor
     stack from `config.cluster_config(id)` (base config + fleet.<id>.*
     overrides), a cluster-labeled SensorRegistry, and a journal under
-    <executor.journal.dir>/<id>/.  Returns (app, fleet_manager)."""
+    <executor.journal.dir>/<id>/.  Returns (app, fleet_manager).
+
+    With `fleet.ha.enabled` (fleet/leases.py): a FileLeaseStore in
+    <executor.journal.dir>/_leases shards ownership across the M
+    instances pointed at the same journal dir — each cluster's admin is
+    wrapped in a FencedClusterAdmin and its journal fenced on the lease
+    epoch, and contexts only start once this instance holds the lease.
+    `ha_clock` injects the instance clock (tests/benches)."""
     from cruise_control_tpu.common.compilation_cache import enable_persistent_cache
     from cruise_control_tpu.common.sensors import SensorRegistry
     from cruise_control_tpu.fleet.manager import ClusterContext, FleetManager
@@ -223,23 +235,79 @@ def build_fleet_service(
         raise ValueError(f"no backend supplied for fleet clusters {missing}")
     enable_persistent_cache(config.compile_cache_dir())
     shared_sensors = SensorRegistry()
+    lease_manager = None
+    if config.get("fleet.ha.enabled"):
+        lease_manager = _build_lease_manager(
+            config, ids, sensors=shared_sensors, clock=ha_clock
+        )
     core = AnalyzerCore(config, sensors=shared_sensors)
     contexts: dict[str, ClusterContext] = {}
     for cid in ids:
         metadata, admin, sampler = backends[cid]
+        fence = None
+        if lease_manager is not None:
+            from cruise_control_tpu.executor.admin import FencedClusterAdmin
+
+            fence = lease_manager.fence(cid)
+            # every cluster mutation this instance ever issues rides the
+            # fenced wrapper — a lost lease turns the whole admin surface
+            # read-only at the SPI boundary
+            admin = FencedClusterAdmin(admin, fence)
         cc, fetcher, task_runner = _build_cluster_stack(
             config.cluster_config(cid), metadata, admin, sampler,
             sensors=SensorRegistry(base_labels={"cluster": cid}),
             sample_store=(sample_stores or {}).get(cid),
             core=core,
             cluster_id=cid,
+            fence=fence,
         )
         contexts[cid] = ClusterContext(
             cid, cc, fetcher=fetcher, task_runner=task_runner
         )
-    fleet = FleetManager(core, contexts, sensors=shared_sensors, config=config)
+    fleet = FleetManager(
+        core, contexts, sensors=shared_sensors, config=config,
+        lease_manager=lease_manager,
+    )
     app = CruiseControlApp(contexts[ids[0]].cc, fleet=fleet)
     return app, fleet
+
+
+def _build_lease_manager(config, cluster_ids, *, sensors, clock=None):
+    """FileLeaseStore + LeaseManager from the fleet.ha.* keys; the store
+    lives in <executor.journal.dir>/_leases (the journal dir IS the
+    fleet's shared durable state — requiring it keeps the HA story on
+    one mount)."""
+    import os
+    import socket
+
+    from cruise_control_tpu.fleet.leases import FileLeaseStore, LeaseManager
+
+    journal_dir = config.get("executor.journal.dir")
+    if not journal_dir:
+        raise ValueError(
+            "fleet.ha.enabled requires executor.journal.dir: the lease "
+            "store lives in <journal.dir>/_leases and a takeover replays "
+            "the dead holder's journal from the same mount"
+        )
+    instance_id = config.get("fleet.ha.instance.id") or (
+        f"{socket.gethostname()}-{os.getpid()}"
+    )
+    skew = config.get("fleet.ha.skew.slack.s")
+    store = FileLeaseStore(
+        os.path.join(os.path.expanduser(journal_dir), "_leases"),
+        skew_slack_s=skew,
+        clock=clock,
+    )
+    return LeaseManager(
+        store,
+        cluster_ids,
+        holder_id=instance_id,
+        ttl_s=config.get("fleet.ha.lease.ttl.s"),
+        renew_s=config.get("fleet.ha.renew.s"),
+        skew_slack_s=skew,
+        clock=clock,
+        sensors=sensors,
+    )
 
 
 def parse_bootstrap_servers(bootstrap_servers: str) -> list[tuple[str, int]]:
@@ -390,13 +458,18 @@ def build_simulated_fleet(
     clusters: dict[str, dict] | None = None,
     seed: int = 0,
     sampled_windows: int = 3,
+    backends: dict | None = None,
+    ha_clock=None,
 ):
     """Full in-process FLEET over N simulated clusters — the embedded
-    harness for fleet tests and `bench.py --fleet-smoke`.
+    harness for fleet tests and `bench.py --fleet-smoke`/`--ha-smoke`.
 
     `clusters`: {cluster_id: synthetic_topology kwargs}; the default is 3
     clusters, two of which share a bucketed model shape (so they must
-    share one compiled engine through the fleet's AnalyzerCore)."""
+    share one compiled engine through the fleet's AnalyzerCore).
+    `backends`: pre-built {cluster_id: (metadata, admin, sampler)} —
+    fleet-HA harnesses pass the SAME backends to two instances so both
+    "see" one set of simulated Kafka clusters."""
     from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
     from cruise_control_tpu.monitor.topology import StaticMetadataProvider
     from cruise_control_tpu.testing.synthetic import (
@@ -426,19 +499,18 @@ def build_simulated_fleet(
     }
     base.update(props or {})
     config = CruiseControlConfig(base)
-    backends = {}
-    samplers = {}
-    for i, (cid, spec) in enumerate(clusters.items()):
-        topo = synthetic_topology(seed=seed + i, **spec)
-        metadata = StaticMetadataProvider(topo)
-        admin = SimulatedClusterAdmin(metadata, link_rate_bytes_per_s=1e12)
-        sampler = SyntheticWorkloadSampler(topo, seed=seed + i)
-        backends[cid] = (metadata, admin, sampler)
-        samplers[cid] = sampler
-    app, fleet = build_fleet_service(config, backends)
+    if backends is None:
+        backends = {}
+        for i, (cid, spec) in enumerate(clusters.items()):
+            topo = synthetic_topology(seed=seed + i, **spec)
+            metadata = StaticMetadataProvider(topo)
+            admin = SimulatedClusterAdmin(metadata, link_rate_bytes_per_s=1e12)
+            sampler = SyntheticWorkloadSampler(topo, seed=seed + i)
+            backends[cid] = (metadata, admin, sampler)
+    app, fleet = build_fleet_service(config, backends, ha_clock=ha_clock)
     window_ms = config.get("partition.metrics.window.ms")
     for cid, ctx in fleet.contexts.items():
-        parts = samplers[cid].all_partition_entities()
+        parts = backends[cid][2].all_partition_entities()
         for w in range(sampled_windows + 1):
             ctx.fetcher.fetch_once(parts, w * window_ms, (w + 1) * window_ms - 1)
     return app, fleet

@@ -238,14 +238,17 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
     "metrics": Schema((), allow_extra=True),
     # --- fleet controller ---
     # GET /fleet: whole-instance rollup — per-cluster summaries under
-    # `clusters`, the shared-core view (engine cache, supervisor,
-    # admission control) under `shared`, and with ?score=true the batched
-    # per-cluster placement scores under `scores`
+    # `clusters` (each carrying an `ownership` block in fleet-HA mode),
+    # the shared-core view (engine cache, supervisor, admission control)
+    # under `shared`, with ?score=true the batched per-cluster placement
+    # scores under `scores`, and in fleet-HA mode the instance's lease
+    # view (instanceId, ttl/renew/skew, ownedClusters) under `ha`
     "fleet": Schema((
         Field("numClusters", NUM),
         Field("clusters", DICT),
         Field("shared", DICT),
         Field("scores", DICT, required=False),
+        Field("ha", DICT, required=False),
     )),
 }
 

@@ -388,3 +388,85 @@ def truncate_file(path: str, *, keep_bytes: int | None = None, drop_bytes: int =
     keep = keep_bytes if keep_bytes is not None else max(0, size - drop_bytes)
     with open(path, "rb+") as f:
         f.truncate(keep)
+
+
+# ----------------------------------------------------------------------
+# fleet-HA injection: lease-store partitions + per-instance clock skew
+# ----------------------------------------------------------------------
+
+#: the LeaseStore contract surface the partition injector can sever
+LEASE_OPS = ("acquire", "renew", "release", "read")
+
+
+@contextlib.contextmanager
+def lease_partition(store, *, ops=LEASE_OPS, schedule: FaultSchedule = ALWAYS,
+                    mode: str = "fail"):
+    """Partition an instance from its lease store: scheduled calls to the
+    given LeaseStore methods either raise OSError (`mode="fail"` — the
+    store is unreachable) or block until the context exits
+    (`mode="hang"` — the classic stalled-writer shape: the instance
+    neither renews nor learns it lost).  Call counts land in the yielded
+    InjectionLog per method, like every other injector here.
+
+    The store object is patched per INSTANCE, so a two-instance harness
+    can partition one instance's view while the other keeps working —
+    exactly the asymmetric partition that forces a takeover."""
+    if mode not in ("fail", "hang"):
+        raise ValueError(f"lease_partition mode {mode!r} not in (fail, hang)")
+    log = InjectionLog()
+    release = threading.Event()
+    originals = {name: getattr(store, name) for name in ops}
+    owned = {
+        name: isinstance(store, type) or name in vars(store) for name in ops
+    }
+
+    def make_wrapper(name, orig):
+        def wrapper(*args, **kwargs):
+            n = log._record(name)
+            if schedule.fires(n):
+                log._mark_fired(name)
+                if mode == "hang":
+                    release.wait()
+                    # the partition healed: the late call completes for
+                    # real (its staleness is the lease layer's problem —
+                    # that is the point)
+                    return orig(*args, **kwargs)
+                raise OSError(f"injected lease-store partition in {name}")
+            return orig(*args, **kwargs)
+
+        return wrapper
+
+    for name, orig in originals.items():
+        setattr(store, name, make_wrapper(name, orig))
+    try:
+        yield log
+    finally:
+        release.set()
+        for name, orig in originals.items():
+            if owned[name]:
+                setattr(store, name, orig)
+            else:
+                delattr(store, name)
+
+
+@contextlib.contextmanager
+def clock_skew(target, offset_s: float):
+    """Skew one instance's clock by `offset_s` seconds: patches the
+    injectable `clock` attribute (LeaseManager and FileLeaseStore both
+    carry one) so every read returns real+offset.  Yields an
+    InjectionLog counting reads under "clock".  Skew within
+    `fleet.ha.skew.slack.s` must be invisible; beyond it, the safety
+    argument no longer covers the instance — chaos tests probe both
+    sides of that line."""
+    log = InjectionLog()
+    orig = target.clock
+
+    def skewed():
+        log._record("clock")
+        return orig() + offset_s
+
+    target.clock = skewed
+    try:
+        yield log
+    finally:
+        target.clock = orig
