@@ -868,7 +868,8 @@ def fleet_smoke() -> int:
         for cid in per_cluster
     )
     ok_engines = engines < len(fleet.contexts) and hits >= 1
-    ok = ok_wall and ok_engines
+    sched_report = _scheduler_burst()
+    ok = ok_wall and ok_engines and sched_report["ok"]
     _emit(
         metric="fleet_smoke",
         value=round(max(per_cluster.values()), 4),
@@ -882,10 +883,151 @@ def fleet_smoke() -> int:
         wall_ratio={k: round(v, 3) for k, v in ratios.items()},
         ok_engines=ok_engines,
         ok_wall=ok_wall,
+        scheduler=sched_report,
         ok=ok,
     )
     fleet.shutdown()
     return 0 if ok else 1
+
+
+def _scheduler_burst(n_clusters: int = 20, duration_s: float = 2.0) -> dict:
+    """Device-scheduler overload gate (stepping toward the ROADMAP
+    `bench.py --fleet` 100-cluster freshness-SLO gate): a 20-cluster
+    synthetic burst of BACKGROUND drift cycles under `device_slowdown`,
+    with URGENT broker-failure-fix dispatches injected throughout.
+
+    Reports per-class p50/p99 queue-to-dispatch wait + deadline-miss
+    ratio and GATES: urgent p99 wait <= one slice budget, zero urgent
+    sheds, every shed counted in fleet.scheduler.shed-total.  Synthetic
+    device work (sleep-shaped slices through the @device_op seam) keeps
+    the burst deterministic and CPU-cheap — the engine-level parity and
+    preemption mechanics are pinned by tests/test_scheduler.py."""
+    import threading
+
+    from cruise_control_tpu.common.device_watchdog import device_op
+    from cruise_control_tpu.fleet.scheduler import (
+        BackgroundShedError,
+        DeviceScheduler,
+        WorkClass,
+    )
+    from cruise_control_tpu.testing import faults
+
+    slice_s = 0.05
+    slowdown = 3.0
+    sched = DeviceScheduler(
+        slice_budget_s=slice_s * slowdown * 1.5,
+        freshness_slo_s=1.0,
+        aging_s=0.5,
+        shed_queue_depth=max(4, n_clusters // 3),
+        brownout_after_s=duration_s / 2,
+    )
+
+    @device_op("engine.run")
+    def device_slice():
+        time.sleep(slice_s)
+
+    from cruise_control_tpu.analyzer.engine import current_segment_context
+
+    def background_cycle():
+        ctx = current_segment_context()
+        for i in range(3):
+            device_slice()
+            if ctx is not None and ctx.checkpoint is not None and i < 2:
+                ctx.checkpoint()
+
+    stop = threading.Event()
+    count_lock = threading.Lock()
+    shed_count = [0]
+    brownout_runs = [0]
+
+    def cluster_loop(cid):
+        while not stop.is_set():
+            try:
+                if sched.brownout_active:
+                    with count_lock:
+                        brownout_runs[0] += 1
+                sched.run(
+                    WorkClass.BACKGROUND, background_cycle,
+                    cluster_id=f"c{cid}", op="controller-cycle",
+                )
+            except BackgroundShedError:
+                # locked: 20 threads race this count, and the gate below
+                # compares it for EXACT equality with the scheduler's own
+                # lock-protected shed counter
+                with count_lock:
+                    shed_count[0] += 1
+                time.sleep(0.02)
+
+    urgent_waits: list[float] = []
+    urgent_device_s = slice_s * slowdown
+    with faults.device_slowdown(slowdown) as log:
+        threads = [
+            threading.Thread(target=cluster_loop, args=(i,), daemon=True)
+            for i in range(n_clusters)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # let the burst pile up
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            sched.run(
+                WorkClass.URGENT, device_slice, cluster_id="cX",
+                op="fix:broker-failure",
+            )
+            urgent_waits.append(time.monotonic() - t0 - urgent_device_s)
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+
+    def pct(xs, p):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(round(p * (len(xs) - 1))))]
+
+    st = sched.state_json()
+    dispatches = sum(st["dispatches"].values())
+    misses = st["deadlineMisses"]
+    per_class = {
+        cls: dict(
+            p50=round(pct([w for w in waits], 0.50), 4),
+            p99=round(pct([w for w in waits], 0.99), 4),
+            missRatio=round(
+                misses[cls] / max(1, st["dispatches"][cls]), 3
+            ),
+        )
+        for cls, waits in (("urgent", urgent_waits),)
+    }
+    urgent_p99 = pct(urgent_waits, 0.99)
+    ok_urgent = urgent_p99 <= sched.slice_budget_s
+    ok_sheds = (
+        st["shedTotal"]["urgent"] == 0
+        and st["shedTotal"]["background"] == shed_count[0]
+        and shed_count[0] >= 1
+    )
+    return dict(
+        clusters=n_clusters,
+        sliceBudgetS=sched.slice_budget_s,
+        urgentInjected=len(urgent_waits),
+        urgentWait=per_class["urgent"],
+        waitSeconds=st.get("waitSeconds"),
+        deadlineMissRatioByClass={
+            c: round(misses[c] / max(1, st["dispatches"][c]), 3)
+            for c in misses
+        },
+        dispatches=st["dispatches"],
+        totalDispatches=dispatches,
+        shedTotal=st["shedTotal"],
+        preemptions=st["preemptions"],
+        overloadEpisodes=st["overloadEpisodes"],
+        brownoutRuns=brownout_runs[0],
+        deviceOpCalls=log.total_calls,
+        ok_urgent_p99=ok_urgent,
+        ok_sheds_counted=ok_sheds,
+        ok=ok_urgent and ok_sheds,
+    )
 
 
 def ha_smoke() -> int:

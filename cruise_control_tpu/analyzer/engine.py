@@ -68,6 +68,8 @@ so at T=0 with a fixed seed they produce identical move trajectories.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import logging
 import time
@@ -542,6 +544,58 @@ FUSED_YS_KEYS = ("accepted", "ran", "stopped", "temperature", "cheap")
 #: disagree on how many checks (and therefore rounds) may run
 FULL_CHECK_BUDGET = 2
 
+#: cap on the segmented runner's rounds-per-slice growth: bounds the
+#: number of distinct slice lengths (and therefore compiled slice
+#: programs) per engine to log2(cap)+1
+SEGMENT_MAX_ROUNDS = 64
+
+
+class SegmentContext:
+    """Preemptible-execution request for one fused anneal (the device
+    scheduler's bounded-wall preemption, fleet/scheduler.py).
+
+    `slice_budget_s` bounds each device dispatch's wall clock
+    (`fleet.scheduler.slice.budget.s`): the engine splits the round
+    schedule into slices sized so one slice stays within the budget.
+    `checkpoint` is called between slices on the dispatching thread — the
+    scheduler uses it to pause this run while an URGENT request takes the
+    device, so an urgent anneal never waits on more than ONE slice of
+    background work.  The callback may block; when it returns, the run
+    resumes from the carried scan state, byte-identically."""
+
+    __slots__ = ("slice_budget_s", "checkpoint")
+
+    def __init__(self, slice_budget_s: float, checkpoint=None):
+        self.slice_budget_s = slice_budget_s
+        self.checkpoint = checkpoint
+
+
+#: ambient segmented-execution request, set by the device scheduler
+#: around a granted non-urgent dispatch.  A contextvar (not a thread
+#: local) because the DeviceSupervisor runs the engine body on a worker
+#: thread with the caller's context COPIED in — the seam must survive
+#: that hop.  None (the default) keeps every run on the plain fused
+#: path, byte-for-byte.
+_SEGMENT_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "engine_segment_context", default=None
+)
+
+
+def current_segment_context() -> SegmentContext | None:
+    return _SEGMENT_CTX.get()
+
+
+@contextlib.contextmanager
+def segmented_execution(ctx: SegmentContext):
+    """Run the enclosed dispatches in wall-bounded preemptible slices.
+    Only the single-device fused path honors it (mesh programs cannot be
+    split mid-collective); everything else ignores the context."""
+    token = _SEGMENT_CTX.set(ctx)
+    try:
+        yield
+    finally:
+        _SEGMENT_CTX.reset(token)
+
 
 class _FlatCallAdapter:
     """Adapter giving an AOT-deserialized FLAT executable the plain
@@ -798,6 +852,11 @@ class Engine:
         # EngineCarry at 500k-replica scale, not one per dispatch
         self._jit_run_fused = jax.jit(self._run_fused_impl, donate_argnums=(1,))
         self._jit_run_fused_verbose = None  # built lazily (adds per-round eval)
+        #: segmented (preemptible) execution programs, built lazily on the
+        #: first scheduler-granted slice run: the init program plus one
+        #: slice program per rounds-per-slice length (powers of two)
+        self._jit_seg_init = None
+        self._seg_fns: dict[int, object] = {}
         self._warm_futures: dict | None = None
         #: analyzer/prewarm.py PrewarmStore — when present, precompile
         #: loads/saves the fused program's AOT artifact (warm-pool workers
@@ -1058,6 +1117,8 @@ class Engine:
                         pass
         self.statics = None
         self._warm_futures = None
+        self._seg_fns = {}
+        self._jit_seg_init = None
 
     # ------------------------------------------------------------------
     # state <-> carry
@@ -2437,94 +2498,240 @@ class Engine:
         stays on device for the result report to consume.
         """
         cfg = self.config
-        n_main = cfg.num_rounds
-        total = n_main + cfg.extra_round_budget
-        tol_on = cfg.early_stop_violations >= 0.0
-        tol = jnp.float32(cfg.early_stop_tol)
-
-        obj0, _ = self._eval_impl(sx, carry)
-        t0 = obj0 * cfg.init_temperature_scale
-        plan0 = self._plan_impl(sx, carry)
+        total = cfg.num_rounds + cfg.extra_round_budget
+        t0, plan0 = self._schedule_init(sx, carry)
 
         def round_body(st, rnd):
-            carry, plan, cheap_prev, done, checks_left, prev_v, has_prev = st
-            active = ~done
-            is_extra = rnd >= n_main
-            main_stop = jnp.bool_(False)
-            run = active
-            if tol_on:
-                # main-round gate: the previous round's cheap O(B) signal
-                # opens the bounded authoritative check (legacy
-                # full_checks_left semantics); extra-round gate: the
-                # full-chain violation decides continue/stop every round
-                main_gate = (
-                    active & ~is_extra & (rnd > 0)
-                    & (checks_left > 0) & (cheap_prev <= tol)
-                )
-                extra_gate = active & is_extra
-                need_full = main_gate | extra_gate
-                full_v = jax.lax.cond(
-                    need_full,
-                    lambda: self._eval_impl(sx, carry)[1],
-                    lambda: jnp.float32(jnp.inf),
-                )
-                main_stop = main_gate & (full_v <= tol)
-                checks_left = jnp.where(
-                    main_gate & ~main_stop, checks_left - 1, checks_left
-                )
-                extra_stop = extra_gate & (
-                    (full_v <= tol) | (has_prev & (full_v > prev_v * 0.9))
-                )
-                stop = main_stop | extra_stop
-                done = done | stop
-                run = active & ~stop
-                prev_v = jnp.where(run & is_extra, full_v, prev_v)
-                has_prev = has_prev | (run & is_extra)
-
-            t_r = jnp.where(
-                is_extra | (rnd == n_main - 1),
-                jnp.float32(0.0),
-                t0 * cfg.temperature_decay ** rnd.astype(jnp.float32),
-            ).astype(jnp.float32)
-
-            def do_round(carry, plan):
-                temps = jnp.full((cfg.steps_per_round,), t_r, jnp.float32)
-                carry, stats = self._scan_impl(sx, carry, temps, plan)
-                carry, plan, cheap = self._round_prep_impl(sx, carry)
-                return carry, plan, cheap, stats["accepted"].sum()
-
-            carry, plan, cheap_prev, acc = jax.lax.cond(
-                run,
-                do_round,
-                lambda c, p: (c, p, jnp.float32(jnp.inf), jnp.int32(0)),
-                carry,
-                plan,
-            )
-            # `stopped` marks only the MAIN early stop: the legacy history
-            # flags early_stop on the round whose post-refresh state
-            # satisfied the full chain, never on an extra-round exit
-            ys = dict(
-                accepted=acc, ran=run, stopped=main_stop, temperature=t_r,
-                cheap=cheap_prev,
-            )
-            assert set(ys) == set(FUSED_YS_KEYS), (
-                "fused ys keys drifted from FUSED_YS_KEYS — update both, "
-                "or AOT artifacts unflatten the wrong structure"
-            )
-            if verbose:
-                ys["objective"] = jax.lax.cond(
-                    run,
-                    lambda: self._eval_impl(sx, carry)[0],
-                    lambda: jnp.float32(jnp.nan),
-                )
-            return (carry, plan, cheap_prev, done, checks_left, prev_v, has_prev), ys
+            return self._fused_round_step(sx, st, rnd, verbose=verbose)
 
         init = (
             carry, plan0, jnp.float32(jnp.inf), jnp.bool_(False),
             jnp.int32(FULL_CHECK_BUDGET), jnp.float32(jnp.inf), jnp.bool_(False),
+            t0,
         )
         (carry, *_), ys = jax.lax.scan(round_body, init, jnp.arange(total))
         return carry, ys
+
+    def _schedule_init(self, sx: EngineStatics, carry: EngineCarry):
+        """(t0, plan0) of a fresh anneal: the initial temperature scale and
+        round-0 sampling plan.  Shared by the whole-anneal fused program
+        (inlined) and the segmented runner's init program (standalone) —
+        the same traced subprograms, so both paths see identical values
+        (the legacy loop already computes them standalone; fused-vs-legacy
+        parity is pinned by tests)."""
+        obj0, _ = self._eval_impl(sx, carry)
+        return obj0 * self.config.init_temperature_scale, self._plan_impl(sx, carry)
+
+    def _fused_round_step(self, sx: EngineStatics, st, rnd, *, verbose: bool):
+        """ONE round of the fused schedule — the scan body shared verbatim
+        by the whole-anneal program and the segmented slice programs, so
+        a segmented run is byte-identical to the unsegmented one by
+        construction.  `st` carries (carry, plan, cheap_prev, done,
+        checks_left, prev_v, has_prev, t0); `rnd` is the ABSOLUTE round
+        index (a slice scans base+arange(L)); rounds past the schedule
+        (`rnd >= total` — a slice overhanging the end) are cond-masked
+        no-ops exactly like post-early-stop rounds."""
+        cfg = self.config
+        n_main = cfg.num_rounds
+        total = n_main + cfg.extra_round_budget
+        tol_on = cfg.early_stop_violations >= 0.0
+        tol = jnp.float32(cfg.early_stop_tol)
+        carry, plan, cheap_prev, done, checks_left, prev_v, has_prev, t0 = st
+        in_range = rnd < total
+        active = ~done & in_range
+        is_extra = rnd >= n_main
+        main_stop = jnp.bool_(False)
+        run = active
+        if tol_on:
+            # main-round gate: the previous round's cheap O(B) signal
+            # opens the bounded authoritative check (legacy
+            # full_checks_left semantics); extra-round gate: the
+            # full-chain violation decides continue/stop every round
+            main_gate = (
+                active & ~is_extra & (rnd > 0)
+                & (checks_left > 0) & (cheap_prev <= tol)
+            )
+            extra_gate = active & is_extra
+            need_full = main_gate | extra_gate
+            full_v = jax.lax.cond(
+                need_full,
+                lambda: self._eval_impl(sx, carry)[1],
+                lambda: jnp.float32(jnp.inf),
+            )
+            main_stop = main_gate & (full_v <= tol)
+            checks_left = jnp.where(
+                main_gate & ~main_stop, checks_left - 1, checks_left
+            )
+            extra_stop = extra_gate & (
+                (full_v <= tol) | (has_prev & (full_v > prev_v * 0.9))
+            )
+            stop = main_stop | extra_stop
+            done = done | stop
+            run = active & ~stop
+            prev_v = jnp.where(run & is_extra, full_v, prev_v)
+            has_prev = has_prev | (run & is_extra)
+
+        t_r = jnp.where(
+            is_extra | (rnd == n_main - 1),
+            jnp.float32(0.0),
+            t0 * cfg.temperature_decay ** rnd.astype(jnp.float32),
+        ).astype(jnp.float32)
+
+        def do_round(carry, plan):
+            temps = jnp.full((cfg.steps_per_round,), t_r, jnp.float32)
+            carry, stats = self._scan_impl(sx, carry, temps, plan)
+            carry, plan, cheap = self._round_prep_impl(sx, carry)
+            return carry, plan, cheap, stats["accepted"].sum()
+
+        carry, plan, cheap_prev, acc = jax.lax.cond(
+            run,
+            do_round,
+            lambda c, p: (c, p, jnp.float32(jnp.inf), jnp.int32(0)),
+            carry,
+            plan,
+        )
+        # `stopped` marks only the MAIN early stop: the legacy history
+        # flags early_stop on the round whose post-refresh state
+        # satisfied the full chain, never on an extra-round exit
+        ys = dict(
+            accepted=acc, ran=run, stopped=main_stop, temperature=t_r,
+            cheap=cheap_prev,
+        )
+        assert set(ys) == set(FUSED_YS_KEYS), (
+            "fused ys keys drifted from FUSED_YS_KEYS — update both, "
+            "or AOT artifacts unflatten the wrong structure"
+        )
+        if verbose:
+            ys["objective"] = jax.lax.cond(
+                run,
+                lambda: self._eval_impl(sx, carry)[0],
+                lambda: jnp.float32(jnp.nan),
+            )
+        return (
+            carry, plan, cheap_prev, done, checks_left, prev_v, has_prev, t0
+        ), ys
+
+    # ------------------------------------------------------------------
+    # segmented (preemptible) fused execution — fleet/scheduler.py
+    # ------------------------------------------------------------------
+
+    def _seg_init_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """Round-0 scan state of the fused schedule as ONE standalone
+        program (the segmented runner's prelude): exactly the init the
+        whole-anneal program builds in-graph."""
+        t0, plan0 = self._schedule_init(sx, carry)
+        return (
+            plan0, jnp.float32(jnp.inf), jnp.bool_(False),
+            jnp.int32(FULL_CHECK_BUDGET), jnp.float32(jnp.inf),
+            jnp.bool_(False), t0,
+        )
+
+    def _seg_slice_impl(self, L: int, sx, carry, seg, base):
+        """Rounds [base, base+L) of the fused schedule: the SAME round
+        body as the whole-anneal scan, over a slice of the round indices,
+        with the full scan state (carry + plan + early-stop flags + t0)
+        carried in and out — splitting a scan into consecutive sub-scans
+        of the same body is composition, not approximation.  carry and
+        seg are donated: HBM holds one placement copy across slices like
+        the unsegmented run."""
+
+        def round_body(st, rnd):
+            return self._fused_round_step(sx, st, rnd, verbose=False)
+
+        (carry, *seg), ys = jax.lax.scan(
+            round_body, (carry, *seg), base + jnp.arange(L)
+        )
+        return carry, tuple(seg), ys
+
+    def _seg_fn(self, L: int):
+        fn = self._seg_fns.get(L)
+        if fn is None:
+            fn = jax.jit(partial(self._seg_slice_impl, L), donate_argnums=(1, 2))
+            self._seg_fns[L] = fn
+        return fn
+
+    def _run_segmented(self, seg_ctx: SegmentContext, *, initial_placement=None):
+        """The fused anneal in wall-bounded preemptible slices.
+
+        The fused program cannot be interrupted mid-XLA-execution, so the
+        device scheduler's bounded-wall preemption needs the schedule cut
+        into separately dispatched slices: run `L` rounds, block until the
+        device is actually idle, call `seg_ctx.checkpoint()` (the
+        scheduler pauses us here while an URGENT request runs), repeat.
+        `L` adapts to `seg_ctx.slice_budget_s` from a measured per-round
+        wall EWMA, in powers of two (<= SEGMENT_MAX_ROUNDS) so at most
+        log2 distinct slice programs compile per engine.
+
+        Byte parity with the unsegmented run holds by construction: every
+        slice scans the SAME `_fused_round_step` body over consecutive
+        absolute round indices with the full scan state carried across
+        dispatches on device (slices overhanging the schedule are masked
+        no-op rounds), and the warm-start path rides the same
+        `init_carry_from` copy-in — pinned by tests/test_scheduler.py.
+        The cost of preemptibility is one blocking sync per slice instead
+        of one per run (reported in the timing record)."""
+        cfg = self.config
+        sx = self.statics
+        t_start = time.monotonic()
+        # the slice programs are plain jits outside the AOT tier — their
+        # first segmented run traces fresh, and cold-start accounting
+        # must say so (once per engine, like the unsegmented path)
+        self._record_fused_trace("fresh")
+        carry = self._init_for_run(initial_placement)
+        if self._jit_seg_init is None:
+            self._jit_seg_init = jax.jit(self._seg_init_impl)
+        seg = self._jit_seg_init(sx, carry)
+        total = cfg.num_rounds + cfg.extra_round_budget
+        budget = max(1e-3, float(seg_ctx.slice_budget_s))
+        ys_parts: list[dict] = []
+        base = 0
+        device_s = 0.0
+        round_wall = None
+        L = 1
+        while base < total:
+            # a slice length's FIRST dispatch pays the slice program's
+            # trace+compile — that wall must not feed the per-round
+            # estimate, or every growth step re-inflates the EWMA and
+            # collapses the next length back toward 1 (extra syncs for
+            # nothing); the very first slice has no other estimate, so
+            # its (polluted, conservative) sample is kept and later
+            # steady-state slices wash it out
+            first_use = L not in self._seg_fns
+            t0s = time.monotonic()
+            carry, seg, ys = self._seg_fn(L)(
+                sx, carry, seg, jnp.asarray(base, jnp.int32)
+            )
+            # the slice boundary IS a blocking sync: the device must be
+            # genuinely idle before the scheduler may hand it to an
+            # urgent request (seg[2] is the in-graph `done` flag)
+            ys_host, done = jax.device_get((ys, seg[2]))
+            wall = time.monotonic() - t0s
+            device_s += wall
+            ys_parts.append(ys_host)
+            base += L
+            per_round = wall / L
+            if round_wall is None:
+                round_wall = per_round
+            elif not first_use:
+                round_wall = 0.5 * round_wall + 0.5 * per_round
+            if bool(done) or base >= total:
+                break
+            L = 1
+            while L * 2 * round_wall <= budget and L * 2 <= SEGMENT_MAX_ROUNDS:
+                L *= 2
+            if seg_ctx.checkpoint is not None:
+                seg_ctx.checkpoint()
+        ys = {
+            k: np.concatenate([p[k] for p in ys_parts]) for k in FUSED_YS_KEYS
+        }
+        history = self._fused_history(ys, verbose=False)
+        history.append(dict(
+            timing=True, fused=True, segmented=True,
+            segments=len(ys_parts), blocking_syncs=len(ys_parts),
+            device_s=round(device_s, 6),
+            host_dispatch_s=round(time.monotonic() - t_start - device_s, 6),
+        ))
+        return self.carry_to_state(carry), history
 
     # ------------------------------------------------------------------
     # driver
@@ -2546,8 +2753,21 @@ class Engine:
         the streaming controller's incremental re-anneal.  The RNG chain,
         schedule, and early-stop semantics are unchanged; only the round-0
         carry differs.
+
+        With an ambient SegmentContext (the device scheduler granted this
+        dispatch preemptibly — fleet/scheduler.py) the fused schedule runs
+        as wall-bounded slices with a preemption checkpoint between them;
+        results are byte-identical to the unsegmented run (see
+        `_run_segmented`).  Verbose runs stay unsegmented: they are
+        debugging tools, and the per-round eval would have to ride every
+        slice program.
         """
         if self.config.fused_rounds:
+            seg_ctx = current_segment_context()
+            if seg_ctx is not None and not verbose:
+                return self._run_segmented(
+                    seg_ctx, initial_placement=initial_placement
+                )
             return self._run_fused(
                 verbose=verbose, initial_placement=initial_placement
             )
@@ -2561,8 +2781,30 @@ class Engine:
             return self.init_carry(key)
         return self.init_carry_from(key, initial_placement)
 
+    def _fused_history(self, ys, *, verbose: bool) -> list[dict]:
+        """Per-round history records from the fused program's fetched ys
+        — one builder for the whole-anneal and segmented runners, so the
+        two report identically (a segmented run may have fetched fewer
+        trailing not-ran rows; those contribute no records anyway)."""
+        history: list[dict] = []
+        for r in range(len(ys["ran"])):
+            if ys["stopped"][r] and history:
+                history[-1]["early_stop"] = True
+            if not ys["ran"][r]:
+                continue
+            rec = dict(
+                round=len(history),
+                temperature=float(ys["temperature"][r]),
+                accepted=int(ys["accepted"][r]),
+            )
+            if r >= self.config.num_rounds:
+                rec["extra"] = True
+            if verbose:
+                rec["objective"] = float(ys["objective"][r])
+            history.append(rec)
+        return history
+
     def _run_fused(self, *, verbose: bool = False, initial_placement=None):
-        cfg = self.config
         sx = self.statics
         t_start = time.monotonic()
         carry = self._init_for_run(initial_placement)
@@ -2593,22 +2835,7 @@ class Engine:
         ys = jax.device_get(ys)
         t_sync = time.monotonic()
 
-        history = []
-        for r in range(len(ys["ran"])):
-            if ys["stopped"][r] and history:
-                history[-1]["early_stop"] = True
-            if not ys["ran"][r]:
-                continue
-            rec = dict(
-                round=len(history),
-                temperature=float(ys["temperature"][r]),
-                accepted=int(ys["accepted"][r]),
-            )
-            if r >= cfg.num_rounds:
-                rec["extra"] = True
-            if verbose:
-                rec["objective"] = float(ys["objective"][r])
-            history.append(rec)
+        history = self._fused_history(ys, verbose=verbose)
         history.append(dict(
             timing=True, fused=True, blocking_syncs=1,
             host_dispatch_s=round(t_disp - t_start, 6),

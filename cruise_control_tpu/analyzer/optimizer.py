@@ -625,6 +625,10 @@ class GoalOptimizer:
                         "device_s", "blocking_syncs", "host_extract_s",
                         "engine_cache_hit", "engine_build_s", "bucket",
                         "mesh_shape", "collective_bytes",
+                        # segmented (preemptible) execution under the
+                        # device scheduler: how many wall-bounded slices
+                        # this anneal dispatched as
+                        "segmented", "segments",
                     )
                     if timing.get(k) is not None
                 },

@@ -67,6 +67,46 @@ def set_device_op_hook(hook) -> None:
         _DEVICE_OP_HOOK = hook
 
 
+_PAUSE_CLOCK_VAR = None
+
+
+def _pause_clock_var():
+    global _PAUSE_CLOCK_VAR
+    if _PAUSE_CLOCK_VAR is None:
+        import contextvars
+
+        _PAUSE_CLOCK_VAR = contextvars.ContextVar(
+            "device_op_pause_clock", default=None
+        )
+    return _PAUSE_CLOCK_VAR
+
+
+class pause_clock_scope:
+    """Scope a pause clock — `() -> float`, cumulative EXTERNALLY-imposed
+    pause of the current dispatch, including one in progress — to the
+    current context.  The device scheduler wraps each granted preemptible
+    dispatch in one (fleet/scheduler.py), so only THAT dispatch's
+    supervised calls extend their hang deadline by its pauses: a paused
+    anneal is the scheduler doing its job, not a wedged device.  A
+    contextvar so it rides the caller's context into `_bounded`'s worker
+    copy; unset (the default) keeps the hang budget pure wall clock."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._token = None
+
+    def __enter__(self):
+        self._token = _pause_clock_var().set(self._fn)
+        return self
+
+    def __exit__(self, *exc):
+        _pause_clock_var().reset(self._token)
+
+
+def _current_pause_clock():
+    return _pause_clock_var().get()
+
+
 def device_op(name: str):
     """Mark a function/method as a device-dispatching entry point.
 
@@ -444,12 +484,20 @@ class DeviceSupervisor:
         pinned stays exempt from hard buffer release (optimizer pin
         semantics), so an eventual late completion cannot touch freed
         memory."""
+        import contextvars
+
         done = threading.Event()
         box: dict = {}
+        # the worker runs with the CALLER'S context copied in: the device
+        # scheduler's ambient grants (segmented-execution seam, held-slot
+        # reentrancy) are contextvars and must survive this thread hop —
+        # a fresh context would silently run a preemptible dispatch
+        # unsegmented (tracer parentage rides along too, harmlessly)
+        ctx = contextvars.copy_context()
 
         def worker():
             try:
-                box["result"] = fn()
+                box["result"] = ctx.run(fn)
             except BaseException as e:  # noqa: BLE001 — re-raised on the caller
                 box["error"] = e
             finally:
@@ -459,8 +507,23 @@ class DeviceSupervisor:
             target=worker, daemon=True, name=f"supervised-{op}"
         )
         t.start()
-        if not done.wait(timeout_s):
-            raise DeviceHangError(op, timeout_s)
+        # deadline extended by scheduler-imposed pause: a segmented
+        # dispatch parked at a preemption checkpoint while URGENT work
+        # runs is healthy — billing that wait here would turn sustained
+        # urgent load into spurious DeviceHangError breaker failures
+        pause = _current_pause_clock()
+        if pause is None:
+            if not done.wait(timeout_s):
+                raise DeviceHangError(op, timeout_s)
+        else:
+            base = pause()
+            deadline = time.monotonic() + timeout_s
+            while True:
+                remaining = deadline + max(0.0, pause() - base) - time.monotonic()
+                if remaining <= 0:
+                    raise DeviceHangError(op, timeout_s)
+                if done.wait(min(remaining, 0.5)):
+                    break
         if "error" in box:
             raise box["error"]
         return box.get("result")

@@ -407,6 +407,61 @@ def _fleet_defs() -> ConfigDef:
              "clusters' proposal refreshes (breach: 429 + "
              "fleet.tenant-rejections sensor); 0 disables",
              in_range(lo=0), group=g)
+    d.define("fleet.tenant.retry.after.s", T.DOUBLE, 5.0, I.LOW,
+             "fallback Retry-After (seconds) on admission-control and "
+             "scheduler-shed 429 responses when no queue drain rate has "
+             "been observed yet; with history, Retry-After is computed "
+             "from the tenant queue's actual drain rate",
+             in_range(lo=1.0), group=g)
+    # --- fleet device scheduler: QoS-aware dispatch (fleet/scheduler.py) ---
+    g = "fleet.scheduler"
+    d.define("fleet.scheduler.enabled", T.BOOLEAN, False, I.HIGH,
+             "QoS-aware device scheduler: every engine dispatch (detector "
+             "fix pipelines = URGENT, REST proposals/simulate/rightsize = "
+             "INTERACTIVE, streaming drift cycles / fleet scoring / "
+             "speculative prewarm = BACKGROUND) runs under one arbitrated "
+             "device slot with per-class deadlines, aging, bounded-wall "
+             "preemption of segmented anneals, and a shed/brownout "
+             "overload ladder.  Off (the default): dispatch order is "
+             "byte-for-byte unscheduled", group=g)
+    d.define("fleet.scheduler.slice.budget.s", T.DOUBLE, 1.0, I.MEDIUM,
+             "wall-clock bound per segmented-anneal slice: a granted "
+             "non-urgent anneal dispatches the fused round schedule in "
+             "slices no longer than this, with a preemption check between "
+             "slices — an URGENT request waits at most one slice",
+             in_range(lo=0.01), group=g)
+    d.define("fleet.scheduler.freshness.slo.s", T.DOUBLE, 60.0, I.MEDIUM,
+             "per-cluster proposal-freshness SLO the scheduler derives "
+             "request deadlines from: BACKGROUND cycles must dispatch "
+             "within the SLO, INTERACTIVE within a quarter of it, URGENT "
+             "within one slice budget.  Per-cluster overridable "
+             "(fleet.<id>.fleet.scheduler.freshness.slo.s); the published "
+             "proposal age it protects is observable as "
+             "analyzer.proposal-age-seconds", in_range(lo=0.1), group=g)
+    d.define("fleet.scheduler.aging.s", T.DOUBLE, 30.0, I.LOW,
+             "wait after which a BACKGROUND ticket is ranked with the "
+             "INTERACTIVE class (its older deadline then wins the "
+             "earliest-deadline tiebreak) — background can be delayed by "
+             "load, never starved", in_range(lo=0.0), group=g)
+    d.define("fleet.scheduler.shed.queue.depth", T.INT, 8, I.MEDIUM,
+             "queued-dispatch depth at which overload protection engages: "
+             "BACKGROUND submissions shed (counted in "
+             "fleet.scheduler.shed-total.background) at this depth, "
+             "INTERACTIVE admissions 429 with Retry-After at twice it; "
+             "URGENT is never shed.  A >=50% deadline-miss ratio over "
+             "recent grants also counts as overload",
+             in_range(lo=1), group=g)
+    d.define("fleet.scheduler.brownout.after.s", T.DOUBLE, 20.0, I.LOW,
+             "overload sustained this long switches BACKGROUND handling "
+             "from shed to BROWNOUT: re-anneals run with the reduced "
+             "candidate width below instead of being skipped, so proposal "
+             "freshness degrades gracefully instead of going dark",
+             in_range(lo=0.0), group=g)
+    d.define("fleet.scheduler.brownout.candidate.factor", T.DOUBLE, 0.5, I.LOW,
+             "candidate/restart width multiplier for browned-out "
+             "background anneals (one quantized step per base config, so "
+             "brownout costs at most one extra compiled program per "
+             "bucket)", in_range(lo=0.05, hi=1.0), group=g)
     # --- fleet HA: lease-sharded ownership (fleet/leases.py) ---
     g = "fleet.ha"
     d.define("fleet.ha.enabled", T.BOOLEAN, False, I.HIGH,
@@ -979,13 +1034,23 @@ class CruiseControlConfig(AbstractConfig):
         ".balance.threshold", ".capacity.threshold",
         ".low.utilization.threshold",
     )
+    #: shared-prefixed keys that ARE legitimately per-cluster: the device
+    #: scheduler is one shared object, but each cluster's freshness SLO
+    #: is a per-request deadline input its facade/controller reads
+    _FLEET_SHARED_KEY_EXEMPT = ("fleet.scheduler.freshness.slo.s",)
 
     def cluster_config(self, cluster_id: str) -> "CruiseControlConfig":
         """Per-cluster config: the base props with every `fleet.<id>.<key>`
         override folded onto its bare `<key>`.  All `fleet.*` keys are
         stripped from the derived config — a cluster-scoped config must
-        never look like a fleet of its own.  Overrides of shared-core /
-        webserver keys are rejected (see _FLEET_SHARED_KEY_PREFIXES)."""
+        never look like a fleet of its own — EXCEPT the builtin
+        fleet.scheduler.*/fleet.tenant.* knobs, which carry no
+        fleet-shaped meaning and which per-cluster facades read (the
+        freshness SLO).  Overrides of shared-core / webserver keys are
+        rejected (see _FLEET_SHARED_KEY_PREFIXES); of the scheduler/
+        tenant knobs only the per-cluster freshness SLO
+        (_FLEET_SHARED_KEY_EXEMPT) is overridable — the rest configure
+        the ONE instance-level scheduler/purgatory built from the base."""
         if cluster_id not in self.get("fleet.clusters"):
             raise ConfigException(
                 f"unknown fleet cluster {cluster_id!r}; "
@@ -995,6 +1060,7 @@ class CruiseControlConfig(AbstractConfig):
         base = {
             k: v for k, v in self._raw_props.items()
             if not k.startswith("fleet.")
+            or k.startswith(("fleet.scheduler.", "fleet.tenant."))
         }
         overrides = {
             k[len(prefix):]: v
@@ -1003,8 +1069,18 @@ class CruiseControlConfig(AbstractConfig):
         }
         shared = sorted(
             k for k in overrides
-            if k.startswith(self._FLEET_SHARED_KEY_PREFIXES)
-            or k.endswith(self._FLEET_SHARED_KEY_SUFFIXES)
+            if (
+                k.startswith(self._FLEET_SHARED_KEY_PREFIXES)
+                or k.endswith(self._FLEET_SHARED_KEY_SUFFIXES)
+                # the scheduler and the admission/Retry-After knobs are
+                # instance-level objects read from the BASE config — a
+                # per-cluster override would fold and then be silently
+                # ignored, except the explicitly per-cluster SLO
+                or (
+                    k.startswith(("fleet.scheduler.", "fleet.tenant."))
+                    and k not in self._FLEET_SHARED_KEY_EXEMPT
+                )
+            )
         )
         if shared:
             raise ConfigException(

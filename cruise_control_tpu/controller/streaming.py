@@ -143,7 +143,7 @@ class StreamingController:
             incrementalAnneals=0, warmStarts=0, proposalsPublished=0,
             lastRounds=None, lastObjective=None, lastWallSeconds=None,
             lastWindowIndex=None, lastPublishMs=None, lastError=None,
-            loopFailures=0,
+            loopFailures=0, cyclesShed=0, brownoutCycles=0,
         )
 
     # ----------------------------------------------------------- lifecycle
@@ -390,14 +390,56 @@ class StreamingController:
             else None
         )
         options = self.cc._build_options(state)
-        with self.sensors.timer("controller.anneal-timer").time():
-            result = self.optimizer.optimize(
-                state,
-                options=options,
-                config=self._opt_config,
-                initial_placement=warm,
-                prior=prior_table,
+        # drift cycles are BACKGROUND work on the shared device: under
+        # the scheduler they run segmented (preemptible by URGENT fix
+        # pipelines), shed under transient overload (counted — the cycle
+        # is skipped, the stale proposal keeps serving inside its
+        # freshness SLO), and run BROWNED OUT — reduced candidate width,
+        # not skipped — under sustained overload
+        sched = self.cc.scheduler
+        cfg = self._opt_config
+        brownout = False
+        if sched is not None and sched.brownout_active:
+            cfg = sched.brownout_config(cfg)
+            brownout = True
+
+        def _run():
+            # the anneal timer lives INSIDE the scheduled body: it must
+            # keep measuring anneal wall, not scheduler queue wait —
+            # fleet.scheduler.wait-timer.background already reports the
+            # wait separately
+            with self.sensors.timer("controller.anneal-timer").time():
+                return self.optimizer.optimize(
+                    state,
+                    options=options,
+                    config=cfg,
+                    initial_placement=warm,
+                    prior=prior_table,
+                )
+
+        if sched is None:
+            result = _run()
+        else:
+            from cruise_control_tpu.fleet.scheduler import (
+                BackgroundShedError,
+                WorkClass,
             )
+
+            try:
+                result = sched.run(
+                    WorkClass.BACKGROUND, _run,
+                    cluster_id=self.cc.cluster_id or "",
+                    op="controller-cycle",
+                    freshness_slo_s=self.cc._freshness_slo_s,
+                )
+            except BackgroundShedError:
+                self._stats["cyclesShed"] += 1
+                self.sensors.counter("controller.cycles-shed").inc()
+                sp.set(shed=True)
+                return dict(shed=True, rounds=0, warm_start=False,
+                            published=False)
+        if brownout:
+            self._stats["brownoutCycles"] += 1
         rounds = sum(1 for h in result.history if not h.get("timing"))
         after = result.state_after
         self._warm = (

@@ -34,6 +34,11 @@ class AnomalyType(enum.Enum):
     #: cluster stepped down to read-only degraded mode while a peer
     #: instance takes over execution
     FLEET_LEASE_LOST = 7
+    #: the device scheduler's overload protection engaged (fleet
+    #: scheduler, fleet/scheduler.py): background cycles are being shed
+    #: or browned out and interactive admissions may 429 — the shared
+    #: device cannot keep up with the fleet's demand
+    FLEET_OVERLOAD = 8
 
     @property
     def priority(self) -> int:
@@ -198,6 +203,33 @@ class FleetLeaseLost(Anomaly):
         return (
             f"FleetLeaseLost(cluster={self.cluster_id}, "
             f"instance={self.instance_id}, epoch={self.epoch})"
+        )
+
+
+@dataclasses.dataclass
+class FleetOverload(Anomaly):
+    """The device scheduler entered an overload episode
+    (fleet/scheduler.py): the engine-dispatch queue breached its
+    depth/deadline-miss threshold, so background cycles are being shed
+    (or browned out under sustained overload) and interactive admissions
+    may be 429'd.  Fired ONCE per episode by the scheduler itself.
+
+    Not self-healable by the detector: the scheduler's shed/brownout
+    ladder IS the mitigation — alert-only, like OPTIMIZER_DEGRADED, so
+    operators learn the instance is past its density budget (add an
+    instance, or shard the fleet: ROADMAP item 2c)."""
+
+    anomaly_type: AnomalyType = AnomalyType.FLEET_OVERLOAD
+    queue_depth: int = 0
+    deadline_miss_ratio: float = 0.0
+    episode: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"FleetOverload(episode={self.episode}, "
+            f"queueDepth={self.queue_depth}, "
+            f"missRatio={self.deadline_miss_ratio})"
         )
 
 

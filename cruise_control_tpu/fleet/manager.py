@@ -49,6 +49,10 @@ def shared_core_rollup(core, *, tenant_max_pending: int = 0) -> dict:
     }
     if core.supervisor is not None:
         out["supervisor"] = core.supervisor.state_json()
+    if getattr(core, "scheduler", None) is not None:
+        # QoS-aware device scheduler (fleet/scheduler.py): queue depths,
+        # shed/brownout ladder state, per-class wait quantiles
+        out["scheduler"] = core.scheduler.state_json()
     return out
 
 
@@ -83,6 +87,10 @@ class ClusterContext:
         cc = self.cc
         out = {
             "proposalReady": cc._valid_cache() is not None,
+            # age of the published proposal (seconds; -1 = none) — the
+            # observable the scheduler's freshness SLO
+            # (fleet.scheduler.freshness.slo.s) is enforced against
+            "proposalAgeS": cc.proposal_age_s(),
             "hasOngoingExecution": cc.executor.has_ongoing_execution,
             "executorState": cc.executor.executor_state().get("state"),
             "modelGeneration": str(cc.monitor.model_generation()),
@@ -362,8 +370,33 @@ class FleetManager:
         chain = self.core.chain
         names = chain.names()
         pw, sw = self.core.balancedness_weights
+        sched = getattr(self.core, "scheduler", None)
         for shape, cids in groups.items():
-            objs, viols, degraded = ev.evaluate_states([states[c] for c in cids])
+            if sched is None:
+                objs, viols, degraded = ev.evaluate_states(
+                    [states[c] for c in cids]
+                )
+            else:
+                # fleet-wide batched scoring is BACKGROUND work: under
+                # overload the whole group's dispatch sheds (reported,
+                # never silent) rather than delaying an urgent re-anneal
+                from cruise_control_tpu.fleet.scheduler import (
+                    BackgroundShedError,
+                    WorkClass,
+                )
+
+                try:
+                    objs, viols, degraded = sched.run(
+                        WorkClass.BACKGROUND,
+                        lambda cs=[states[c] for c in cids]: (
+                            ev.evaluate_states(cs)
+                        ),
+                        op="fleet-score",
+                    )
+                except BackgroundShedError:
+                    for cid in cids:
+                        out[cid] = {"shed": True}
+                    continue
             for i, cid in enumerate(cids):
                 v = viols[i]
                 out[cid] = {

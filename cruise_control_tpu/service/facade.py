@@ -42,6 +42,7 @@ from cruise_control_tpu.detector import (
 )
 from cruise_control_tpu.executor import ExecutionOptions, Executor, OngoingExecutionError
 from cruise_control_tpu.executor.admin import ClusterAdmin
+from cruise_control_tpu.fleet.scheduler import WorkClass
 from cruise_control_tpu.models.state import ClusterState
 from cruise_control_tpu.monitor import (
     LoadMonitor,
@@ -133,6 +134,26 @@ class AnalyzerCore:
         self.supervisor = config.device_supervisor(
             sensors=self.sensors, tracer=self.tracer
         )
+        #: QoS-aware device scheduler (fleet/scheduler.py, config
+        #: fleet.scheduler.*): ONE per core — it arbitrates the single
+        #: shared device every facade over this core dispatches onto.
+        #: None (the default) keeps every dispatch path byte-for-byte
+        #: unscheduled.
+        self.scheduler = None
+        if config.get("fleet.scheduler.enabled"):
+            from cruise_control_tpu.fleet.scheduler import DeviceScheduler
+
+            self.scheduler = DeviceScheduler(
+                slice_budget_s=config.get("fleet.scheduler.slice.budget.s"),
+                freshness_slo_s=config.get("fleet.scheduler.freshness.slo.s"),
+                aging_s=config.get("fleet.scheduler.aging.s"),
+                shed_queue_depth=config.get("fleet.scheduler.shed.queue.depth"),
+                brownout_after_s=config.get("fleet.scheduler.brownout.after.s"),
+                brownout_factor=config.get(
+                    "fleet.scheduler.brownout.candidate.factor"
+                ),
+                sensors=self.sensors,
+            )
         #: boot-prewarm manifest + AOT artifact store (tpu.prewarm.*,
         #: analyzer/prewarm.py): ONE per core, so N fleet facades MERGE
         #: their bucket working sets into one manifest instead of
@@ -263,6 +284,11 @@ class CruiseControl:
         self.optimizer = core.optimizer
         self.scenario_evaluator = core.scenario_evaluator
         self.rightsizer = core.rightsizer
+        #: shared device scheduler (None when fleet.scheduler.enabled is
+        #: off); the per-cluster freshness SLO rides each request as its
+        #: deadline input
+        self.scheduler = core.scheduler
+        self._freshness_slo_s = config.get("fleet.scheduler.freshness.slo.s")
         from cruise_control_tpu.executor.strategy import resolve_strategy_chain
 
         #: the configured strategy pool gates what requests may reference
@@ -362,6 +388,16 @@ class CruiseControl:
         # queue every detector feeds, so the notifier (Slack included)
         # alerts on wedged moves like any other anomaly
         self.executor.anomaly_sink = self.anomaly_detector.add_anomaly
+        if core.scheduler is not None and core.scheduler.anomaly_sink is None:
+            # FLEET_OVERLOAD is an INSTANCE-level episode: the first
+            # facade built over the core claims the sink, so the anomaly
+            # fires exactly once per episode instead of once per cluster
+            core.scheduler.anomaly_sink = self.anomaly_detector.add_anomaly
+        #: published-proposal age (the freshness the scheduler's SLO
+        #: protects, observable): seconds since the cached proposal was
+        #: computed, -1 while none is published.  Per cluster via this
+        #: facade's (labeled) registry.
+        self.sensors.gauge("analyzer.proposal-age-seconds", self.proposal_age_s)
         self._wire_detectors()
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
@@ -657,16 +693,39 @@ class CruiseControl:
         allow_est = self.config.get("allow.capacity.estimation.on.proposal.precompute")
         streak_gauge = self.sensors.gauge("analyzer.precompute-consecutive-failures")
         consecutive = 0
+        from cruise_control_tpu.fleet.scheduler import BackgroundShedError
+
         while True:
             try:
+                # BACKGROUND: the periodic refresh is exactly the
+                # steady-state load the scheduler's shed ladder exists
+                # to relieve — under overload this cycle sheds (counted
+                # by the scheduler, the cached proposal keeps serving)
+                # instead of crowding out urgent/interactive dispatches.
+                # Pre-check BEFORE the full model build: a cycle the
+                # dispatch would shed anyway must not pay the expensive
+                # host flatten while the instance is saturated.
+                sched = self.scheduler
+                if sched is not None and sched.should_shed_background():
+                    sched.shed_background(op="precompute")
+                    if self._stop_precompute.wait(
+                        self._proposal_expiration_ms / 2000.0
+                    ):
+                        return
+                    continue
                 self.proposals(
                     OperationProgress(),
                     ignore_cache=True,
                     allow_capacity_estimation=allow_est,
+                    work_class=WorkClass.BACKGROUND,
                 )
                 consecutive = 0
                 streak_gauge.set(0)
                 self._log_compile_cache_report()
+            except BackgroundShedError:
+                # a shed refresh is overload protection working, not a
+                # precompute failure — don't touch the failing streak
+                pass
             except Exception:  # noqa: BLE001 — the loop must keep ticking,
                 # but a permanently broken precompute must be VISIBLE:
                 # every failure counts, and three in a row start WARN
@@ -784,12 +843,29 @@ class CruiseControl:
         # this loop re-runs every proposal_expiration/2 seconds
         if nxt == state.shape or self.optimizer.has_engine_for(nxt):
             return
+        sched = self.scheduler
+        if sched is not None and sched.brownout_active:
+            # speculation is pure luxury: brownout lets real background
+            # cycles run (reduced), but a next-bucket guess must never
+            # add device/compile pressure mid-episode — shed it, counted
+            sched.shed_background(op="prewarm-next-bucket")
+            return
         from cruise_control_tpu.models.builder import pad_state
 
         # speculation compiles AFTER anything the boot prewarm or a
         # request enqueued (warm-pool priority ordering): the active
         # bucket's programs must never wait behind a next-bucket guess
-        self.optimizer.prewarm(pad_state(state, nxt), priority=100)
+        from cruise_control_tpu.fleet.scheduler import BackgroundShedError
+
+        padded = pad_state(state, nxt)
+        try:
+            self._scheduled(
+                WorkClass.BACKGROUND,
+                lambda: self.optimizer.prewarm(padded, priority=100),
+                op="prewarm-next-bucket",
+            )
+        except BackgroundShedError:
+            pass  # a shed speculation is overload protection working
 
     # ------------------------------------------------------------------
     # proposal computation + cache (reference optimizations():276-324,493)
@@ -848,6 +924,7 @@ class CruiseControl:
         options: OptimizationOptions | None = None,
         goals: list[str] | None = None,
         allow_capacity_estimation: bool = True,
+        work_class: "WorkClass | None" = None,
     ) -> OptimizerResult:
         """Cached unless options/goals are non-default
         (reference ignoreProposalCache():469).
@@ -878,7 +955,19 @@ class CruiseControl:
         # reference GoalOptimizer proposal-computation-timer (:116,155);
         # the histogram twin feeds /metrics with aggregatable buckets
         with self.sensors.timer("analyzer.proposal-computation-timer").time():
-            result = optimizer.optimize(state, options=options or OptimizationOptions())
+            # INTERACTIVE under the device scheduler (REST path) unless
+            # the caller says otherwise — the periodic precompute loop
+            # passes BACKGROUND so steady-state refresh anneals sit in
+            # the shed ladder's background rung; a self-healing fix
+            # pipeline reaching here carries an URGENT tag that upgrades
+            # either default
+            result = self._scheduled(
+                work_class if work_class is not None else WorkClass.INTERACTIVE,
+                lambda: optimizer.optimize(
+                    state, options=options or OptimizationOptions()
+                ),
+                op="proposals",
+            )
         self.sensors.histogram("analyzer.proposal-computation-seconds").observe(
             result.wall_seconds
         )
@@ -962,6 +1051,38 @@ class CruiseControl:
     def invalidate_proposal_cache(self):
         with self._cache_lock:
             self._cache = None
+
+    def proposal_age_s(self) -> float:
+        """Age (seconds, monotonic) of the published/cached proposal; -1
+        when none is cached.  The observable half of the scheduler's
+        proposal-freshness SLO (`fleet.scheduler.freshness.slo.s`):
+        exported as the `analyzer.proposal-age-seconds` gauge and the
+        /fleet per-cluster `proposalAgeS` field."""
+        with self._cache_lock:
+            c = self._cache
+        if c is None:
+            return -1.0
+        return round(time.monotonic() - c.computed_mono, 3)
+
+    def _scheduled(self, work_class, fn, *, op: str):
+        """Run one device-dispatching body under the shared device
+        scheduler (no-op passthrough when fleet.scheduler.enabled is
+        off).  The effective class is the dispatch site's default
+        upgraded by any ambient pipeline tag — a self-healing fix
+        pipeline tags itself URGENT (scheduler.tagged), so its inner
+        proposals() dispatch acquires the slot urgently while its long
+        executor phase holds nothing."""
+        sched = self.scheduler
+        if sched is None:
+            return fn()
+        from cruise_control_tpu.fleet.scheduler import effective_class
+
+        return sched.run(
+            effective_class(work_class), fn,
+            cluster_id=self.cluster_id or "",
+            op=op,
+            freshness_slo_s=self._freshness_slo_s,
+        )
 
     # ------------------------------------------------------------------
     # operations (reference servlet/handler/async/runnable/*)
@@ -1278,7 +1399,11 @@ class CruiseControl:
             elif goals is not None:
                 optimizer = self._make_optimizer(goals)
             progress.add_step(BatchedOptimization(optimizer.config.num_rounds))
-            result = optimizer.optimize(state, options=options)
+            result = self._scheduled(
+                WorkClass.INTERACTIVE,
+                lambda: optimizer.optimize(state, options=options),
+                op="rebalance",
+            )
         else:
             result = self.proposals(
                 progress, allow_capacity_estimation=allow_capacity_estimation
@@ -1314,7 +1439,11 @@ class CruiseControl:
             excluded_brokers_for_replica_move=~dest_mask,
             excluded_brokers_for_leadership=~dest_mask,
         )
-        result = self.optimizer.optimize(state, options=options)
+        result = self._scheduled(
+            WorkClass.INTERACTIVE,
+            lambda: self.optimizer.optimize(state, options=options),
+            op="remove_brokers",
+        )
         out = result.summary()
         out["estimatedExecutionTime"] = self._execution_eta(
             result, execution_overrides
@@ -1419,12 +1548,16 @@ class CruiseControl:
             # the response cannot drift from the mutated states' scoring;
             # its optimize flag is False — the response never serializes a
             # baseline fix, so annealing it would be a wasted full anneal
-            outcomes = self.scenario_evaluator.evaluate(
-                state,
-                [Scenario(name="__baseline__")] + scenarios,
-                self.monitor.last_catalog,
-                optimize=[False] + [bool(optimize)] * len(scenarios),
-                bucket=self.bucket_policy,
+            outcomes = self._scheduled(
+                WorkClass.INTERACTIVE,
+                lambda: self.scenario_evaluator.evaluate(
+                    state,
+                    [Scenario(name="__baseline__")] + scenarios,
+                    self.monitor.last_catalog,
+                    optimize=[False] + [bool(optimize)] * len(scenarios),
+                    bucket=self.bucket_policy,
+                ),
+                op="simulate",
             )
             sp.set(degraded=any(o.degraded for o in outcomes))
         base, rest = outcomes[0], outcomes[1:]
@@ -1501,7 +1634,11 @@ class CruiseControl:
         max_anneals = self.config.get("planner.rightsize.max.anneals")
         catalog = self.monitor.last_catalog
         with self.tracer.span("planner.rightsize", component="planner") as sp:
-            out = rs.rightsize(state, catalog, max_anneals=max_anneals)
+            out = self._scheduled(
+                WorkClass.INTERACTIVE,
+                lambda: rs.rightsize(state, catalog, max_anneals=max_anneals),
+                op="rightsize",
+            )
             sp.set(
                 status=out.get("provisionStatus"),
                 anneals=out.get("annealsRun"),
@@ -1525,8 +1662,13 @@ class CruiseControl:
                     "error": "not enough windowed history to fit a trend",
                 }
             else:
-                fc = rs.rightsize(
-                    state, catalog, load_scenario=load_sc, max_anneals=max_anneals
+                fc = self._scheduled(
+                    WorkClass.INTERACTIVE,
+                    lambda: rs.rightsize(
+                        state, catalog, load_scenario=load_sc,
+                        max_anneals=max_anneals,
+                    ),
+                    op="rightsize-forecast",
                 )
                 fc["horizonMs"] = horizon_ms
                 out["forecast"] = fc
@@ -1613,9 +1755,21 @@ class SelfHealingAdapter:
         Busy executor is the EXPECTED no (the detector re-checks later)
         and stays silent.  Everything else used to be swallowed
         indistinguishably — now it is logged with the traceback, counted
-        (`self-healing.fix-failed`), and kept as last-failure info."""
+        (`self-healing.fix-failed`), and kept as last-failure info.
+
+        Under the device scheduler every fix pipeline is tagged URGENT:
+        a broker-failure / EXECUTION_STUCK / lease-takeover re-anneal's
+        engine dispatch preempts whatever background slice holds the
+        device (never shed, never 429'd), while the pipeline's long
+        executor phase — which dispatches nothing — holds no slot."""
         try:
-            fn()
+            if self.cc.scheduler is not None:
+                from cruise_control_tpu.fleet.scheduler import tagged
+
+                with tagged(WorkClass.URGENT):
+                    fn()
+            else:
+                fn()
             return True
         except OngoingExecutionError:
             return False

@@ -29,6 +29,7 @@ from cruise_control_tpu.common.resources import RESOURCE_NAMES, Resource
 from cruise_control_tpu.service.facade import CruiseControl
 from cruise_control_tpu.service.parameters import ParameterError, build_override_maps
 from cruise_control_tpu.service.purgatory import Purgatory, PurgatoryFullError
+from cruise_control_tpu.fleet.scheduler import SchedulerOverloadError
 from cruise_control_tpu.service.tasks import (
     USER_TASK_ID_HEADER,
     TenantOverloadError,
@@ -530,10 +531,25 @@ class CruiseControlApp:
         fn = wrapped
 
         def _submit():
-            # per-tenant admission control (fleet.tenant.max.pending.tasks):
-            # enforced at SUBMISSION inside the task manager's lock (an
-            # atomic count-and-admit) — polling an already-running task is
-            # never rejected, only new work competing for the shared pool
+            # admission control runs HERE — _submit only fires for NEW
+            # work, so polling an already-running task (User-Task-ID
+            # header, or the session rebind below) is never rejected.
+            # Two rungs: the device scheduler's INTERACTIVE shed (severe
+            # overload: 429 + drain-rate Retry-After BEFORE a task is
+            # created), then the per-tenant pending cap enforced inside
+            # the task manager's lock (atomic count-and-admit).
+            sched = getattr(cc, "scheduler", None)
+            if sched is not None:
+                try:
+                    sched.admit_interactive(
+                        cluster_id=cluster_id,
+                        default_retry_after_s=self.config.get(
+                            "fleet.tenant.retry.after.s"
+                        ),
+                    )
+                except SchedulerOverloadError:
+                    cc.sensors.counter("fleet.scheduler-rejections").inc()
+                    raise
             cap = (
                 self.tenant_max_pending
                 if self.fleet is not None and cluster_id else 0
@@ -562,7 +578,21 @@ class CruiseControlApp:
                 tid = self.sessions.get_or_bind(key, lambda: _submit().task_id)
                 task = self.user_tasks.get(tid)
         except TenantOverloadError as e:
-            return 429, {"errorMessage": str(e)}
+            # Retry-After from the tenant queue's measured drain rate
+            # (fallback: fleet.tenant.retry.after.s) — the rider becomes
+            # a real Retry-After header in _send
+            ra = e.retry_after_s
+            if ra is None:
+                ra = self.user_tasks.retry_after_s(
+                    cluster_id,
+                    default_s=self.config.get("fleet.tenant.retry.after.s"),
+                )
+            return 429, {"errorMessage": str(e), "_retryAfter": int(round(ra))}
+        except SchedulerOverloadError as e:
+            return 429, {
+                "errorMessage": str(e),
+                "_retryAfter": int(round(e.retry_after_s)),
+            }
         status, payload = self._task_response(task)
         if status != 202:  # response delivered -> close the session
             self.sessions.release(key)
@@ -1203,6 +1233,11 @@ class CruiseControlApp:
                 tid = payload.get("_userTaskId") if isinstance(payload, dict) else None
                 if tid:
                     self.send_header(USER_TASK_ID_HEADER, tid)
+                ra = payload.get("_retryAfter") if isinstance(payload, dict) else None
+                if ra is not None:
+                    # 429 backoff hint (admission control / scheduler
+                    # shed): standard header, integer seconds
+                    self.send_header("Retry-After", str(int(ra)))
                 self.end_headers()
                 self.wfile.write(body)
                 if app.access_log:

@@ -11,6 +11,7 @@ import dataclasses
 import threading
 import time
 import uuid
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from cruise_control_tpu.service.progress import OperationProgress, Pending
@@ -21,7 +22,16 @@ USER_TASK_ID_HEADER = "User-Task-ID"
 class TenantOverloadError(RuntimeError):
     """Per-cluster pending-task cap breached (fleet.tenant.max.pending.
     tasks) — surfaces as 429, never as a 500.  Raised by submit() under
-    the manager lock so concurrent submissions can't race past the cap."""
+    the manager lock so concurrent submissions can't race past the cap.
+
+    `retry_after_s` (set by the server from the tenant queue's measured
+    drain rate, falling back to `fleet.tenant.retry.after.s`) rides the
+    429 response as a `Retry-After` header so clients back off for a
+    meaningful interval instead of hammering."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -96,6 +106,9 @@ class UserTaskManager:
         self._pool = ThreadPoolExecutor(max_workers=num_threads, thread_name_prefix="user-task")
         self._tasks: dict[str, UserTask] = {}
         self._lock = threading.RLock()
+        #: per-cluster recent task-completion stamps (monotonic) — the
+        #: drain-rate observations Retry-After is computed from
+        self._completions: dict[str, deque] = {}
         self.max_active_tasks = max_active_tasks
         self.max_cached_completed = max_cached_completed
         self.completed_retention_ms = completed_retention_ms
@@ -146,13 +159,52 @@ class UserTaskManager:
             )
             # completion stamp for retention: set the moment the operation
             # finishes, so the retention window starts when the RESULT
-            # became available, not when the task was born
+            # became available, not when the task was born.  The same
+            # stamp feeds the per-cluster drain-rate window Retry-After
+            # is computed from.
             future.add_done_callback(
-                lambda f, t=task: setattr(t, "completed_mono", time.monotonic())
+                lambda f, t=task: self._on_done(t)
             )
             self._tasks[tid] = task
             self._maybe_evict()
             return task
+
+    def _on_done(self, task: UserTask) -> None:
+        task.completed_mono = time.monotonic()
+        if task.cluster_id:
+            with self._lock:
+                self._completions.setdefault(
+                    task.cluster_id, deque(maxlen=32)
+                ).append(task.completed_mono)
+
+    #: drain-rate observation window: completions older than this are
+    #: not evidence about the CURRENT drain rate — a burst hours ago
+    #: must not shape today's Retry-After (nor may an hour of trickle
+    #: inflate it past what the now-idle pool would actually take)
+    DRAIN_WINDOW_S = 300.0
+
+    def retry_after_s(self, cluster_id: str, *, default_s: float = 5.0) -> float:
+        """Estimated seconds until the tenant's queue has room, from its
+        measured drain rate: pending tasks over completions/second in the
+        recent window (DRAIN_WINDOW_S; older stamps are pruned as stale
+        evidence).  Falls back to `default_s` (fleet.tenant.retry.after.s)
+        when too little fresh history exists, and is clamped to [1, 300]
+        so a stalled queue can't tell clients to come back next week."""
+        now = time.monotonic()
+        with self._lock:
+            pending = sum(
+                1 for t in self._tasks.values()
+                if t.cluster_id == cluster_id and t.status == "Active"
+            )
+            stamps = [
+                s for s in self._completions.get(cluster_id, ())
+                if now - s <= self.DRAIN_WINDOW_S
+            ]
+        if len(stamps) >= 2 and stamps[-1] > stamps[0]:
+            rate = (len(stamps) - 1) / (stamps[-1] - stamps[0])
+            est = max(1, pending) / max(rate, 1e-9)
+            return float(min(300.0, max(1.0, est)))
+        return float(min(300.0, max(1.0, default_s)))
 
     def get(self, task_id: str) -> UserTask | None:
         with self._lock:

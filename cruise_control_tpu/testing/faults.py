@@ -214,6 +214,36 @@ def compile_failures(*, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS):
 
 
 @contextlib.contextmanager
+def device_slowdown(
+    factor: float, *, ops=ENGINE_OPS, schedule: FaultSchedule = ALWAYS
+):
+    """Sustained device SLOWNESS: every targeted engine op completes for
+    real, then stalls until its wall clock has been scaled by `factor`
+    (>= 1.0) — thermal throttling, a contended tunnel, a neighbour's
+    burst.  Hangs and crashes were injectable before; this is the shape
+    overload soaks need: the device keeps answering, just too slowly to
+    hold the fleet's deadlines, so shedding/brownout must engage rather
+    than the breaker.
+
+    Per-op accounting rides the yielded InjectionLog (calls/fired per op
+    name) like every injector here, and the hook nests/restores through
+    `device_fault` — an inner injector still sees non-targeted calls.
+    """
+    if factor < 1.0:
+        raise ValueError(f"device_slowdown factor must be >= 1.0, got {factor}")
+
+    def effect(op, fn, args, kwargs):
+        t0 = time.monotonic()
+        result = fn(*args, **kwargs)
+        wall = time.monotonic() - t0
+        time.sleep(wall * (factor - 1.0))
+        return result
+
+    with device_fault(effect, ops=ops, schedule=schedule) as log:
+        yield log
+
+
+@contextlib.contextmanager
 def device_wedged(*, ops=ALL_DEVICE_OPS, schedule: FaultSchedule = ALWAYS):
     """The observed MULTICHIP_r05 failure: every device op — engine runs
     AND the recovery probe — hangs until the context exits ("the fault
