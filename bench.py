@@ -796,6 +796,96 @@ def trace_overhead() -> int:
     return 0 if ok else 1
 
 
+def blackbox_overhead() -> int:
+    """`bench.py --blackbox-overhead`: the black-box dispatch spool is ON
+    by default wherever a durable directory exists, so its cost is gated
+    by measurement, not assumption — same shape as --trace-overhead.
+
+    Runs the smoke workload with the recorder spooling to a temp
+    directory vs disabled (same compiled engine, min-of-N walls) and
+    fails past 2% overhead; also pins the DISABLED path leaves no spool
+    file and that recording changes NOTHING about results (byte-identical
+    placements) — observation must never perturb the optimization."""
+    import os as _os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+    from cruise_control_tpu.common.blackbox import RECORDER
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+    state = random_cluster_fast(
+        RandomClusterSpec(
+            num_brokers=24, num_partitions=1500, num_racks=6, num_topics=12, skew=1.0
+        ),
+        seed=7,
+    )
+    cfg = OptimizerConfig(
+        num_candidates=512, leadership_candidates=128, swap_candidates=64,
+        steps_per_round=16, num_rounds=4, init_temperature_scale=0.0, seed=0,
+    )
+    reps = 7
+    walls: dict[str, float] = {}
+    placements: dict[str, object] = {}
+    spool_dir = tempfile.mkdtemp(prefix="blackbox-bench-")
+    records_written = 0
+
+    def _spool_bytes() -> int:
+        return sum(
+            _os.path.getsize(_os.path.join(spool_dir, f))
+            for f in _os.listdir(spool_dir)
+        )
+
+    try:
+        for mode in ("recorded", "disabled"):
+            if mode == "recorded":
+                RECORDER.configure(
+                    _os.path.join(spool_dir, f"spool-{_os.getpid()}.jsonl")
+                )
+            else:
+                RECORDER.configure(None)
+            opt = GoalOptimizer(config=cfg)
+            result = opt.optimize(state)  # warm: compile outside the measurement
+            placements[mode] = np.asarray(result.state_after.replica_broker)
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.monotonic()
+                opt.optimize(state)
+                best = min(best, time.monotonic() - t0)
+            walls[mode] = best
+            if mode == "recorded":
+                records_written = RECORDER.state_json()["recordsWritten"]
+                bytes_after_recorded = _spool_bytes()
+    finally:
+        RECORDER.configure(None)
+    overhead = walls["recorded"] / max(walls["disabled"], 1e-9) - 1.0
+    parity = bool((placements["recorded"] == placements["disabled"]).all())
+    # the disabled pin: the whole disabled run wrote ZERO spool bytes
+    no_writes_when_disabled = _spool_bytes() == bytes_after_recorded
+    ok = (
+        walls["recorded"] <= walls["disabled"] * 1.02 + 0.002
+        and parity
+        and records_written > 0
+        and no_writes_when_disabled
+    )
+    _emit(
+        metric="blackbox_overhead_smoke",
+        value=round(walls["recorded"], 4),
+        unit="s",
+        vs_baseline=round(overhead, 4),
+        recorded_wall_s=round(walls["recorded"], 4),
+        disabled_wall_s=round(walls["disabled"], 4),
+        overhead_pct=round(overhead * 100, 2),
+        records_written=records_written,
+        disabled_parity=parity,
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 def fleet_smoke() -> int:
     """`bench.py --fleet-smoke`: the fleet controller's economics gate.
 
@@ -1502,13 +1592,27 @@ def streaming(smoke_mode: bool) -> int:
         and warm["stats"]["deltaApplies"] == n_windows - 1
         and cold["stats"]["fullReflattens"] == n_windows
     )
-    ok = parity and rounds_ok and obj_ok and inplace_ok
+    # the headline latency metric (ROADMAP item 4): window-roll-to-
+    # published-proposal p50/p99 from the controller's histogram — every
+    # warm window publishes, so the histogram must have n_windows samples
+    hist = warm["cc"].sensors.get("controller.window-roll-to-publish-seconds")
+    publish_p50 = publish_p99 = None
+    hist_ok = hist is not None and hist.count == n_windows
+    if hist is not None and hist.count:
+        # None (JSON null), never NaN, when empty: the failing run's
+        # record must stay parseable by strict JSON consumers
+        publish_p50 = round(hist.quantile(0.5), 4)
+        publish_p99 = round(hist.quantile(0.99), 4)
+    ok = parity and rounds_ok and obj_ok and inplace_ok and hist_ok
     _emit(
         metric="streaming_warm_vs_cold",
         value=round(warm["wall_s"], 3),
         unit="s",
         vs_baseline=round(warm["wall_s"] / max(cold["wall_s"], 1e-9), 4),
         windows=n_windows,
+        window_roll_to_publish_p50_s=publish_p50,
+        window_roll_to_publish_p99_s=publish_p99,
+        publish_histogram_ok=hist_ok,
         proposals_per_sec=round(n_windows / max(warm["wall_s"], 1e-9), 3),
         cold_proposals_per_sec=round(n_windows / max(cold["wall_s"], 1e-9), 3),
         warm_rounds_mean=round(warm_mean, 3),
@@ -1758,6 +1862,8 @@ def main():
         sys.exit(mesh_smoke())
     if "--trace-overhead" in sys.argv:
         sys.exit(trace_overhead())
+    if "--blackbox-overhead" in sys.argv:
+        sys.exit(blackbox_overhead())
     if "--scenarios" in sys.argv:
         sys.exit(scenarios_bench("--smoke" in sys.argv))
     if "--churn" in sys.argv:

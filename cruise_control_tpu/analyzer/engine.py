@@ -81,6 +81,7 @@ import numpy as np
 
 from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
 from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
@@ -2698,13 +2699,29 @@ class Engine:
             # steady-state slices wash it out
             first_use = L not in self._seg_fns
             t0s = time.monotonic()
-            carry, seg, ys = self._seg_fn(L)(
-                sx, carry, seg, jnp.asarray(base, jnp.int32)
-            )
-            # the slice boundary IS a blocking sync: the device must be
-            # genuinely idle before the scheduler may hand it to an
-            # urgent request (seg[2] is the in-graph `done` flag)
-            ys_host, done = jax.device_get((ys, seg[2]))
+            # black-box spool: one Begin per slice DISPATCH, closed only
+            # after the blocking sync below — a hang inside the slice
+            # program (or a kill mid-slice) leaves "slice K, rounds
+            # [base, base+L) in flight" on disk, the exact trail the
+            # multichip post-mortem needs (common/blackbox.py)
+            _bb = _BLACKBOX
+            bb_seq = _bb.begin(
+                "engine-slice",
+                slice=len(ys_parts), base_round=int(base), rounds=int(L),
+                total_rounds=int(total),
+            ) if _bb.enabled else 0
+            try:
+                carry, seg, ys = self._seg_fn(L)(
+                    sx, carry, seg, jnp.asarray(base, jnp.int32)
+                )
+                # the slice boundary IS a blocking sync: the device must
+                # be genuinely idle before the scheduler may hand it to
+                # an urgent request (seg[2] is the in-graph `done` flag)
+                ys_host, done = jax.device_get((ys, seg[2]))
+            except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+                _bb.end(bb_seq, ok=False, error=repr(e))
+                raise
+            _bb.end(bb_seq, done=bool(done))
             wall = time.monotonic() - t0s
             device_s += wall
             ys_parts.append(ys_host)

@@ -21,6 +21,10 @@ from cruise_control_tpu.analyzer.objective import (
     balancedness_score,
 )
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.blackbox import (
+    RECORDER as _BLACKBOX,
+    blackbox_context,
+)
 from cruise_control_tpu.analyzer.proposals import (
     ExecutionProposal,
     ProposalSet,
@@ -609,10 +613,35 @@ class GoalOptimizer:
         recorder's analyzer stage."""
         cfg = config or self.config
         with self.tracer.span("analyzer.optimize", component="analyzer") as sp:
-            result = self._optimize_routed(
-                state, options, verbose, cfg,
-                initial_placement=initial_placement, prior=prior,
-            )
+            if _BLACKBOX.enabled:
+                # stamp the dispatch context the black-box spool's leaf
+                # records (supervised / device-op / engine-slice) cannot
+                # know themselves: which bucket, which search config,
+                # which parallel mode — the "what was it doing" half of a
+                # hang post-mortem (common/blackbox.py)
+                import hashlib
+
+                bucketed = (
+                    self.shape_bucket.bucket_shape(state.shape)
+                    if self.shape_bucket is not None
+                    else state.shape
+                )
+                ctx = blackbox_context(
+                    bucket=self._bucket_key(bucketed),
+                    config_fp=hashlib.sha1(
+                        repr(cfg).encode()
+                    ).hexdigest()[:12],
+                    parallel_mode=self.parallel_mode,
+                )
+            else:
+                import contextlib
+
+                ctx = contextlib.nullcontext()
+            with ctx:
+                result = self._optimize_routed(
+                    state, options, verbose, cfg,
+                    initial_placement=initial_placement, prior=prior,
+                )
             timing = next((h for h in result.history if h.get("timing")), {})
             sp.set(
                 parallel_mode=self.parallel_mode,

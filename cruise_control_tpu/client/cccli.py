@@ -155,12 +155,22 @@ ENDPOINTS: dict[str, dict] = {
     # observability: flight-recorder replay + Prometheus exposition.
     # `cccli trace` lists recent root traces; `cccli trace --id <traceId>`
     # (the _traceId of any async response, or a TraceId from user_tasks)
-    # replays the span tree.  `cccli metrics` prints the exposition text
-    # verbatim (NOT JSON) — pipe it to promtool or grep.
+    # replays the span tree; `cccli trace --blackbox true` additionally
+    # embeds the on-disk black-box dispatch spool (tail + in-flight
+    # dispatches — the durable twin of the in-memory store).  `cccli
+    # metrics` prints the exposition text verbatim (NOT JSON) — pipe it
+    # to promtool or grep; `--format openmetrics` adds trace-id
+    # exemplars on histogram buckets.
     "trace": {"method": "GET", "endpoint": "trace",
               "params": {"--id": ("id", str),
-                         "--limit": ("limit", positive_int_param)}},
-    "metrics": {"method": "GET", "endpoint": "metrics", "params": {}},
+                         "--limit": ("limit", positive_int_param),
+                         "--blackbox": ("blackbox", boolean_param)}},
+    "metrics": {"method": "GET", "endpoint": "metrics",
+                "params": {"--format": ("format", str)}},
+    # SLO registry: burn rates, compliance and breach episodes per
+    # cluster (`cccli slo`; pair with the global --cluster flag to
+    # filter one cluster of a fleet)
+    "slo": {"method": "GET", "endpoint": "slo", "params": {}},
     # fleet controller: whole-instance rollup (`cccli fleet`); pair the
     # other subcommands with the global --cluster flag to target one
     # cluster of a fleet (e.g. `cccli --cluster east rebalance`)

@@ -66,15 +66,16 @@ def reset_engine_trace_counts() -> None:
 def _scan(cache_dir: str) -> tuple[set[str], int]:
     """(entry names, total bytes) currently on disk; tolerant of races.
 
-    Prunes the `prewarm` subdirectory: the boot-prewarm manifest and AOT
-    artifacts (analyzer/prewarm.py) live INSIDE the cache dir by default
-    so they share its mount/durability, and their writes must not read
-    as XLA compile-cache hits/misses in boot_report()."""
+    Prunes the `prewarm` and `blackbox` subdirectories: the boot-prewarm
+    manifest + AOT artifacts (analyzer/prewarm.py) and the black-box
+    dispatch spool (common/blackbox.py) live INSIDE the cache dir by
+    default so they share its mount/durability, and their writes must
+    not read as XLA compile-cache hits/misses in boot_report()."""
     entries: set[str] = set()
     total = 0
     try:
         for root, _dirs, files in os.walk(cache_dir):
-            _dirs[:] = [d for d in _dirs if d != "prewarm"]
+            _dirs[:] = [d for d in _dirs if d not in ("prewarm", "blackbox")]
             for fn in files:
                 path = os.path.join(root, fn)
                 entries.add(os.path.relpath(path, cache_dir))

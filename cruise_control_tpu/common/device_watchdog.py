@@ -36,6 +36,8 @@ import random
 import threading
 import time
 
+from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
+
 
 def _trivial_device_op() -> None:
     """The watchdog's probe payload: one tiny reduction through the
@@ -121,6 +123,29 @@ def device_op(name: str):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             hook = _DEVICE_OP_HOOK
+            if _BLACKBOX.enabled:
+                # black-box spool (common/blackbox.py): the Begin record
+                # is on disk BEFORE anything that could block — including
+                # the memory probe below, which queries the same runtime
+                # that may be wedged (a hang inside it must still leave
+                # this op in flight in the trail).  Best-effort
+                # per-device memory (OOM post-mortems) rides the End
+                # record instead.  One predicate read on the disabled
+                # path.
+                seq = _BLACKBOX.begin("device-op", op=name)
+                try:
+                    if hook is not None:
+                        result = hook(name, fn, args, kwargs)
+                    else:
+                        result = fn(*args, **kwargs)
+                except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+                    _BLACKBOX.end(seq, ok=False, error=repr(e))
+                    raise
+                mem = _memory_in_use()
+                _BLACKBOX.end(
+                    seq, **({"mem_bytes": mem} if mem is not None else {})
+                )
+                return result
             if hook is not None:
                 return hook(name, fn, args, kwargs)
             return fn(*args, **kwargs)
@@ -129,6 +154,20 @@ def device_op(name: str):
         return wrapper
 
     return deco
+
+
+def _memory_in_use() -> int | None:
+    """Best-effort bytes-in-use across local devices for the black-box
+    supervised record (None where the backend has no stats — host CPU,
+    or an uninitialized/wedged runtime this probe must never touch
+    dangerously)."""
+    try:
+        from cruise_control_tpu.common.profiling import _memory_stat
+
+        v = _memory_stat("bytes_in_use")
+        return int(v) if v else None
+    except Exception:  # noqa: BLE001 — telemetry, never the dispatch
+        return None
 
 
 _probe_op = device_op("probe")(_trivial_device_op)
@@ -506,26 +545,42 @@ class DeviceSupervisor:
         t = threading.Thread(
             target=worker, daemon=True, name=f"supervised-{op}"
         )
+        # black-box Begin BEFORE the worker starts: the supervised call's
+        # budget and op land on disk ahead of any chance to block, and the
+        # ABANDONMENT verdict below (the one outcome the in-worker
+        # device-op record can never write — its thread is the thing that
+        # hung) closes the pair.  Deliberately NO runtime introspection on
+        # this thread: querying a wedged runtime can itself hang, and this
+        # thread is the one enforcing the deadline.
+        bb_seq = _BLACKBOX.begin(
+            "supervised", op=op, timeout_s=round(timeout_s, 3)
+        )
         t.start()
         # deadline extended by scheduler-imposed pause: a segmented
         # dispatch parked at a preemption checkpoint while URGENT work
         # runs is healthy — billing that wait here would turn sustained
         # urgent load into spurious DeviceHangError breaker failures
         pause = _current_pause_clock()
-        if pause is None:
-            if not done.wait(timeout_s):
-                raise DeviceHangError(op, timeout_s)
-        else:
-            base = pause()
-            deadline = time.monotonic() + timeout_s
-            while True:
-                remaining = deadline + max(0.0, pause() - base) - time.monotonic()
-                if remaining <= 0:
+        try:
+            if pause is None:
+                if not done.wait(timeout_s):
                     raise DeviceHangError(op, timeout_s)
-                if done.wait(min(remaining, 0.5)):
-                    break
+            else:
+                base = pause()
+                deadline = time.monotonic() + timeout_s
+                while True:
+                    remaining = deadline + max(0.0, pause() - base) - time.monotonic()
+                    if remaining <= 0:
+                        raise DeviceHangError(op, timeout_s)
+                    if done.wait(min(remaining, 0.5)):
+                        break
+        except DeviceHangError:
+            _BLACKBOX.end(bb_seq, ok=False, hang=True, abandoned=True)
+            raise
         if "error" in box:
+            _BLACKBOX.end(bb_seq, ok=False, error=repr(box["error"]))
             raise box["error"]
+        _BLACKBOX.end(bb_seq)
         return box.get("result")
 
     def call(self, fn, *, op: str = "optimize", timeout_s: float | None = None):

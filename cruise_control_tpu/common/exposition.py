@@ -25,6 +25,16 @@ built on it.
 scripts/check.sh lint gate and the tests: TYPE-before-samples, one TYPE
 per family, counter naming + non-negativity, label syntax/escaping, and
 histogram bucket monotonicity (with the `+Inf` bucket == `_count`).
+
+OpenMetrics flavor (`prometheus_text(..., openmetrics=True)` — served
+when `GET /metrics` is asked for `application/openmetrics-text` or
+`?format=openmetrics`): the same family structure plus EXEMPLARS on
+histogram `_bucket` samples (` # {trace_id="..."} <value> <ts>`) and the
+terminating `# EOF` line.  Exemplars are how a latency panel's p99
+outlier links straight to its `/trace` replay — each Histogram sensor
+keeps the latest exemplar per bucket (common/sensors.py).  The lint
+parser accepts and validates the exemplar syntax on `_bucket`/`_total`
+samples and rejects it anywhere else.
 """
 
 from __future__ import annotations
@@ -43,6 +53,9 @@ from cruise_control_tpu.common.sensors import (
 )
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _LABEL_NAME_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
@@ -83,7 +96,9 @@ def _labels(labels: dict) -> str:
     return "{" + ",".join(parts) + "}"
 
 
-def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
+def prometheus_text(
+    registry, *, namespace: str = "cruisecontrol", openmetrics: bool = False
+) -> str:
     """Render one registry — or a sequence of them — in the exposition
     format; ends with a newline.
 
@@ -92,7 +107,13 @@ def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
     `{cluster: "east"}`) are stamped onto every sample, and the shared
     core's registry rides unlabeled beside them.  All samples of one
     family are emitted as one group (the format requires it) regardless
-    of which registry contributed them, with ONE TYPE line per family."""
+    of which registry contributed them, with ONE TYPE line per family.
+
+    `openmetrics=True` additionally renders each Histogram bucket's
+    latest exemplar (` # {trace_id=...} value ts`) and terminates the
+    body with `# EOF`; the default 0.0.4 text stays byte-identical to
+    before exemplars existed (scrapers that never asked for OpenMetrics
+    must never see its syntax)."""
     registries = (
         [registry] if isinstance(registry, SensorRegistry) else list(registry)
     )
@@ -141,11 +162,21 @@ def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
             elif isinstance(sensor, Histogram):
                 fam, out = family(name, "", "histogram")
                 cum, total, n = sensor.cumulative()
+                exemplars = (
+                    {b: (v, lab, ts) for b, v, lab, ts in sensor.exemplars()}
+                    if openmetrics
+                    else {}
+                )
                 for bound, c in cum:
                     le = "+Inf" if bound == float("inf") else _fmt(bound)
-                    out.append(
-                        f"{fam}_bucket{_labels({**base, 'le': le})} {_fmt(c)}"
-                    )
+                    line = f"{fam}_bucket{_labels({**base, 'le': le})} {_fmt(c)}"
+                    ex = exemplars.get(bound)
+                    if ex is not None:
+                        v, lab, ts = ex
+                        line += (
+                            f" # {_labels(lab) or '{}'} {_fmt(v)} {_fmt(ts)}"
+                        )
+                    out.append(line)
                 out.append(f"{fam}_sum{blk} {_fmt(total)}")
                 out.append(f"{fam}_count{blk} {_fmt(n)}")
             elif isinstance(sensor, Collector):
@@ -162,6 +193,8 @@ def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
         lines.append(f"# HELP {fam} sensor {info['sensor']}")
         lines.append(f"# TYPE {fam} {info['type']}")
         lines.extend(info["lines"])
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -171,9 +204,13 @@ def prometheus_text(registry, *, namespace: str = "cruisecontrol") -> str:
 
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>.*)\})?"
+    r"(?:\{(?P<labels>.*?)\})?"
     r"\s+(?P<value>[^\s]+)"
-    r"(?:\s+(?P<ts>-?\d+))?$"
+    r"(?:\s+(?P<ts>-?\d+))?"
+    # OpenMetrics exemplar: ` # {labels} value [timestamp]` — rendered
+    # only on histogram buckets; linted wherever it appears
+    r"(?:\s+#\s+\{(?P<exlabels>.*?)\}\s+(?P<exvalue>[^\s]+)"
+    r"(?:\s+(?P<exts>[^\s]+))?)?$"
 )
 _LABEL_RE = re.compile(
     r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"(?P<value>(?:[^"\\]|\\["\\n])*)"\s*(?:,|$)'
@@ -269,6 +306,24 @@ def parse_exposition(text: str) -> dict[str, dict]:
             raise ExpositionError(
                 f"line {lineno}: counter {fam!r} is negative ({value})"
             )
+        if m.group("exvalue") is not None:
+            # exemplar lint: allowed only where OpenMetrics allows them
+            # (histogram buckets, counters), with valid label syntax and
+            # a parseable value
+            if not (name.endswith("_bucket") or name.endswith("_total")):
+                raise ExpositionError(
+                    f"line {lineno}: exemplar on non-bucket/counter "
+                    f"sample {name!r}"
+                )
+            if m.group("exlabels"):
+                _parse_labels(m.group("exlabels"))
+            try:
+                float(m.group("exvalue"))
+            except ValueError as e:
+                raise ExpositionError(
+                    f"line {lineno}: unparseable exemplar value "
+                    f"{m.group('exvalue')!r}"
+                ) from e
         families[fam]["samples"].append((name, labels, value))
 
     # histogram structural lint: buckets cumulative + +Inf == _count.

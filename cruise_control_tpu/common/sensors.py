@@ -246,7 +246,13 @@ class Histogram:
     """Fixed-boundary histogram with exportable cumulative buckets — the
     sensor type the Prometheus exposition needs (a Timer's bounded sample
     window yields quantiles, but quantiles cannot be aggregated across
-    instances; buckets can)."""
+    instances; buckets can).
+
+    `observe` optionally takes an EXEMPLAR — a small label dict (by
+    convention `{"trace_id": ...}`) naming one concrete observation that
+    landed in that bucket.  The OpenMetrics exposition renders the latest
+    exemplar per bucket, which is how a p99 outlier on a latency panel
+    links straight to its `/trace` replay."""
 
     def __init__(self, buckets=DEFAULT_HISTOGRAM_BUCKETS) -> None:
         bounds = tuple(sorted(float(b) for b in buckets))
@@ -258,10 +264,12 @@ class Histogram:
         self.bounds = bounds
         # per-bucket (non-cumulative) counts; last slot is the +Inf bucket
         self._counts = [0] * (len(bounds) + 1)
+        # latest exemplar per bucket: (value, labels, wall_ts) or None
+        self._exemplars: list = [None] * (len(bounds) + 1)
         self._sum = 0.0
         self._count = 0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
         import bisect
 
         i = bisect.bisect_left(self.bounds, float(value))
@@ -269,6 +277,8 @@ class Histogram:
             self._counts[i] += 1
             self._sum += float(value)
             self._count += 1
+            if exemplar:
+                self._exemplars[i] = (float(value), dict(exemplar), time.time())
 
     @property
     def count(self) -> int:
@@ -288,6 +298,42 @@ class Histogram:
             cum.append((bound, running))
         cum.append((float("inf"), running + counts[-1]))
         return cum, total, n
+
+    def exemplars(self) -> list:
+        """[(upper_bound, value, labels, wall_ts)] for buckets holding an
+        exemplar, ordered like `cumulative()`'s ladder (+Inf last)."""
+        with self._lock:
+            ex = list(self._exemplars)
+        bounds = list(self.bounds) + [float("inf")]
+        return [
+            (bounds[i], v, labels, ts)
+            for i, e in enumerate(ex)
+            if e is not None
+            for (v, labels, ts) in (e,)
+        ]
+
+    def quantile(self, q: float) -> float:
+        """Prometheus-style `histogram_quantile`: linear interpolation
+        within the bucket the q-th observation falls in (the +Inf bucket
+        answers its lower bound — the largest finite boundary).  NaN
+        before any observation."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        cum, _total, n = self.cumulative()
+        if n == 0:
+            return float("nan")
+        rank = q * n
+        prev_bound, prev_count = 0.0, 0
+        for bound, c in cum:
+            if c >= rank:
+                if bound == float("inf"):
+                    return prev_bound  # unbounded bucket: report its floor
+                if c == prev_count:
+                    return bound
+                frac = (rank - prev_count) / (c - prev_count)
+                return prev_bound + (bound - prev_bound) * frac
+            prev_bound, prev_count = (bound if bound != float("inf") else prev_bound), c
+        return prev_bound
 
     def snapshot(self) -> dict:
         cum, total, n = self.cumulative()
@@ -378,6 +424,14 @@ class SensorRegistry:
         if fn is not None:
             c._fn = fn  # re-registration rebinds, like gauge callbacks
         return c
+
+    def get(self, name: str):
+        """The sensor registered under `name`, or None — WITHOUT
+        creating one (readers like the /fleet rollup must not mint a
+        default-boundary histogram the real producer would then be
+        stuck with)."""
+        with self._lock:
+            return self._sensors.get(name)
 
     def items(self) -> list[tuple[str, object]]:
         """Stable (name, sensor) listing — the exposition iterates this."""

@@ -313,6 +313,76 @@ def _observability_defs() -> ConfigDef:
              ) else (_ for _ in ()).throw(ConfigException(
                  f"{n}={v!r} is not a valid Prometheus name prefix")),
              group=g)
+    # --- black-box dispatch spool (common/blackbox.py) ---
+    g = "observability.blackbox"
+    d.define("blackbox.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "record every device dispatch (supervised calls, engine "
+             "runs, segmented-anneal slices, scheduler grants, "
+             "controller cycles) to a crash/hang-durable on-disk JSONL "
+             "ring spool — a hung or killed process leaves a readable "
+             "'last dispatch in flight' trail instead of a bare return "
+             "code.  Needs a durable directory (blackbox.dir, or derived "
+             "from executor.journal.dir / tpu.compile.cache.dir); "
+             "without one the recorder stays off.  Overhead is gated "
+             "<2% of a smoke proposal run (bench.py "
+             "--blackbox-overhead)", group=g)
+    d.define("blackbox.dir", T.STRING, None, I.LOW,
+             "directory of the black-box spool files "
+             "(spool-<pid>.jsonl).  Unset derives '_blackbox' inside "
+             "executor.journal.dir (the service's durable mount), "
+             "falling back to a 'blackbox' subdirectory of the "
+             "persistent compile cache; explicitly empty disables",
+             group=g)
+    d.define("blackbox.spool.max.records", T.INT, 2048, I.LOW,
+             "ring size: the active spool file rotates past this many "
+             "records, keeping one previous generation — bounded disk "
+             "forever", in_range(lo=64), group=g)
+    d.define("blackbox.fsync.batch.records", T.INT, 32, I.LOW,
+             "records between fsyncs.  Every record is flushed to the "
+             "kernel synchronously (process death of any flavor cannot "
+             "lose it); fsync batching only bounds what machine power "
+             "loss could cost, exactly like the executor journal's "
+             "batch knob", in_range(lo=1), group=g)
+    # --- SLO registry + burn-rate alerting (common/slo.py) ---
+    g = "observability.slo"
+    d.define("slo.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "continuously evaluate the service-level objectives "
+             "(per-cluster proposal freshness against "
+             "fleet.scheduler.freshness.slo.s, cold-start-to-first-"
+             "proposal, streaming publish latency, urgent queue wait) "
+             "with fast/slow multi-window error-budget burn rates; a "
+             "sustained breach raises one alert-only SLO_BURN anomaly "
+             "per episode through the detector/notifier and is served "
+             "by GET /slo + Prometheus slo.* gauges", group=g)
+    d.define("slo.tick.interval.s", T.DOUBLE, 5.0, I.LOW,
+             "cadence of the background SLO evaluation loop (probes "
+             "sampled, burn rates re-evaluated, episodes fired/cleared); "
+             "GET /slo additionally evaluates on every scrape",
+             in_range(lo=0.1), group=g)
+    d.define("slo.burn.fast.window.s", T.DOUBLE, 300.0, I.MEDIUM,
+             "fast burn-rate window: catches a new fire quickly; an "
+             "episode fires only when BOTH windows burn past "
+             "slo.burn.threshold", in_range(lo=1.0), group=g)
+    d.define("slo.burn.slow.window.s", T.DOUBLE, 3600.0, I.MEDIUM,
+             "slow burn-rate window: keeps one noisy sample from paging "
+             "— must be >= the fast window",
+             in_range(lo=1.0), group=g)
+    d.define("slo.burn.threshold", T.DOUBLE, 10.0, I.MEDIUM,
+             "error-budget burn multiple (1.0 = consuming the budget "
+             "exactly at the sustainable rate) both windows must reach "
+             "to open a breach episode", in_range(lo=1.0), group=g)
+    d.define("slo.streaming.publish.target.s", T.DOUBLE, 1.0, I.MEDIUM,
+             "good/bad threshold of the streaming-publish SLO: a window "
+             "roll whose superseding proposal publishes within this wall "
+             "is a good sample (ROADMAP item 4's sub-second control-loop "
+             "target, measured by "
+             "controller.window-roll-to-publish-seconds)",
+             in_range(lo=0.001), group=g)
+    d.define("slo.coldstart.target.s", T.DOUBLE, 60.0, I.MEDIUM,
+             "good/bad threshold of the cold-start SLO: start_up to the "
+             "first served/published proposal (PR 10's restart SLO, "
+             "bench.py --coldstart), one sample per process",
+             in_range(lo=0.1), group=g)
     return d
 
 
@@ -1185,6 +1255,29 @@ class CruiseControlConfig(AbstractConfig):
         if not cache:
             return None
         return os.path.join(os.path.expanduser(cache), "prewarm")
+
+    def blackbox_dir(self) -> str | None:
+        """Directory of the black-box dispatch spool (common/blackbox.py),
+        or None when disabled / no durable directory exists.  Unset
+        derives '_blackbox' inside executor.journal.dir — the spool must
+        survive exactly the crashes the journal survives, so they share
+        one mount — falling back to a 'blackbox' subdirectory of the
+        persistent compile cache.  An explicitly empty value disables,
+        like compile_cache_dir."""
+        import os
+
+        if not self.get("blackbox.enabled"):
+            return None
+        v = self.get("blackbox.dir")
+        if v is not None:
+            return v or None
+        journal = self.get("executor.journal.dir")
+        if journal:
+            return os.path.join(os.path.expanduser(journal), "_blackbox")
+        cache = self.compile_cache_dir()
+        if not cache:
+            return None
+        return os.path.join(os.path.expanduser(cache), "blackbox")
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")

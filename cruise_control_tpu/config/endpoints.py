@@ -11,14 +11,15 @@ through package __init__s).
 GET_ENDPOINTS = (
     "bootstrap", "train", "load", "partition_load", "proposals", "state",
     "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
-    "trace", "metrics", "fleet",
+    "trace", "metrics", "fleet", "slo",
 )
 
 #: endpoints that are fleet-GLOBAL: in fleet mode they answer for the
 #: whole instance (rollups, shared stores) and never require `cluster=`;
 #: every other endpoint is cluster-scoped and must name its cluster
 FLEET_GLOBAL_ENDPOINTS = frozenset(
-    {"fleet", "metrics", "trace", "user_tasks", "review_board", "review"}
+    {"fleet", "metrics", "trace", "user_tasks", "review_board", "review",
+     "slo"}
 )
 POST_ENDPOINTS = (
     "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
@@ -59,6 +60,8 @@ ENDPOINT_TYPES = {
     "metrics": "CRUISE_CONTROL_MONITOR",
     # fleet controller: whole-instance rollup over every managed cluster
     "fleet": "CRUISE_CONTROL_MONITOR",
+    # SLO registry: burn rates + episode state (read-only)
+    "slo": "CRUISE_CONTROL_MONITOR",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
 

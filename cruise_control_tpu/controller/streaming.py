@@ -42,12 +42,21 @@ import time
 
 import numpy as np
 
+from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
 from cruise_control_tpu.controller.prior import MoveAcceptancePrior
 from cruise_control_tpu.models.whatif import LiveState
 from cruise_control_tpu.monitor import ModelCompletenessRequirements
 from cruise_control_tpu.monitor.delta import extract_window_delta
 
 log = logging.getLogger(__name__)
+
+#: latency-shaped bucket boundaries for the streaming hot path — finer
+#: below 1 s than the default ladder because the headline target
+#: (`slo.streaming.publish.target.s`, ROADMAP item 4) is sub-second
+STREAMING_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0,
+    1.5, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
 
 
 @dataclasses.dataclass
@@ -128,6 +137,20 @@ class StreamingController:
                 "min.valid.partition.ratio"
             ),
         )
+        #: streaming publish-latency SLO target (`slo.streaming.publish.
+        #: target.s`): each window-roll-to-publish wall feeds the
+        #: "streaming-publish" SLO as a good/bad sample
+        self._publish_target_s = cfg.get("slo.streaming.publish.target.s")
+        # mint the hot-path histograms EAGERLY so their boundaries are
+        # always the streaming ladder — a reader getting there first must
+        # never fix them at the default ladder
+        for stage in (
+            "window-roll-to-publish", "delta-extract", "scatter", "anneal",
+            "host-extract", "publish",
+        ):
+            self.sensors.histogram(
+                f"controller.{stage}-seconds", buckets=STREAMING_BUCKETS
+            )
         self._live: LiveState | None = None
         self._index: _ModelIndex | None = None
         self._warm = None  # (shape, replica_broker, replica_is_leader, replica_disk)
@@ -228,11 +251,46 @@ class StreamingController:
             "controller.window-roll", component="controller",
             window_index=int(cur_w),
         ) as sp:
-            info = self._cycle(history, sp)
+            if _BLACKBOX.enabled:
+                # the cycle is a dispatch-bearing unit of work: its
+                # begin/end (and any hang between them) belongs in the
+                # durable spool beside the engine records it triggers
+                with _BLACKBOX.record(
+                    "controller-cycle", window=int(cur_w),
+                    cluster=self.cc.cluster_id or "",
+                ):
+                    info = self._cycle(history, sp)
+            else:
+                info = self._cycle(history, sp)
+            wall = time.monotonic() - t0
+            if info.get("published"):
+                # the HEADLINE latency: metric-window roll observed ->
+                # superseding proposal published, with the cycle's trace
+                # id as the OpenMetrics exemplar so a p99 outlier on a
+                # dashboard links straight to its /trace replay
+                self.sensors.histogram(
+                    "controller.window-roll-to-publish-seconds",
+                    buckets=STREAMING_BUCKETS,
+                ).observe(
+                    wall,
+                    exemplar=(
+                        {"trace_id": sp.trace_id} if sp.trace_id else None
+                    ),
+                )
+                reg = getattr(self.cc, "slo_registry", None)
+                # the FIRST cycle pays the cold XLA compile and will blow
+                # any sub-second target — that wall is the cold-start
+                # SLO's sample, and feeding it here would fire a spurious
+                # SLO_BURN on every restart (the histogram above still
+                # reports it honestly)
+                if reg is not None and self._stats["incrementalAnneals"] > 1:
+                    reg.record(
+                        "streaming-publish", wall <= self._publish_target_s
+                    )
         self._last_window = cur_w
         self._stats["windowRolls"] += 1
         self._stats["lastWindowIndex"] = int(cur_w)
-        self._stats["lastWallSeconds"] = round(time.monotonic() - t0, 6)
+        self._stats["lastWallSeconds"] = round(wall, 6)
         self.sensors.counter("controller.window-rolls").inc()
         return info
 
@@ -260,10 +318,14 @@ class StreamingController:
             info["reflattened"] = True
             info["reflatten_reason"] = reason
         else:
+            t_ex = time.monotonic()
             delta = extract_window_delta(
                 idx.history, history,
                 self.monitor.partition_aggregator.metric_def,
                 prev_reduced=idx.reduced,
+            )
+            self._stage_observe(
+                "controller.delta-extract-seconds", time.monotonic() - t_ex, sp
             )
             if delta.requires_reflatten:
                 # topics/partitions appeared or vanished mid-stream: the
@@ -272,7 +334,11 @@ class StreamingController:
                 info["reflattened"] = True
                 info["reflatten_reason"] = "entities"
             else:
+                t_sc = time.monotonic()
                 info["delta_partitions"] = self._apply_delta(delta)
+                self._stage_observe(
+                    "controller.scatter-seconds", time.monotonic() - t_sc, sp
+                )
                 idx.history = history
                 idx.reduced = delta.reduced
         sp.set(
@@ -281,6 +347,16 @@ class StreamingController:
         )
         info.update(self._anneal(sp))
         return info
+
+    def _stage_observe(self, name: str, wall_s: float, sp) -> None:
+        """One hot-path stage sample into its latency Histogram, exemplar
+        = this cycle's trace id (delta-extract / scatter / anneal /
+        host-extract / publish — the stages `controller.window-roll-to-
+        publish-seconds` is the sum of)."""
+        self.sensors.histogram(name, buckets=STREAMING_BUCKETS).observe(
+            wall_s,
+            exemplar={"trace_id": sp.trace_id} if sp.trace_id else None,
+        )
 
     # ----------------------------------------------------- flatten / delta
 
@@ -408,14 +484,19 @@ class StreamingController:
             # keep measuring anneal wall, not scheduler queue wait —
             # fleet.scheduler.wait-timer.background already reports the
             # wait separately
+            t_an = time.monotonic()
             with self.sensors.timer("controller.anneal-timer").time():
-                return self.optimizer.optimize(
+                r = self.optimizer.optimize(
                     state,
                     options=options,
                     config=cfg,
                     initial_placement=warm,
                     prior=prior_table,
                 )
+            self._stage_observe(
+                "controller.anneal-seconds", time.monotonic() - t_an, sp
+            )
+            return r
 
         if sched is None:
             result = _run()
@@ -440,6 +521,14 @@ class StreamingController:
                             published=False)
         if brownout:
             self._stats["brownoutCycles"] += 1
+        timing = next((h for h in result.history if h.get("timing")), {})
+        if timing.get("host_extract_s") is not None:
+            # the fused run's one blocking host fetch — the stage the
+            # ROADMAP fusion audit targets, now measured per cycle
+            self._stage_observe(
+                "controller.host-extract-seconds",
+                timing["host_extract_s"], sp,
+            )
         rounds = sum(1 for h in result.history if not h.get("timing"))
         after = result.state_after
         self._warm = (
@@ -447,8 +536,12 @@ class StreamingController:
             after.replica_disk,
         )
         observed = self.prior.observe_proposals(result.proposals, catalog)
+        t_pub = time.monotonic()
         published = self.cc.publish_proposal(
             result, generation=self._index.model_generation()
+        )
+        self._stage_observe(
+            "controller.publish-seconds", time.monotonic() - t_pub, sp
         )
         self._stats["incrementalAnneals"] += 1
         self._stats["lastRounds"] = rounds

@@ -39,6 +39,10 @@ class AnomalyType(enum.Enum):
     #: or browned out and interactive admissions may 429 — the shared
     #: device cannot keep up with the fleet's demand
     FLEET_OVERLOAD = 8
+    #: an SLO's error budget is burning past its multi-window threshold
+    #: (common/slo.py): proposal freshness, streaming publish latency,
+    #: cold-start or urgent queue-wait is sustainedly out of objective
+    SLO_BURN = 9
 
     @property
     def priority(self) -> int:
@@ -230,6 +234,37 @@ class FleetOverload(Anomaly):
             f"FleetOverload(episode={self.episode}, "
             f"queueDepth={self.queue_depth}, "
             f"missRatio={self.deadline_miss_ratio})"
+        )
+
+
+@dataclasses.dataclass
+class SloBurn(Anomaly):
+    """An SLO registry (common/slo.py) observed its error budget burning
+    at >= `slo.burn.threshold` times the sustainable rate over BOTH the
+    fast and the slow window — a sustained breach, not a blip.  Fired
+    EXACTLY once per breach episode by the registry itself; the episode
+    re-arms only after the fast window recovers.
+
+    Not self-healable by the detector: whatever is burning the budget
+    (overload, a wedged device, a slow cold start) has its own
+    mitigation ladder — alert-only, like OPTIMIZER_DEGRADED and
+    FLEET_OVERLOAD, so operators hear the objective is at risk while
+    the budget still has headroom."""
+
+    anomaly_type: AnomalyType = AnomalyType.SLO_BURN
+    slo: str = ""
+    cluster_id: str = ""
+    objective: float = 0.0
+    fast_burn_rate: float = 0.0
+    slow_burn_rate: float = 0.0
+    episode: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"SloBurn(slo={self.slo}, cluster={self.cluster_id or '-'}, "
+            f"objective={self.objective}, burn={self.fast_burn_rate}x fast / "
+            f"{self.slow_burn_rate}x slow, episode={self.episode})"
         )
 
 

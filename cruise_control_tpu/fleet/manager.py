@@ -103,6 +103,20 @@ class ClusterContext:
                 "running": cc.controller.running,
                 "windowRolls": cc.controller.state_json()["windowRolls"],
             }
+            hist = cc.sensors.get(
+                "controller.window-roll-to-publish-seconds"
+            )
+            if hist is not None and hist.count:
+                # the streaming hot path's headline latency (ROADMAP item
+                # 4's p99 target), estimated from the exportable buckets
+                out["controller"]["windowRollToPublishSeconds"] = {
+                    "count": hist.count,
+                    "p50": round(hist.quantile(0.5), 6),
+                    "p99": round(hist.quantile(0.99), 6),
+                }
+        if cc.slo_registry is not None:
+            # burn-rate summary per SLO (full detail on GET /slo)
+            out["slo"] = cc.slo_registry.summary_json()
         recovery = cc.executor.recovery_info()
         if recovery is not None:
             out["recovered"] = True

@@ -55,6 +55,11 @@ import threading
 import time
 from collections import deque
 
+from cruise_control_tpu.common.blackbox import (
+    RECORDER as _BLACKBOX,
+    blackbox_context,
+)
+
 log = logging.getLogger(__name__)
 
 
@@ -207,6 +212,11 @@ class DeviceScheduler:
         #: FLEET_OVERLOAD episode alert rides; the first facade built
         #: over the core claims it (service/facade.py)
         self.anomaly_sink = anomaly_sink
+        #: SloRegistry (common/slo.py) fed one good/bad sample per URGENT
+        #: grant — good when the queue-to-dispatch wait met the class
+        #: deadline (one slice budget); claimed by the first facade over
+        #: the core, exactly like the anomaly sink
+        self.slo_registry = None
         self._cond = threading.Condition()
         self._waiting: list[_Ticket] = []
         self._holder: _Ticket | None = None
@@ -490,32 +500,48 @@ class DeviceScheduler:
                 self.sensors.counter(
                     f"fleet.scheduler.deadline-misses.{cls}"
                 ).inc()
+        if work_class is WorkClass.URGENT and self.slo_registry is not None:
+            # the urgent queue-wait SLO: one sample per grant, good when
+            # the wait landed inside the class deadline (one slice budget
+            # — the preemption bound the scheduler promises)
+            self.slo_registry.record("urgent-queue-wait", not missed)
+        # black-box instant: the grant's class/wait/deadline verdict land
+        # in the durable spool, and the context stamps them onto every
+        # device record this grant dispatches (common/blackbox.py)
+        if _BLACKBOX.enabled:
+            _BLACKBOX.event(
+                "sched-grant", work_class=cls, op=op, cluster=cluster_id,
+                queue_wait_s=round(wait, 4), deadline_missed=missed,
+            )
         if preemptible is None:
             preemptible = work_class is not WorkClass.URGENT
         token = _HELD.set(ticket)
         try:
-            if preemptible and self.slice_budget_s > 0:
-                from cruise_control_tpu.analyzer.engine import (
-                    SegmentContext,
-                    segmented_execution,
-                )
-                from cruise_control_tpu.common.device_watchdog import (
-                    pause_clock_scope,
-                )
+            with blackbox_context(
+                work_class=cls, queue_wait_s=round(wait, 4)
+            ):
+                if preemptible and self.slice_budget_s > 0:
+                    from cruise_control_tpu.analyzer.engine import (
+                        SegmentContext,
+                        segmented_execution,
+                    )
+                    from cruise_control_tpu.common.device_watchdog import (
+                        pause_clock_scope,
+                    )
 
-                ctx = SegmentContext(
-                    self.slice_budget_s,
-                    checkpoint=lambda t=ticket: self._checkpoint(t),
-                )
-                # the supervisor's hang budget must exclude time WE
-                # pause this dispatch at preemption checkpoints —
-                # including a pause still in progress
-                with pause_clock_scope(
-                    lambda t=ticket: self._ticket_pause_s(t)
-                ):
-                    with segmented_execution(ctx):
-                        return fn()
-            return fn()
+                    ctx = SegmentContext(
+                        self.slice_budget_s,
+                        checkpoint=lambda t=ticket: self._checkpoint(t),
+                    )
+                    # the supervisor's hang budget must exclude time WE
+                    # pause this dispatch at preemption checkpoints —
+                    # including a pause still in progress
+                    with pause_clock_scope(
+                        lambda t=ticket: self._ticket_pause_s(t)
+                    ):
+                        with segmented_execution(ctx):
+                            return fn()
+                return fn()
         finally:
             _HELD.reset(token)
             self._release(ticket, granted_at)

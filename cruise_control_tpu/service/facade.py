@@ -154,6 +154,51 @@ class AnalyzerCore:
                 ),
                 sensors=self.sensors,
             )
+        #: black-box dispatch spool (common/blackbox.py, config
+        #: blackbox.*): the PROCESS-WIDE recorder is configured here — one
+        #: spool file per process under the journal/compile-cache mount,
+        #: shared by every facade over this core, so a hang or a kill
+        #: leaves a durable "last dispatch in flight" trail.  Disabled
+        #: (one predicate per dispatch, zero writes) when no durable
+        #: directory exists.
+        from cruise_control_tpu.common.blackbox import RECORDER as _bb
+
+        bb_dir = config.blackbox_dir()
+        if bb_dir:
+            import os
+
+            _bb.configure(
+                os.path.join(
+                    os.path.expanduser(bb_dir), f"spool-{os.getpid()}.jsonl"
+                ),
+                max_records=config.get("blackbox.spool.max.records"),
+                fsync_batch=config.get("blackbox.fsync.batch.records"),
+            )
+        else:
+            # blackbox.enabled=false / explicitly empty dir must DISABLE
+            # a recorder an earlier core in this process configured — the
+            # recorder is process-wide, and the disabled contract (zero
+            # writes) is pinned
+            _bb.configure(None)
+        self.blackbox = _bb
+        self.sensors.gauge(
+            "blackbox.enabled", lambda: 1.0 if _bb.enabled else 0.0
+        )
+        self.sensors.gauge(
+            "blackbox.records-written", lambda: float(_bb.state_json()["recordsWritten"])
+        )
+        self.sensors.gauge(
+            "blackbox.write-errors", lambda: float(_bb.write_errors)
+        )
+        #: ONE SLO evaluation loop per core (common/slo.py SloTicker):
+        #: every facade's registry ticks on this shared thread instead of
+        #: N clusters running N wakeup loops; no thread exists until the
+        #: first start_up adds a registry
+        from cruise_control_tpu.common.slo import SloTicker
+
+        self.slo_ticker = SloTicker(
+            interval_s=config.get("slo.tick.interval.s")
+        )
         #: boot-prewarm manifest + AOT artifact store (tpu.prewarm.*,
         #: analyzer/prewarm.py): ONE per core, so N fleet facades MERGE
         #: their bucket working sets into one manifest instead of
@@ -398,6 +443,72 @@ class CruiseControl:
         #: computed, -1 while none is published.  Per cluster via this
         #: facade's (labeled) registry.
         self.sensors.gauge("analyzer.proposal-age-seconds", self.proposal_age_s)
+        #: SLO registry (common/slo.py, config slo.*): per cluster, fed
+        #: by the controller (publish latency), the scheduler (urgent
+        #: queue wait), this facade (cold start) and a freshness probe;
+        #: burn episodes raise SLO_BURN through this cluster's detector
+        self.slo_registry = None
+        self._coldstart_t0 = time.monotonic()
+        self._coldstart_recorded = False
+        if config.get("slo.enabled"):
+            from cruise_control_tpu.common.slo import SloRegistry, SloSpec
+
+            reg = SloRegistry(
+                fast_window_s=config.get("slo.burn.fast.window.s"),
+                slow_window_s=config.get("slo.burn.slow.window.s"),
+                burn_threshold=config.get("slo.burn.threshold"),
+                sensors=self.sensors,
+                anomaly_sink=self.anomaly_detector.add_anomaly,
+                cluster_id=cluster_id or "",
+            )
+            fresh_s = self._freshness_slo_s
+            reg.register(SloSpec(
+                name="proposal-freshness",
+                description="a published/cached proposal no older than "
+                            "the per-cluster freshness SLO is available",
+                objective=0.99,
+                target=f"proposal age <= {fresh_s:g}s "
+                       "(fleet.scheduler.freshness.slo.s)",
+                # age < 0 = nothing published yet: no data, not a breach
+                # (a cold service is the cold-start SLO's business)
+                probe=lambda: (
+                    None if (age := self.proposal_age_s()) < 0
+                    else age <= self._freshness_slo_s
+                ),
+            ))
+            reg.register(SloSpec(
+                name="cold-start",
+                description="start to first served/published proposal "
+                            "within the restart SLO (one sample per "
+                            "process; bench.py --coldstart is the gate)",
+                objective=0.99,
+                target=f"<= {config.get('slo.coldstart.target.s'):g}s "
+                       "(slo.coldstart.target.s)",
+            ))
+            reg.register(SloSpec(
+                name="streaming-publish",
+                description="window-roll-to-published-proposal latency "
+                            "of the streaming controller's hot path "
+                            "(controller.window-roll-to-publish-seconds)",
+                objective=0.99,
+                target=f"<= {config.get('slo.streaming.publish.target.s'):g}s "
+                       "(slo.streaming.publish.target.s)",
+            ))
+            if core.scheduler is not None:
+                reg.register(SloSpec(
+                    name="urgent-queue-wait",
+                    description="URGENT engine dispatches granted within "
+                                "one slice budget (the scheduler's "
+                                "preemption bound)",
+                    objective=0.99,
+                    target="queue wait <= fleet.scheduler.slice.budget.s",
+                ))
+                if core.scheduler.slo_registry is None:
+                    # like the FLEET_OVERLOAD sink: the first facade over
+                    # the core claims the scheduler's SLO feed, so urgent
+                    # waits are one instance-level series
+                    core.scheduler.slo_registry = reg
+            self.slo_registry = reg
         self._wire_detectors()
         self._started_ms = int(time.time() * 1000)
         self._precompute_thread: threading.Thread | None = None
@@ -656,6 +767,13 @@ class CruiseControl:
                 target=self._precompute_loop, daemon=True, name="proposal-precompute"
             )
             self._precompute_thread.start()
+        if self.slo_registry is not None:
+            # continuous SLO evaluation: probes sampled + burn episodes
+            # fired with nobody scraping /slo (the alert path must not
+            # depend on being observed); the ticker thread is shared by
+            # every facade over this core
+            self.core.slo_ticker.add(self.slo_registry)
+            self.core.slo_ticker.start()
 
     def resume_recovered_async(self):
         """Background-drive a journal-reconciled execution remainder.
@@ -681,6 +799,9 @@ class CruiseControl:
         self._stop_precompute.set()
         if self.controller is not None:
             self.controller.stop()
+        if self.slo_registry is not None:
+            # the shared ticker stops itself once the last facade leaves
+            self.core.slo_ticker.remove(self.slo_registry)
         self.anomaly_detector.shutdown()
 
     def _precompute_loop(self):
@@ -971,6 +1092,7 @@ class CruiseControl:
         self.sensors.histogram("analyzer.proposal-computation-seconds").observe(
             result.wall_seconds
         )
+        self._record_coldstart_once()
         if storable:
             with self._cache_lock:
                 self._cache = _CachedResult(
@@ -1019,7 +1141,21 @@ class CruiseControl:
         # published anneal is this deployment's "first proposal pass" —
         # report the persistent compile cache's hit/miss split here too
         self._log_compile_cache_report()
+        self._record_coldstart_once()
         return True
+
+    def _record_coldstart_once(self) -> None:
+        """The cold-start SLO's one sample per process: facade
+        construction to the first computed/published proposal, good when
+        it landed inside `slo.coldstart.target.s` (the budget
+        bench.py --coldstart gates)."""
+        if self._coldstart_recorded or self.slo_registry is None:
+            return
+        self._coldstart_recorded = True
+        wall = time.monotonic() - self._coldstart_t0
+        self.slo_registry.record(
+            "cold-start", wall <= self.config.get("slo.coldstart.target.s")
+        )
 
     def _valid_cache(self) -> OptimizerResult | None:
         with self._cache_lock:

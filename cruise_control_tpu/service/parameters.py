@@ -218,8 +218,16 @@ _RAW_PARAMETERS: dict[str, tuple] = {
                         "trace id to replay (from _traceId of an async "
                         "response); omit to list recent root traces"),
                   Param("limit", _min1_int,
-                        "max recent traces listed without id (default 50)")),
-        "metrics": (),
+                        "max recent traces listed without id (default 50)"),
+                  Param("blackbox", _bool,
+                        "also embed the black-box dispatch spool's tail + "
+                        "in-flight dispatches (common/blackbox.py) — the "
+                        "durable twin of the in-memory trace store")),
+        "metrics": (Param("format", str,
+                          "'openmetrics' renders the OpenMetrics flavor "
+                          "with per-bucket trace-id exemplars (also "
+                          "negotiated via the Accept header)"),),
+        "slo": (),
         # --- fleet controller (whole-instance rollup) ---
         "fleet": (Param("score", _bool,
                         "also batch-score every cluster's current placement "

@@ -230,6 +230,9 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("traceId", STR, required=False),
         Field("spans", LIST, required=False),
         Field("traces", LIST, required=False),
+        # ?blackbox=true: the on-disk dispatch spool's state/tail/
+        # in-flight view (common/blackbox.py)
+        Field("blackbox", DICT, required=False),
     )),
     # GET /metrics is TEXT (Prometheus exposition 0.0.4), not JSON — the
     # schema entry satisfies the full-coverage gate; the body itself is
@@ -249,6 +252,13 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("shared", DICT),
         Field("scores", DICT, required=False),
         Field("ha", DICT, required=False),
+    )),
+    # GET /slo: per-cluster SLO registry state (burn rates, compliance,
+    # episode status), single-cluster deployments under "default" —
+    # common/slo.py
+    "slo": Schema((
+        Field("numClusters", NUM),
+        Field("clusters", DICT),
     )),
 }
 

@@ -417,6 +417,8 @@ class CruiseControlApp:
         # identical POST would silently resume client A's operation.
         client = headers.get("X-Client")
         self._local.client = client or ""
+        # content negotiation (the /metrics OpenMetrics flavor reads it)
+        self._local.accept = str(headers.get("Accept") or "")
         self._local.session_key = (
             self.sessions.session_key(
                 client, method, endpoint,
@@ -778,41 +780,95 @@ class CruiseControlApp:
             "train", lambda progress: runner.train(start, end)
         )
 
+    def _blackbox_block(self) -> dict:
+        """The black-box spool's live view (the durable twin of the
+        in-memory trace store): recorder state, the trailing records
+        re-read from disk, and the dispatches currently in flight."""
+        from cruise_control_tpu.common.blackbox import RECORDER
+
+        return {
+            "state": RECORDER.state_json(),
+            "records": RECORDER.tail(),
+            "inFlight": RECORDER.in_flight(),
+        }
+
     def _ep_trace(self, params) -> tuple[int, dict]:
         """GET /trace — flight-recorder replay.  With ?id=<traceId> the
         span forest of one trace (404 when nothing of it is retained);
-        without, a newest-first index of recent root traces."""
+        without, a newest-first index of recent root traces.  With
+        ?blackbox=true the response also embeds the on-disk dispatch
+        spool's tail + in-flight dispatches."""
         tid = params.get("id", [None])[0]
+        with_bb = _parse_bool(params, "blackbox", False)
         if tid is None:
             # the declared Param("limit", _min1_int) parser already 400'd
             # malformed/<1 values before dispatch reached this handler
             limit = int(params.get("limit", ["50"])[0])
-            return 200, {"traces": self.tracer.recent_traces(limit)}
+            out = {"traces": self.tracer.recent_traces(limit)}
+            if with_bb:
+                out["blackbox"] = self._blackbox_block()
+            return 200, out
         spans = self.tracer.trace_tree(tid)
         if not spans:
             # KeyError -> the dispatcher's 404 path: an unknown (or
             # already-evicted) trace id is "not found", not an empty tree
             raise KeyError(f"no retained spans for trace id {tid}")
-        return 200, {"traceId": tid, "spans": spans}
+        out = {"traceId": tid, "spans": spans}
+        if with_bb:
+            out["blackbox"] = self._blackbox_block()
+        return 200, out
 
     def _ep_metrics(self, params) -> tuple[int, dict]:
         """GET /metrics — Prometheus text exposition of the whole sensor
         surface (common/exposition.py); text/plain, not JSON.  Fleet mode
         renders EVERY registry: the shared core's unlabeled plus each
-        cluster's `{cluster=...}`-labeled one."""
+        cluster's `{cluster=...}`-labeled one.  `?format=openmetrics` (or
+        an Accept header naming application/openmetrics-text) renders the
+        OpenMetrics flavor: histogram buckets carry trace-id exemplars
+        linking latency outliers to their /trace replays."""
         from cruise_control_tpu.common.exposition import (
             CONTENT_TYPE,
+            CONTENT_TYPE_OPENMETRICS,
             prometheus_text,
         )
 
+        openmetrics = (
+            params.get("format", [""])[0].lower() == "openmetrics"
+            or "application/openmetrics-text"
+            in getattr(self._local, "accept", "")
+        )
         registries = (
             self.fleet.registries() if self.fleet is not None else self.cc.sensors
         )
         body = prometheus_text(
             registries,
             namespace=self.config.get("metrics.prometheus.namespace"),
+            openmetrics=openmetrics,
         )
-        return 200, RawResponse(body, CONTENT_TYPE)
+        return 200, RawResponse(
+            body, CONTENT_TYPE_OPENMETRICS if openmetrics else CONTENT_TYPE
+        )
+
+    def _ep_slo(self, params) -> tuple[int, dict]:
+        """GET /slo — the SLO registries' live state: per-SLO fast/slow
+        burn rates, compliance, and breach-episode status, evaluated
+        fresh on every scrape (common/slo.py).  Fleet mode reports every
+        cluster (or one, with ?cluster=); single-cluster deployments
+        answer under the synthetic id "default" like /fleet."""
+        cluster = params.get("cluster", [None])[0]
+
+        def block(cc) -> dict:
+            reg = cc.slo_registry
+            if reg is None:
+                return {"enabled": False, "slos": []}
+            return {"enabled": True, **reg.state_json()}
+
+        if self.fleet is None:
+            clusters = {"default": block(self._default_cc)}
+        else:
+            ids = [cluster] if cluster else self.fleet.cluster_ids()
+            clusters = {cid: block(self.fleet.facade(cid)) for cid in ids}
+        return 200, {"numClusters": len(clusters), "clusters": clusters}
 
     def _ep_fleet(self, params) -> tuple[int, dict]:
         """GET /fleet — whole-instance rollup: per-cluster summaries + the

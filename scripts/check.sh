@@ -199,10 +199,35 @@ echo "== check.sh: trace overhead gate (tracing-on adds <2% to a smoke run) =="
 GRAFT_FORCE_CPU=1 python bench.py --trace-overhead
 overhead_rc=$?
 
+echo "== check.sh: black-box overhead gate (spool-on adds <2%, disabled path writes nothing) =="
+# named gate: the crash-durable dispatch spool is ON by default wherever
+# a durable dir exists; its per-dispatch write+flush must stay
+# unmeasurable beside an engine run, recording must not perturb results
+# (byte-identical placements), and the disabled path must write zero bytes
+GRAFT_FORCE_CPU=1 python bench.py --blackbox-overhead
+blackbox_overhead_rc=$?
+
+echo "== check.sh: black-box gate (crash-durable spool, kill/hang post-mortems) =="
+# named gate: a process killed -9 (or hang-timed-out) mid-anneal must
+# leave a spool that replays to the exact in-flight dispatch (bucket,
+# slice index, wait class), the dryrun timeout verdict must embed
+# structured last-dispatch records, and the torn-tail/ring-rotation
+# reader invariants must hold
+python -m pytest tests/test_blackbox.py -q
+blackbox_rc=$?
+
+echo "== check.sh: SLO gate (burn-rate windows, once-per-episode alerting, /slo) =="
+# named gate: multi-window burn-rate math on injected clocks, a
+# sustained freshness breach fires SLO_BURN exactly once per episode
+# (twice across two episodes), burn gauges render in a lint-clean
+# /metrics scrape, and GET /slo serves the registry state
+python -m pytest tests/test_slo.py -q
+slo_rc=$?
+
 echo "== check.sh: flight-recorder unit gate (trace model, exposition parser) =="
 python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc coldstart=$coldstart_rc prewarm=$prewarm_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc fleet_ha=$fleet_ha_rc ha_smoke=$ha_smoke_rc scheduler=$scheduler_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$coldstart_rc" -eq 0 ] && [ "$prewarm_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$fleet_ha_rc" -eq 0 ] && [ "$ha_smoke_rc" -eq 0 ] && [ "$scheduler_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc coldstart=$coldstart_rc prewarm=$prewarm_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc fleet_ha=$fleet_ha_rc ha_smoke=$ha_smoke_rc scheduler=$scheduler_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc blackbox_overhead=$blackbox_overhead_rc blackbox=$blackbox_rc slo=$slo_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$coldstart_rc" -eq 0 ] && [ "$prewarm_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$fleet_ha_rc" -eq 0 ] && [ "$ha_smoke_rc" -eq 0 ] && [ "$scheduler_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$blackbox_overhead_rc" -eq 0 ] && [ "$blackbox_rc" -eq 0 ] && [ "$slo_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
