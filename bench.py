@@ -886,6 +886,115 @@ def blackbox_overhead() -> int:
     return 0 if ok else 1
 
 
+def ledger_overhead() -> int:
+    """`bench.py --ledger-overhead`: convergence diagnostics + the
+    decision ledger are ON by default, so their cost is gated by
+    measurement, not assumption — same shape as --blackbox-overhead.
+
+    Runs the smoke workload with diagnostics compiled in AND one ledger
+    decision record written per run, vs both off (min-of-N walls), and
+    fails past 2% overhead; also pins that the diagnostics-on engine
+    produces BYTE-IDENTICAL placements to diagnostics-off (observation
+    must never perturb the search) and that the disabled path writes
+    ZERO ledger bytes."""
+    import dataclasses as _dc
+    import os as _os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+    from cruise_control_tpu.analyzer.ledger import (
+        DecisionLedger,
+        build_decision_record,
+    )
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+    state = random_cluster_fast(
+        RandomClusterSpec(
+            num_brokers=24, num_partitions=1500, num_racks=6, num_topics=12, skew=1.0
+        ),
+        seed=7,
+    )
+    base_cfg = OptimizerConfig(
+        num_candidates=512, leadership_candidates=128, swap_candidates=64,
+        steps_per_round=16, num_rounds=4, init_temperature_scale=0.0, seed=0,
+    )
+    reps = 7
+    walls: dict[str, float] = {}
+    placements: dict[str, object] = {}
+    ledger_dir = tempfile.mkdtemp(prefix="ledger-bench-")
+    records_written = 0
+    conv_rounds = None
+
+    def _dir_bytes() -> int:
+        return sum(
+            _os.path.getsize(_os.path.join(ledger_dir, f))
+            for f in _os.listdir(ledger_dir)
+        )
+
+    for mode in ("recorded", "disabled"):
+        cfg = _dc.replace(base_cfg, diagnostics=(mode == "recorded"))
+        led = (
+            DecisionLedger(_os.path.join(ledger_dir, "decision-ledger.jsonl"))
+            if mode == "recorded"
+            else None
+        )
+
+        def run_once(opt=GoalOptimizer(config=cfg), led=led):
+            result = opt.optimize(state)
+            if led is not None:
+                led.record_decision(
+                    build_decision_record(result, source="bench")
+                )
+            return result
+
+        result = run_once()  # warm: compile outside the measurement
+        placements[mode] = np.asarray(result.state_after.replica_broker)
+        if mode == "recorded":
+            timing = next(h for h in result.history if h.get("timing"))
+            conv_rounds = timing["convergence"]["rounds"]
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.monotonic()
+            run_once()
+            best = min(best, time.monotonic() - t0)
+        walls[mode] = best
+        if mode == "recorded":
+            records_written = led.records_written
+            bytes_after_recorded = _dir_bytes()
+            led.close()
+    overhead = walls["recorded"] / max(walls["disabled"], 1e-9) - 1.0
+    parity = bool((placements["recorded"] == placements["disabled"]).all())
+    # the disabled pin: the whole disabled run wrote ZERO ledger bytes
+    no_writes_when_disabled = _dir_bytes() == bytes_after_recorded
+    ok = (
+        walls["recorded"] <= walls["disabled"] * 1.02 + 0.002
+        and parity
+        and records_written > 0
+        and conv_rounds is not None
+        and conv_rounds >= 1
+        and no_writes_when_disabled
+    )
+    _emit(
+        metric="ledger_overhead_smoke",
+        value=round(walls["recorded"], 4),
+        unit="s",
+        vs_baseline=round(overhead, 4),
+        recorded_wall_s=round(walls["recorded"], 4),
+        disabled_wall_s=round(walls["disabled"], 4),
+        overhead_pct=round(overhead * 100, 2),
+        decisions_recorded=records_written,
+        convergence_rounds=conv_rounds,
+        diagnostics_parity=parity,
+        disabled_zero_bytes=no_writes_when_disabled,
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 def fleet_smoke() -> int:
     """`bench.py --fleet-smoke`: the fleet controller's economics gate.
 
@@ -1864,6 +1973,8 @@ def main():
         sys.exit(trace_overhead())
     if "--blackbox-overhead" in sys.argv:
         sys.exit(blackbox_overhead())
+    if "--ledger-overhead" in sys.argv:
+        sys.exit(ledger_overhead())
     if "--scenarios" in sys.argv:
         sys.exit(scenarios_bench("--smoke" in sys.argv))
     if "--churn" in sys.argv:

@@ -152,6 +152,17 @@ class OptimizerConfig:
     #: same arithmetic, and the prior's extra draws ride keys derived via
     #: fold_in that no other stream reads (pinned by tests).
     prior_enabled: bool = False
+    #: convergence diagnostics (config analyzer.diagnostics.enabled): the
+    #: fused program's per-round outputs additionally carry the full-chain
+    #: objective, the per-goal violation vector at each round boundary,
+    #: acceptance counts by move kind, and prior-draw usage — riding the
+    #: run's existing single host extraction, ZERO extra blocking syncs.
+    #: Trace-static: False keeps the traced program and its outputs
+    #: byte-identical to today's; True adds only read-only reductions
+    #: (no RNG keys are split, no placement arithmetic changes), so
+    #: placements are byte-identical to the off path — pinned by
+    #: tests/test_ledger.py across plain, segmented, and mesh runs.
+    diagnostics: bool = False
 
     def __post_init__(self):
         # round-count knobs validated in ONE place: both the in-graph
@@ -538,6 +549,15 @@ AOT_MIN_CANDIDATES = 1_024
 #: `Engine._fused_out_def` rebuilds the output treedef from WITHOUT
 #: tracing (the AOT-hit path must not pay the trace artifacts skip)
 FUSED_YS_KEYS = ("accepted", "ran", "stopped", "temperature", "cheap")
+
+#: the additional per-round keys the diagnostics-on fused program emits
+#: (OptimizerConfig.diagnostics): full-chain objective, per-goal violation
+#: vector [G], per-kind acceptance counts, and prior-draw usage — all
+#: read-only reductions riding the same single host extraction
+FUSED_DIAG_YS_KEYS = FUSED_YS_KEYS + (
+    "objective", "goal_viol", "acc_replica", "acc_swap", "acc_lead",
+    "prior_cands", "prior_acc",
+)
 
 #: budget of AUTHORITATIVE (full goal chain) early-stop checks per run when
 #: the cheap O(B) gate opens but delta-folded goals still have work — shared
@@ -971,15 +991,21 @@ class Engine:
         donate = tuple(range(n_sx, len(leaves_av)))
         return leaves_av, in_def, donate
 
+    def _ys_keys(self) -> tuple:
+        """Per-round ys keys of this engine's (non-verbose) fused program
+        — FUSED_YS_KEYS, plus the diagnostics keys when the config
+        compiles convergence diagnostics in."""
+        return FUSED_DIAG_YS_KEYS if self.config.diagnostics else FUSED_YS_KEYS
+
     def _fused_out_def(self, carry_av):
         """Output treedef of the (non-verbose) fused program — (carry,
         per-round ys dict) — constructed WITHOUT tracing: dict pytrees
-        flatten by sorted key, so the key set (FUSED_YS_KEYS, the same
-        constant `_fused_rounds_body` checks its ys against) pins the
+        flatten by sorted key, so the key set (`_ys_keys`, the same
+        constant set `_fused_rounds_body` checks its ys against) pins the
         structure.  tests/test_prewarm.py asserts this equals the traced
         structure, and the artifact fingerprint's source digest retires
         artifacts whenever this file changes."""
-        ys = {k: 0 for k in FUSED_YS_KEYS}
+        ys = {k: 0 for k in self._ys_keys()}
         return jax.tree.structure((carry_av, ys))
 
     def aot_worthwhile(self) -> bool:
@@ -1282,6 +1308,13 @@ class Engine:
         the violation max at the early-stop gate; tracing the full goal
         chain once instead of twice halves the chain's share of the
         warm-start trace bill."""
+        obj, viol = self._eval_vec_impl(sx, carry)
+        return obj, jnp.max(viol)
+
+    def _eval_vec_impl(self, sx: EngineStatics, carry: EngineCarry):
+        """(full objective, per-goal violation VECTOR f32[G]) from the
+        carry's incremental aggregates — the convergence-diagnostics
+        variant of _eval_impl (the ledger's per-round goal trajectory)."""
         from cruise_control_tpu.models.aggregates import BrokerAggregates
 
         agg = BrokerAggregates(
@@ -1297,7 +1330,7 @@ class Engine:
         obj, viol, _ = self.chain.evaluate(
             self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint
         )
-        return obj, jnp.max(viol)
+        return obj, viol
 
     def _plan_impl(self, sx: EngineStatics, carry: EngineCarry) -> SamplingPlan:
         """Importance-sampling + movement-pricing plan from current aggregates."""
@@ -1555,7 +1588,9 @@ class Engine:
             r = jnp.concatenate([r, r_imp])
         return r
 
-    def _sample_dests(self, sx, key: jax.Array, n: int, r: jax.Array) -> jax.Array:
+    def _sample_dests(
+        self, sx, key: jax.Array, n: int, r: jax.Array, *, with_mask: bool = False
+    ):
         """n destination POSITIONS (indices into dest_ids) for the replica
         moves whose sampled sources are `r`.
 
@@ -1568,9 +1603,15 @@ class Engine:
         extra draws ride a fold_in-derived key no other stream reads, so
         a cold prior (mix 0) reproduces the uniform stream bit-for-bit —
         the controller's parity guarantee (tests/test_controller.py).
+
+        `with_mask` (convergence diagnostics) additionally returns the
+        per-draw took-the-prior-branch mask — a pure read of the existing
+        mix draw, so the destination stream itself is untouched.
         """
         uni = _uniform_idx(key, (n,), sx.n_dest)
         if not self.config.prior_enabled:
+            if with_mask:
+                return uni, jnp.zeros((n,), bool)
             return uni
         k_m, k_p = jax.random.split(jax.random.fold_in(key, 1))
         t = sx.state.replica_topic[jnp.minimum(r, self.shape.R - 1)]
@@ -1580,7 +1621,10 @@ class Engine:
             jnp.sum(u[:, None] >= cdf, axis=-1).astype(jnp.int32), sx.n_dest - 1
         )
         use = jax.random.uniform(k_m, (n,)) < sx.prior_mix
-        return jnp.where(use, p_idx, uni)
+        out = jnp.where(use, p_idx, uni)
+        if with_mask:
+            return out, use
+        return out
 
     def _slice_draws(self, slice_, *arrays):
         """Candidate-axis sharding (parallel/mesh.py): keep only one mesh
@@ -1616,8 +1660,16 @@ class Engine:
         K = self.K_r
         k1, k2 = jax.random.split(key)
         r = self._sample_sources(sx, k1, K, plan)
-        dst = sx.dest_ids[self._sample_dests(sx, k2, K, r)]
-        r, dst = self._slice_draws(slice_, r, dst)
+        if self.config.diagnostics:
+            # same draws, plus the took-the-prior-branch mask so per-round
+            # prior usage can be counted — placements untouched
+            pos, from_prior = self._sample_dests(sx, k2, K, r, with_mask=True)
+            dst = sx.dest_ids[pos]
+            r, dst, from_prior = self._slice_draws(slice_, r, dst, from_prior)
+        else:
+            dst = sx.dest_ids[self._sample_dests(sx, k2, K, r)]
+            r, dst = self._slice_draws(slice_, r, dst)
+            from_prior = None
         src = carry.replica_broker[r]
         part = st.replica_partition[r]
 
@@ -1719,6 +1771,8 @@ class Engine:
 
         payload = dict(r=r, dst=dst, d_dst=d_dst, load=load, is_lead=is_lead,
                        pot=pot, lbin=lbin, d_src=d_src)
+        if from_prior is not None:
+            payload["from_prior"] = from_prior
         return delta, feasible, src, dst, part, payload
 
     def _intra_disk_candidates(
@@ -1792,6 +1846,10 @@ class Engine:
                            is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0
                        ),
                        d_src=d_src)
+        if self.config.diagnostics:
+            # intra-broker candidates never draw destinations from the
+            # prior; the mask exists so the diagnostics bundle is uniform
+            payload["from_prior"] = jnp.zeros(r.shape, bool)
         return delta, feasible, b, b, part, payload
 
     def _swap_candidates(
@@ -2206,6 +2264,10 @@ class Engine:
         dr, fr, sr, tr, pr, payr = raw_r
         ds, fs, ss, ts, ps1, ps2, pays = raw_s
         dl, fl, sl, tl, pl, payl = raw_l
+        # diagnostics rider: the replica rows' took-the-prior-branch mask
+        # (never part of the apply payload — swaps/leads are not prior-drawn)
+        payr = dict(payr)
+        from_prior = payr.pop("from_prior", None)
 
         delta = jnp.concatenate([dr, ds, dl])
         feas = jnp.concatenate([fr, fs, fl])
@@ -2239,10 +2301,13 @@ class Engine:
             d_f=carry.replica_disk[jnp.minimum(payl["rf"], R1)],
             d_t=carry.replica_disk[jnp.minimum(payl["rt"], R1)],
         )
-        return dict(
+        out = dict(
             delta=delta, feas=feas, src=src, dst=dst, part1=part1, part2=part2,
             nr=dr.shape[0], ns=ds.shape[0], payr=payr_ext, payl=payl_ext,
         )
+        if from_prior is not None:
+            out["from_prior"] = from_prior
+        return out
 
     def _select(self, accept, delta, src, dst, part1, part2, num_parts=None):
         """Conflict resolution: unique ranks; a candidate survives iff it is
@@ -2300,6 +2365,20 @@ class Engine:
             improving=(feas & (delta < 0)).sum(),
             delta=jnp.where(survive, delta, 0.0).sum(),
         )
+        if self.config.diagnostics:
+            # per-kind acceptance + prior-draw usage: read-only reductions
+            # of the already-computed survival masks (the ledger's
+            # per-round acceptance-by-kind trajectory)
+            fp = prop.get("from_prior")
+            if fp is None:
+                fp = jnp.zeros((nr,), bool)
+            stats.update(
+                acc_replica=sv_r.sum(),
+                acc_swap=sv_s.sum(),
+                acc_lead=sv_l.sum(),
+                prior_cands=fp.sum(),
+                prior_acc=(sv_r & fp).sum(),
+            )
         return carry, stats
 
     def _apply(
@@ -2578,16 +2657,27 @@ class Engine:
             t0 * cfg.temperature_decay ** rnd.astype(jnp.float32),
         ).astype(jnp.float32)
 
+        diag = self.config.diagnostics
+        stat_keys = (
+            ("accepted", "acc_replica", "acc_swap", "acc_lead",
+             "prior_cands", "prior_acc")
+            if diag
+            else ("accepted",)
+        )
+
         def do_round(carry, plan):
             temps = jnp.full((cfg.steps_per_round,), t_r, jnp.float32)
             carry, stats = self._scan_impl(sx, carry, temps, plan)
             carry, plan, cheap = self._round_prep_impl(sx, carry)
-            return carry, plan, cheap, stats["accepted"].sum()
+            return carry, plan, cheap, {k: stats[k].sum() for k in stat_keys}
 
         carry, plan, cheap_prev, acc = jax.lax.cond(
             run,
             do_round,
-            lambda c, p: (c, p, jnp.float32(jnp.inf), jnp.int32(0)),
+            lambda c, p: (
+                c, p, jnp.float32(jnp.inf),
+                {k: jnp.int32(0) for k in stat_keys},
+            ),
             carry,
             plan,
         )
@@ -2595,14 +2685,35 @@ class Engine:
         # flags early_stop on the round whose post-refresh state
         # satisfied the full chain, never on an extra-round exit
         ys = dict(
-            accepted=acc, ran=run, stopped=main_stop, temperature=t_r,
-            cheap=cheap_prev,
+            accepted=acc["accepted"], ran=run, stopped=main_stop,
+            temperature=t_r, cheap=cheap_prev,
         )
-        assert set(ys) == set(FUSED_YS_KEYS), (
-            "fused ys keys drifted from FUSED_YS_KEYS — update both, "
-            "or AOT artifacts unflatten the wrong structure"
+        if diag:
+            # round-boundary goal quality: the full-chain objective + the
+            # per-goal violation vector of the post-round carry, masked to
+            # NaN on not-ran rounds.  A read of the carry only — the scan
+            # state and every RNG stream are untouched, so placements stay
+            # byte-identical to the diagnostics-off program.
+            n_goals = len(self.chain.goals)
+            obj_d, viol_d = jax.lax.cond(
+                run,
+                lambda: self._eval_vec_impl(sx, carry),
+                lambda: (
+                    jnp.float32(jnp.nan),
+                    jnp.full((n_goals,), jnp.nan, jnp.float32),
+                ),
+            )
+            ys.update(
+                objective=obj_d, goal_viol=viol_d,
+                acc_replica=acc["acc_replica"], acc_swap=acc["acc_swap"],
+                acc_lead=acc["acc_lead"], prior_cands=acc["prior_cands"],
+                prior_acc=acc["prior_acc"],
+            )
+        assert set(ys) == set(self._ys_keys()), (
+            "fused ys keys drifted from FUSED_YS_KEYS/FUSED_DIAG_YS_KEYS — "
+            "update both, or AOT artifacts unflatten the wrong structure"
         )
-        if verbose:
+        if verbose and "objective" not in ys:
             ys["objective"] = jax.lax.cond(
                 run,
                 lambda: self._eval_impl(sx, carry)[0],
@@ -2739,15 +2850,19 @@ class Engine:
             if seg_ctx.checkpoint is not None:
                 seg_ctx.checkpoint()
         ys = {
-            k: np.concatenate([p[k] for p in ys_parts]) for k in FUSED_YS_KEYS
+            k: np.concatenate([p[k] for p in ys_parts]) for k in self._ys_keys()
         }
         history = self._fused_history(ys, verbose=False)
-        history.append(dict(
+        timing = dict(
             timing=True, fused=True, segmented=True,
             segments=len(ys_parts), blocking_syncs=len(ys_parts),
             device_s=round(device_s, 6),
             host_dispatch_s=round(time.monotonic() - t_start - device_s, 6),
-        ))
+        )
+        conv = self._convergence_summary(ys)
+        if conv is not None:
+            timing["convergence"] = conv
+        history.append(timing)
         return self.carry_to_state(carry), history
 
     # ------------------------------------------------------------------
@@ -2802,7 +2917,11 @@ class Engine:
         """Per-round history records from the fused program's fetched ys
         — one builder for the whole-anneal and segmented runners, so the
         two report identically (a segmented run may have fetched fewer
-        trailing not-ran rows; those contribute no records anyway)."""
+        trailing not-ran rows; those contribute no records anyway).
+        With convergence diagnostics compiled in, each record additionally
+        carries the round-boundary objective, the per-goal violation
+        vector, acceptance counts by move kind, and prior-draw usage."""
+        diag = self.config.diagnostics
         history: list[dict] = []
         for r in range(len(ys["ran"])):
             if ys["stopped"][r] and history:
@@ -2816,10 +2935,58 @@ class Engine:
             )
             if r >= self.config.num_rounds:
                 rec["extra"] = True
-            if verbose:
+            if diag:
+                rec["objective"] = float(ys["objective"][r])
+                rec["goal_violations"] = [
+                    round(float(v), 8) for v in np.asarray(ys["goal_viol"][r])
+                ]
+                rec["accepted_by_kind"] = {
+                    "replica": int(ys["acc_replica"][r]),
+                    "swap": int(ys["acc_swap"][r]),
+                    "leadership": int(ys["acc_lead"][r]),
+                }
+                rec["prior"] = {
+                    "candidates": int(ys["prior_cands"][r]),
+                    "accepted": int(ys["prior_acc"][r]),
+                }
+            elif verbose:
                 rec["objective"] = float(ys["objective"][r])
             history.append(rec)
         return history
+
+    def _convergence_summary(self, ys) -> dict | None:
+        """Compact convergence summary from one run's fetched per-round
+        ys (None unless diagnostics are compiled in) — attached to the
+        run's timing record, threaded into the analyzer.optimize span and
+        the decision ledger (analyzer/ledger.py)."""
+        if not self.config.diagnostics:
+            return None
+        ran = np.asarray(ys["ran"]).astype(bool)
+        obj = np.asarray(ys["objective"])
+        viol = np.asarray(ys["goal_viol"])
+        last = int(np.nonzero(ran)[0][-1]) if ran.any() else None
+        return dict(
+            rounds=int(ran.sum()),
+            early_stop=bool(np.asarray(ys["stopped"]).any()),
+            objective_trajectory=[round(float(x), 8) for x in obj[ran]],
+            temperatures=[float(x) for x in np.asarray(ys["temperature"])[ran]],
+            accepted=[int(x) for x in np.asarray(ys["accepted"])[ran]],
+            accepted_by_kind=dict(
+                replica=int(np.asarray(ys["acc_replica"])[ran].sum()),
+                swap=int(np.asarray(ys["acc_swap"])[ran].sum()),
+                leadership=int(np.asarray(ys["acc_lead"])[ran].sum()),
+            ),
+            prior=dict(
+                candidates=int(np.asarray(ys["prior_cands"])[ran].sum()),
+                accepted=int(np.asarray(ys["prior_acc"])[ran].sum()),
+            ),
+            goal_names=self.chain.names(),
+            final_goal_violations=(
+                [round(float(v), 8) for v in viol[last]]
+                if last is not None
+                else []
+            ),
+        )
 
     def _run_fused(self, *, verbose: bool = False, initial_placement=None):
         sx = self.statics
@@ -2853,17 +3020,24 @@ class Engine:
         t_sync = time.monotonic()
 
         history = self._fused_history(ys, verbose=verbose)
-        history.append(dict(
+        timing = dict(
             timing=True, fused=True, blocking_syncs=1,
             host_dispatch_s=round(t_disp - t_start, 6),
             device_s=round(t_sync - t_disp, 6),
-        ))
+        )
+        conv = self._convergence_summary(ys)
+        if conv is not None:
+            timing["convergence"] = conv
+        history.append(timing)
         return self.carry_to_state(carry), history
 
     def _run_legacy(self, *, verbose: bool = False, initial_placement=None):
         """Legacy Python round loop: one scan dispatch + one blocking sync
         per round.  Kept behind `fused_rounds=False` for parity testing and
-        per-round host-side debugging."""
+        per-round host-side debugging.  Convergence diagnostics are a
+        fused-path feature (they ride the fused program's per-round ys);
+        the legacy loop ignores `OptimizerConfig.diagnostics` — per-round
+        inspection here is what `verbose=True` is for."""
         cfg = self.config
         sx = self.statics
         t_start = time.monotonic()

@@ -648,6 +648,17 @@ class GoalOptimizer:
                 degraded=result.degraded,
                 wall_s=round(result.wall_seconds, 6),
                 num_proposals=len(result.proposals),
+                # final per-goal violations ON the span: a /trace replay
+                # shows the run's goal quality even with the decision
+                # ledger disabled (objective/balancedness beside them)
+                objective_after=round(result.objective_after, 6),
+                balancedness_after=round(result.balancedness_after, 3),
+                goal_violations_after={
+                    n: round(float(v), 6)
+                    for n, v in zip(
+                        result.goal_names, np.asarray(result.violations_after)
+                    )
+                },
                 **{
                     k: timing.get(k)
                     for k in (
@@ -658,6 +669,10 @@ class GoalOptimizer:
                         # device scheduler: how many wall-bounded slices
                         # this anneal dispatched as
                         "segmented", "segments",
+                        # convergence diagnostics summary (trajectory,
+                        # acceptance by kind, prior usage, final per-goal
+                        # violations) when OptimizerConfig.diagnostics
+                        "convergence",
                     )
                     if timing.get(k) is not None
                 },

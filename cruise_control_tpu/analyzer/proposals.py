@@ -179,6 +179,21 @@ class ProposalSet:
             append(o)
         return out
 
+    def rows_at(self, indices) -> list[ExecutionProposal]:
+        """Materialize ONLY the given rows (decision-ledger top-moves
+        featurization: the top-N-by-data rows of a 100k-move plan must
+        not force the whole set into Python objects)."""
+        if self._all is not None:
+            return [self._all[int(i)] for i in indices]
+        return self._rows(np.asarray(indices, np.int64))
+
+    def top_by_data(self, n: int) -> list[ExecutionProposal]:
+        """The `n` proposals moving the most inter-broker data, selected
+        on the columns (no materialization beyond the returned rows) —
+        the decision ledger's top-moves accessor."""
+        data = np.asarray(self._c["data"])
+        return self.rows_at(np.argsort(-data)[: max(0, n)])
+
     def _materialize(self) -> list[ExecutionProposal]:
         if self._all is None:
             self._all = self._rows(np.arange(len(self)))

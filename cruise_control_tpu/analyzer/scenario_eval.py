@@ -224,6 +224,62 @@ class ScenarioEvaluator:
             self._single_fn = jax.jit(one)
         return jax.device_get(self._single_fn(state))
 
+    # ------------------------------------------------------------------
+    # calibration scoring (decision ledger, analyzer/ledger.py)
+    # ------------------------------------------------------------------
+
+    @device_op("scenario.score-state")
+    def _score_state_on_device(self, state):
+        import jax
+
+        from cruise_control_tpu.models.stats import compute_stats
+
+        if getattr(self, "_score_fn", None) is None:
+
+            def one(s):
+                obj, viol, _ = self.chain.evaluate(s, constraint=self.constraint)
+                return obj, viol, compute_stats(s)
+
+            self._score_fn = jax.jit(one)
+        return jax.device_get(self._score_fn(state))
+
+    def score_state(self, state: ClusterState):
+        """(objective, per-goal violations f64[G], ClusterStats, degraded)
+        of ONE measured cluster state — the calibration loop's scorer:
+        the SAME goal chain + constraint the decision's prediction rode,
+        evaluated in one batched dispatch (goal chain + cluster stats as
+        one program), supervised like every other evaluator dispatch with
+        a sequential-CPU degraded fallback."""
+        import jax
+
+        sup = self.supervisor
+        if sup is None:
+            obj, viol, stats = self._score_state_on_device(state)
+            return float(obj), np.asarray(viol, np.float64), stats, False
+        from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
+
+        if sup.available():
+            try:
+                obj, viol, stats = sup.call(
+                    lambda: self._score_state_on_device(state),
+                    op="calibration-score",
+                )
+                return float(obj), np.asarray(viol, np.float64), stats, False
+            except DeviceDegradedError:
+                pass
+        from cruise_control_tpu.models.stats import compute_stats
+
+        # degraded twin: objective/violations via the sequential-CPU
+        # evaluator path, cluster stats computed on the CPU backend
+        cpu = jax.local_devices(backend="cpu")[0]
+        host = jax.tree.map(np.asarray, state)
+        objs, viols = self._evaluate_cpu([host])
+        with jax.default_device(cpu):
+            stats = jax.tree.map(np.asarray, compute_stats(host))
+        if self.sensors is not None:
+            self.sensors.counter("planner.degraded-evaluations").inc()
+        return float(objs[0]), np.asarray(viols[0], np.float64), stats, True
+
     def _evaluate_cpu(self, states):
         """Degraded path: sequential single-state evaluation pinned to the
         host CPU backend — same numbers, no batching, no accelerator."""

@@ -171,6 +171,18 @@ ENDPOINTS: dict[str, dict] = {
     # cluster (`cccli slo`; pair with the global --cluster flag to
     # filter one cluster of a fleet)
     "slo": {"method": "GET", "endpoint": "slo", "params": {}},
+    # decision ledger (analyzer/ledger.py).  `cccli explain --trace-id
+    # <id>` (the _traceId of any async response) or `--proposal <id>`
+    # replays one decision→outcome→calibration episode as a structured
+    # explanation; `cccli ledger` prints the raw joined episode stream
+    # newest-first.  Both are raw-JSON passthrough and route to one
+    # cluster of a fleet with the global -c/--cluster flag, exactly like
+    # `trace`/`slo`.
+    "explain": {"method": "GET", "endpoint": "explain",
+                "params": {"--trace-id": ("trace_id", str),
+                           "--proposal": ("proposal", str)}},
+    "ledger": {"method": "GET", "endpoint": "ledger",
+               "params": {"--limit": ("limit", positive_int_param)}},
     # fleet controller: whole-instance rollup (`cccli fleet`); pair the
     # other subcommands with the global --cluster flag to target one
     # cluster of a fleet (e.g. `cccli --cluster east rebalance`)

@@ -48,6 +48,14 @@ _CURRENT: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 )
 
 
+def current_trace_id() -> str:
+    """Trace id of the ambient span ("" outside any span) — lets
+    non-span producers (the decision ledger's records) stamp the trace
+    they ran under without threading ids through every call."""
+    sp = _CURRENT.get()
+    return sp.trace_id if sp is not None else ""
+
+
 class Span:
     """One timed stage of a trace.  Mutable until `finish()`; thread-safe
     enough for its uses (attributes/events are appended under the owning

@@ -230,6 +230,69 @@ def _analyzer_defs() -> ConfigDef:
              "manifest entries kept (most-recently-used buckets win) — "
              "bounds how many engines a boot prewarm compiles",
              in_range(lo=1), group=g)
+    # --- convergence diagnostics + decision ledger + calibration ---
+    g = "analyzer.diagnostics"
+    d.define("analyzer.diagnostics.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "compile convergence diagnostics into the fused anneal: "
+             "per-round objective trajectory, per-goal violation vector "
+             "at round boundaries, acceptance counts by move kind and "
+             "prior-draw usage ride the run's existing single host "
+             "extraction (zero extra blocking syncs) into "
+             "OptimizerResult.history, the analyzer.optimize span, and "
+             "the decision ledger.  Placements are byte-identical either "
+             "way (pinned); false restores today's outputs bit-for-bit",
+             group=g)
+    g = "analyzer.ledger"
+    d.define("analyzer.ledger.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "durably record one `decision` record per published "
+             "proposal (trace id, generation, bucket + config "
+             "fingerprint, per-goal pre/post scores, predicted load, "
+             "per-move features, convergence summary) into an "
+             "append-only crash-tolerant JSONL ledger, joined by an "
+             "`outcome` record at execution completion and a "
+             "`calibration` record once the next complete metric window "
+             "measures what the moves actually did — the training "
+             "corpus for learned optimization and the GET /explain "
+             "surface.  Needs a durable directory (analyzer.ledger.dir, "
+             "or derived from executor.journal.dir); without one the "
+             "ledger stays off and writes zero bytes", group=g)
+    d.define("analyzer.ledger.dir", T.STRING, None, I.LOW,
+             "directory of the decision ledger (decision-ledger.jsonl; "
+             "fleet deployments namespace one subdirectory per "
+             "cluster).  Unset derives '_ledger' inside "
+             "executor.journal.dir — decisions must survive exactly the "
+             "crashes the journal survives; explicitly empty disables",
+             group=g)
+    d.define("analyzer.ledger.retention.count", T.INT, 32, I.LOW,
+             "rotated ledger archives kept (newest first); archives "
+             "holding a decision whose outcome is still pending are "
+             "never pruned", in_range(lo=1), group=g)
+    d.define("analyzer.ledger.retention.hours", T.DOUBLE, 336.0, I.LOW,
+             "age bound on rotated ledger archives (hours); the live "
+             "file and pending-outcome episodes are never pruned",
+             in_range(lo=0.1), group=g)
+    g = "analyzer.calibration"
+    d.define("analyzer.calibration.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "after an executed proposal's moves land and the next "
+             "complete metric window rolls, score the MEASURED cluster "
+             "state through the same goal chain (one batched "
+             "ScenarioEvaluator dispatch) and append a calibration "
+             "record — predicted vs realized per-goal scores and "
+             "per-broker load prediction error — to the decision "
+             "ledger, the analyzer.calibration.* sensors and the /fleet "
+             "per-cluster rollup.  No-op while the ledger is off",
+             group=g)
+    d.define("analyzer.calibration.drift.threshold", T.DOUBLE, 0.05, I.MEDIUM,
+             "mean absolute per-goal prediction error (worst goal, over "
+             "the last drift.min.samples calibrated executions) past "
+             "which one alert-only MODEL_DRIFT anomaly fires per "
+             "episode through the detector/notifier; the episode "
+             "re-arms when the mean falls back under the threshold",
+             in_range(lo=0.0), group=g)
+    d.define("analyzer.calibration.drift.min.samples", T.INT, 3, I.LOW,
+             "calibrated executions required before MODEL_DRIFT may "
+             "fire (one bad sample is noise, not drift)",
+             in_range(lo=1), group=g)
     return d
 
 
@@ -1221,6 +1284,7 @@ class CruiseControlConfig(AbstractConfig):
             replica_move_cost=g("tpu.replica.move.cost"),
             leadership_move_cost=g("tpu.leadership.move.cost"),
             importance_fraction=g("tpu.importance.fraction"),
+            diagnostics=g("analyzer.diagnostics.enabled"),
         )
 
     def compile_cache_dir(self) -> str | None:
@@ -1278,6 +1342,25 @@ class CruiseControlConfig(AbstractConfig):
         if not cache:
             return None
         return os.path.join(os.path.expanduser(cache), "blackbox")
+
+    def ledger_dir(self) -> str | None:
+        """Directory of the decision ledger (analyzer/ledger.py), or None
+        when disabled / no durable directory exists.  Unset derives
+        '_ledger' inside executor.journal.dir — decision records must
+        survive exactly the crashes the execution journal survives, so
+        they share one mount.  An explicitly empty value disables, like
+        blackbox_dir."""
+        import os
+
+        if not self.get("analyzer.ledger.enabled"):
+            return None
+        v = self.get("analyzer.ledger.dir")
+        if v is not None:
+            return v or None
+        journal = self.get("executor.journal.dir")
+        if journal:
+            return os.path.join(os.path.expanduser(journal), "_ledger")
+        return None
 
     def parallel_mode(self) -> str:
         return self.get("tpu.parallel.mode")

@@ -11,7 +11,7 @@ through package __init__s).
 GET_ENDPOINTS = (
     "bootstrap", "train", "load", "partition_load", "proposals", "state",
     "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
-    "trace", "metrics", "fleet", "slo",
+    "trace", "metrics", "fleet", "slo", "explain", "ledger",
 )
 
 #: endpoints that are fleet-GLOBAL: in fleet mode they answer for the
@@ -62,6 +62,11 @@ ENDPOINT_TYPES = {
     "fleet": "CRUISE_CONTROL_MONITOR",
     # SLO registry: burn rates + episode state (read-only)
     "slo": "CRUISE_CONTROL_MONITOR",
+    # decision ledger: structured explanation of one published/executed
+    # proposal, and the raw joined episode stream (both read-only;
+    # cluster-scoped — each cluster owns its own ledger)
+    "explain": "CRUISE_CONTROL_MONITOR",
+    "ledger": "CRUISE_CONTROL_MONITOR",
 }
 assert set(ENDPOINT_TYPES) == set(ALL_ENDPOINTS)
 

@@ -538,7 +538,13 @@ class StreamingController:
         observed = self.prior.observe_proposals(result.proposals, catalog)
         t_pub = time.monotonic()
         published = self.cc.publish_proposal(
-            result, generation=self._index.model_generation()
+            result, generation=self._index.model_generation(),
+            prior_table=prior_table,
+            # the FIRST publish (cold-compile cycle) is excluded from
+            # calibration sampling — the same exclusion the streaming-
+            # publish SLO applies — so restarts can't fire a spurious
+            # MODEL_DRIFT off a cold, possibly-degraded first anneal
+            calibration_eligible=self._stats["incrementalAnneals"] > 0,
         )
         self._stage_observe(
             "controller.publish-seconds", time.monotonic() - t_pub, sp

@@ -43,6 +43,11 @@ class AnomalyType(enum.Enum):
     #: (common/slo.py): proposal freshness, streaming publish latency,
     #: cold-start or urgent queue-wait is sustainedly out of objective
     SLO_BURN = 9
+    #: the decision ledger's calibration loop (analyzer/ledger.py +
+    #: service/facade.py) measured SUSTAINED prediction error: the goal
+    #: scores/broker loads the engine predicted for executed proposals
+    #: keep diverging from what the cluster actually measured afterwards
+    MODEL_DRIFT = 10
 
     @property
     def priority(self) -> int:
@@ -265,6 +270,39 @@ class SloBurn(Anomaly):
             f"SloBurn(slo={self.slo}, cluster={self.cluster_id or '-'}, "
             f"objective={self.objective}, burn={self.fast_burn_rate}x fast / "
             f"{self.slow_burn_rate}x slow, episode={self.episode})"
+        )
+
+
+@dataclasses.dataclass
+class ModelDrift(Anomaly):
+    """The calibration loop observed SUSTAINED prediction error: across
+    the last `samples` calibrated executions, the mean absolute error
+    between the goal scores the engine PREDICTED (decision records,
+    analyzer/ledger.py) and the scores MEASURED after the moves landed
+    crossed `analyzer.calibration.drift.threshold`.  Fired EXACTLY once
+    per drift episode by the facade's calibration detector; the episode
+    re-arms once the mean error falls back under the threshold.
+
+    Not self-healable: a drifting model means the capacity model / goal
+    chain inputs (broker capacities, CPU model, sample quality) need a
+    human look — alert-only, like OPTIMIZER_DEGRADED."""
+
+    anomaly_type: AnomalyType = AnomalyType.MODEL_DRIFT
+    cluster_id: str = ""
+    samples: int = 0
+    mean_goal_error: float = 0.0
+    mean_load_error: float = 0.0
+    threshold: float = 0.0
+    episode: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"ModelDrift(cluster={self.cluster_id or '-'}, "
+            f"goalErr={self.mean_goal_error:.4g}, "
+            f"loadErr={self.mean_load_error:.4g} over {self.samples} "
+            f"calibrations, threshold={self.threshold:.4g}, "
+            f"episode={self.episode})"
         )
 
 

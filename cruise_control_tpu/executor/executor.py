@@ -398,6 +398,17 @@ class Executor:
         #: True after a FencedError aborted an execution (lease lost
         #: mid-batch); cleared when a new execution starts
         self._fenced_abort = False
+        #: stuck-move reaper actions within the CURRENT execution (the
+        #: decision ledger's outcome record wants the per-execution count,
+        #: not the process-lifetime counter)
+        self._exec_reaped = 0
+        self._exec_started_ms: int | None = None
+        #: callable(info: dict) fired when an execution finishes — success,
+        #: stop, or fenced abort — riding the same finish path the PR-4
+        #: notifier hook does.  The facade wires the decision ledger's
+        #: outcome join here (analyzer/ledger.py); best-effort like the
+        #: notifier: a broken observer must never fail the execution.
+        self.execution_observer = None
         if journal is not None and not defer_recovery:
             self.reconcile_journal()
 
@@ -863,6 +874,8 @@ class Executor:
                 self._requested = {}  # overrides die with the previous one
                 self._recovery = None
                 self._fenced_abort = False
+                self._exec_reaped = 0
+                self._exec_started_ms = now
                 self._planner = ExecutionTaskPlanner(strategy or self.strategy)
                 tasks = self._planner.add_execution_proposals(
                     proposals, strategy_context
@@ -964,8 +977,40 @@ class Executor:
             with self._lock:
                 self._fenced_abort = True
             self.sensors.counter("executor.fenced-aborts").inc()
+            # the observer still hears about the episode's end: a fenced
+            # abort IS this execution's outcome (the new holder resumes
+            # under its own decision)
+            self._notify_execution_observer(
+                result=None, uuid=uuid, fenced=True
+            )
             raise
         return result
+
+    def _notify_execution_observer(self, *, result, uuid, fenced: bool):
+        obs = self.execution_observer
+        if obs is None:
+            return
+        now = self._clock()
+        started = self._exec_started_ms
+        info = {
+            "uuid": uuid,
+            "startedMs": started,
+            "finishedMs": now,
+            "durationS": (
+                round((now - started) / 1000.0, 3) if started is not None else None
+            ),
+            "completed": result.completed if result is not None else 0,
+            "aborted": result.aborted if result is not None else 0,
+            "dead": result.dead if result is not None else 0,
+            "stopped": bool(result.stopped) if result is not None else False,
+            "fencedAbort": bool(fenced),
+            "reaped": self._exec_reaped,
+        }
+        try:
+            obs(info)
+        except Exception:  # noqa: BLE001 — observers must not fail the
+            # execution (same contract as the notifier hook above)
+            pass
 
     def _result(self, *, ticks: int) -> ExecutionResult:
         return ExecutionResult(
@@ -996,6 +1041,7 @@ class Executor:
                 self.notifier.on_execution_finished(result, uuid)
             except Exception:  # noqa: BLE001 — a broken notifier must not fail the execution
                 pass
+        self._notify_execution_observer(result=result, uuid=uuid, fenced=False)
 
     # ------------------------------------------------------------------
 
@@ -1042,6 +1088,7 @@ class Executor:
             task.kill(now)
         del in_flight[key]
         watermark.pop(key, None)
+        self._exec_reaped += 1
         self.sensors.counter("executor.reaper.stuck-task").inc()
         sp = self._exec_span
         if sp is not None:

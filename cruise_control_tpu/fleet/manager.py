@@ -117,6 +117,11 @@ class ClusterContext:
         if cc.slo_registry is not None:
             # burn-rate summary per SLO (full detail on GET /slo)
             out["slo"] = cc.slo_registry.summary_json()
+        if cc.ledger is not None:
+            # decision ledger + predicted-vs-measured calibration
+            # (analyzer/ledger.py; raw episodes on GET /ledger)
+            out["ledger"] = cc.ledger.state_json()
+            out["calibration"] = cc.calibration_state()
         recovery = cc.executor.recovery_info()
         if recovery is not None:
             out["recovered"] = True

@@ -501,13 +501,27 @@ class MeshEngine:
         win_carry = jax.tree.map(lambda x: x[winner], carry)
         state = self.final_state(win_carry)
         history = self._history(ys, winner, cfg, verbose)
-        history.append(dict(
+        timing = dict(
             timing=True, fused=True, blocking_syncs=1,
             host_dispatch_s=round(t_disp - t_start, 6),
             device_s=round(t_sync - t_disp, 6),
             mesh_shape=[self.n_restarts, self.n],
             collective_bytes=self.collective_bytes_per_round,
-        ))
+        )
+        if cfg.diagnostics:
+            # convergence summary with the SAME aggregation as the
+            # per-round history records above: COUNT fields sum over all
+            # chains (accepted == sum(kinds) holds, and the summary can be
+            # cross-checked against the round records), while STATE
+            # metrics (objective trajectory, final per-goal violations,
+            # ran/early-stop) are the winner chain's — the trajectory the
+            # served placement actually followed
+            win_ys = {k: np.asarray(v)[winner] for k, v in ys.items()}
+            for k in ("accepted", "acc_replica", "acc_swap", "acc_lead",
+                      "prior_cands", "prior_acc"):
+                win_ys[k] = np.asarray(ys[k]).sum(axis=0)
+            timing["convergence"] = self.engine._convergence_summary(win_ys)
+        history.append(timing)
         self.last_info = dict(
             objectives=objs, winner=winner,
             n_chains=self.n_restarts, n_shards=self.n,
@@ -535,7 +549,29 @@ class MeshEngine:
             )
             if r >= cfg.num_rounds:
                 rec["extra"] = True
-            if verbose:
+            if cfg.diagnostics:
+                # engine._fused_history record shape, one schema for
+                # downstream consumers.  COUNTS (accepted_by_kind, prior)
+                # sum over chains exactly like the pre-existing `accepted`
+                # field, so accepted == sum(accepted_by_kind) holds on a
+                # multi-chain mesh too; STATE metrics (objective, per-goal
+                # violations) are the winner chain's — they describe the
+                # placement actually served, and are not additive
+                rec["objective"] = float(np.asarray(ys["objective"])[winner, r])
+                rec["goal_violations"] = [
+                    round(float(v), 8)
+                    for v in np.asarray(ys["goal_viol"])[winner, r]
+                ]
+                rec["accepted_by_kind"] = {
+                    "replica": int(np.asarray(ys["acc_replica"])[:, r].sum()),
+                    "swap": int(np.asarray(ys["acc_swap"])[:, r].sum()),
+                    "leadership": int(np.asarray(ys["acc_lead"])[:, r].sum()),
+                }
+                rec["prior"] = {
+                    "candidates": int(np.asarray(ys["prior_cands"])[:, r].sum()),
+                    "accepted": int(np.asarray(ys["prior_acc"])[:, r].sum()),
+                }
+            elif verbose:
                 rec["objective"] = float(np.asarray(ys["objective"])[winner, r])
             history.append(rec)
         return history

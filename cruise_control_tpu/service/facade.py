@@ -433,6 +433,64 @@ class CruiseControl:
         # queue every detector feeds, so the notifier (Slack included)
         # alerts on wedged moves like any other anomaly
         self.executor.anomaly_sink = self.anomaly_detector.add_anomaly
+        #: decision ledger (analyzer/ledger.py, config analyzer.ledger.*):
+        #: one durable `decision` record per published proposal, joined by
+        #: an `outcome` record when its execution finishes (the executor's
+        #: finish hook below) and a `calibration` record once the next
+        #: complete metric window measures what the moves actually did —
+        #: ROADMAP item 3's training corpus and the GET /explain surface.
+        #: Fleet deployments namespace one ledger per cluster, exactly
+        #: like the execution journal.
+        self.ledger = None
+        ledger_dir = config.ledger_dir()
+        if ledger_dir:
+            import os
+
+            from cruise_control_tpu.analyzer.ledger import DecisionLedger
+
+            if cluster_id:
+                ledger_dir = os.path.join(ledger_dir, cluster_id)
+            self.ledger = DecisionLedger(
+                os.path.join(ledger_dir, "decision-ledger.jsonl"),
+                retention_count=config.get("analyzer.ledger.retention.count"),
+                retention_hours=config.get("analyzer.ledger.retention.hours"),
+                sensors=self.sensors,
+            )
+        #: in-memory predictions of recent decisions (decision id ->
+        #: predicted goal/load scores) awaiting their calibration join;
+        #: bounded — a decision that never executes ages out
+        from collections import OrderedDict, deque
+
+        self._predictions: OrderedDict = OrderedDict()
+        self._predictions_cap = 64
+        self._ledger_lock = threading.Lock()
+        #: decision id whose execution is currently in flight (the
+        #: executor serializes executions, so one slot suffices)
+        self._executing_decision: str | None = None
+        #: calibrations awaiting the next complete metric window
+        self._pending_calibrations: list[dict] = []
+        #: recent calibration errors driving the MODEL_DRIFT episode —
+        #: sized to hold at least drift.min.samples, or a large
+        #: min-samples setting could silently never fire
+        self._calibration_errors: deque = deque(
+            maxlen=max(
+                16, config.get("analyzer.calibration.drift.min.samples")
+            )
+        )
+        self._drift_active = False
+        self._drift_episodes = 0
+        self._calibration_samples = 0
+        self._last_calibration: dict | None = None
+        self.executor.execution_observer = self._on_execution_finished
+        if self.ledger is not None:
+            self.sensors.gauge(
+                "analyzer.calibration.pending",
+                lambda: float(len(self._pending_calibrations)),
+            )
+            self.sensors.gauge(
+                "analyzer.calibration.drift-active",
+                lambda: 1.0 if self._drift_active else 0.0,
+            )
         if core.scheduler is not None and core.scheduler.anomaly_sink is None:
             # FLEET_OVERLOAD is an INSTANCE-level episode: the first
             # facade built over the core claims the sink, so the anomaly
@@ -725,6 +783,10 @@ class CruiseControl:
         reg(slow_detect, interval_s=_interval("metric.anomaly.detection.interval.ms"))
         # supervisor breaker watch: every round (cheap property reads)
         reg(self._detect_optimizer_degraded)
+        # calibration loop + MODEL_DRIFT watch (decision ledger): cheap
+        # when nothing is due — the measured-state scoring dispatch runs
+        # only once an executed decision's next metric window completes
+        reg(self._detect_model_drift)
 
     # ------------------------------------------------------------------
     # lifecycle (reference startUp():162)
@@ -803,6 +865,8 @@ class CruiseControl:
             # the shared ticker stops itself once the last facade leaves
             self.core.slo_ticker.remove(self.slo_registry)
         self.anomaly_detector.shutdown()
+        if self.ledger is not None:
+            self.ledger.close()
 
     def _precompute_loop(self):
         """Reference GoalOptimizer.run precompute loop (GoalOptimizer.java:124-175).
@@ -1094,13 +1158,23 @@ class CruiseControl:
         )
         self._record_coldstart_once()
         if storable:
+            gen = self.monitor.model_generation()
             with self._cache_lock:
                 self._cache = _CachedResult(
                     result,
                     int(time.time() * 1000),
                     time.monotonic(),
-                    self.monitor.model_generation(),
+                    gen,
                 )
+            # a stored result IS a published proposal (it will serve
+            # /proposals until superseded): one ledger decision record
+            self._record_decision(
+                result, source="optimizer", generation=gen,
+                work_class=(
+                    work_class.name.lower() if work_class is not None
+                    else "interactive"
+                ),
+            )
         return result
 
     def publish_proposal(
@@ -1109,6 +1183,8 @@ class CruiseControl:
         *,
         source: str = "controller",
         generation=None,
+        prior_table=None,
+        calibration_eligible: bool = True,
     ) -> bool:
         """Publish a freshly computed result into the proposal cache —
         the streaming controller's output path.  `generation` is the
@@ -1120,7 +1196,14 @@ class CruiseControl:
         (False); same-or-newer SUPERSEDES the cached proposal — a fresher
         anneal of the same generation replaces it, so `/proposals` can
         never serve a staler result than `/state`'s ControllerState
-        reports."""
+        reports.
+
+        `prior_table` (controller publishes) rides into the decision
+        record's per-move prior-contribution features;
+        `calibration_eligible=False` excludes this decision from
+        calibration sampling — the controller's FIRST (cold-compile)
+        publish passes it, mirroring the streaming-publish SLO exclusion,
+        so a restart can never fire a spurious MODEL_DRIFT."""
         gen = generation if generation is not None else self.monitor.model_generation()
         new_key = (gen.metadata_generation, gen.load_generation)
         with self._cache_lock:
@@ -1142,6 +1225,10 @@ class CruiseControl:
         # report the persistent compile cache's hit/miss split here too
         self._log_compile_cache_report()
         self._record_coldstart_once()
+        self._record_decision(
+            result, source=source, generation=gen, work_class="background",
+            prior_table=prior_table, calibration_eligible=calibration_eligible,
+        )
         return True
 
     def _record_coldstart_once(self) -> None:
@@ -1156,6 +1243,339 @@ class CruiseControl:
         self.slo_registry.record(
             "cold-start", wall <= self.config.get("slo.coldstart.target.s")
         )
+
+    # ------------------------------------------------------------------
+    # decision ledger + calibration (analyzer/ledger.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _ledger_decision_id(result: OptimizerResult) -> str | None:
+        """The ledger decision id a result was recorded under (stamped
+        into its timing record), or None when it was never recorded."""
+        for h in result.history:
+            if h.get("timing"):
+                return h.get("ledger_decision_id")
+        return None
+
+    def _record_decision(
+        self,
+        result: OptimizerResult,
+        *,
+        source: str,
+        generation=None,
+        work_class: str = "",
+        prior_table=None,
+        calibration_eligible: bool = True,
+    ) -> str | None:
+        """Append one `decision` record for a published proposal; stamps
+        the ledger id into the result's timing record so a later
+        execution of the same result joins its outcome.  Best-effort:
+        ledger failures are counted, never surfaced to the caller."""
+        led = self.ledger
+        if led is None:
+            return None
+        try:
+            import hashlib
+
+            from cruise_control_tpu.analyzer.ledger import (
+                build_decision_record,
+                load_summary,
+            )
+            from cruise_control_tpu.common.trace import current_trace_id
+
+            rec = build_decision_record(
+                result,
+                source=source,
+                trace_id=current_trace_id(),
+                cluster_id=self.cluster_id or "",
+                generation=generation,
+                work_class=work_class,
+                config_fingerprint=hashlib.sha1(
+                    repr(self.optimizer.config).encode()
+                ).hexdigest()[:12],
+                prior_table=prior_table,
+                calibration_eligible=calibration_eligible,
+            )
+            did = led.record_decision(rec)
+            timing = next((h for h in result.history if h.get("timing")), None)
+            if timing is not None:
+                timing["ledger_decision_id"] = did
+            with self._ledger_lock:
+                self._predictions[did] = {
+                    "goal_names": list(result.goal_names),
+                    "violations": [
+                        float(v) for v in np.asarray(result.violations_after)
+                    ],
+                    "objective": float(result.objective_after),
+                    "balancedness": float(result.balancedness_after),
+                    "load": load_summary(result.stats_after),
+                    "eligible": bool(calibration_eligible),
+                }
+                while len(self._predictions) > self._predictions_cap:
+                    self._predictions.popitem(last=False)
+            return did
+        except Exception:  # noqa: BLE001 — the ledger must never fail serving
+            self.sensors.counter("analyzer.ledger.errors").inc()
+            log.warning("decision-ledger record failed", exc_info=True)
+            return None
+
+    def _on_execution_finished(self, info: dict) -> None:
+        """Executor finish hook (PR-4 observer path): join the executed
+        decision's `outcome` record and, when calibration applies, queue
+        the predicted-vs-measured check for the next complete window."""
+        with self._ledger_lock:
+            did = self._executing_decision
+            self._executing_decision = None
+        if did is None or self.ledger is None:
+            return
+        try:
+            self.ledger.record_outcome(did, dict(info))
+        except Exception:  # noqa: BLE001
+            self.sensors.counter("analyzer.ledger.errors").inc()
+            log.warning("decision-ledger outcome failed", exc_info=True)
+            return
+        pred = self._predictions.get(did)
+        if (
+            pred is None
+            or not pred.get("eligible", True)
+            or not self.config.get("analyzer.calibration.enabled")
+            or info.get("fencedAbort")
+            or not info.get("completed")
+        ):
+            return
+        try:
+            window = self.monitor.partition_aggregator.current_window_index
+        except Exception:  # noqa: BLE001 — no aggregator (bare harnesses)
+            window = None
+        with self._ledger_lock:
+            self._pending_calibrations.append({
+                "id": did,
+                "window": window,
+                "finished_ms": info.get("finishedMs"),
+            })
+
+    def _run_calibration_once(self) -> list[dict]:
+        """Score the MEASURED cluster state for every calibration whose
+        next complete metric window has rolled; append `calibration`
+        records and return them.  One batched ScenarioEvaluator dispatch
+        regardless of how many decisions are due (they all compare
+        against the same measured state)."""
+        if self.ledger is None or not self._pending_calibrations:
+            return []
+        try:
+            cur_w = self.monitor.partition_aggregator.current_window_index
+        except Exception:  # noqa: BLE001
+            return []
+        with self._ledger_lock:
+            due = [
+                e for e in self._pending_calibrations
+                if cur_w is not None
+                and (e["window"] is None or cur_w > e["window"])
+            ]
+        if not due:
+            return []
+        from cruise_control_tpu.analyzer.ledger import (
+            load_summary,
+            load_summary_error,
+        )
+        from cruise_control_tpu.analyzer.objective import balancedness_score
+        from cruise_control_tpu.analyzer.scenario_eval import VIOLATION_TOL
+
+        state = self._cluster_model(OperationProgress())
+        obj, viol, stats, degraded = self.scenario_evaluator.score_state(state)
+        pw, sw = self.balancedness_weights
+        measured = {
+            "objective": round(float(obj), 6),
+            "violations": [round(float(v), 6) for v in viol],
+            "balancedness": round(
+                balancedness_score(
+                    viol, self.chain, priority_weight=pw, strictness_weight=sw
+                ), 3,
+            ),
+            "violatedGoals": [
+                n for n, v in zip(self.chain.names(), viol)
+                if v > VIOLATION_TOL
+            ],
+            "load": load_summary(stats),
+            "windowIndex": int(cur_w),
+            "degraded": bool(degraded),
+        }
+        out = []
+        for entry in due:
+            did = entry["id"]
+            with self._ledger_lock:
+                pred = self._predictions.pop(did, None)
+            if pred is None:
+                continue
+            pv = np.asarray(pred["violations"], np.float64)
+            mv = np.asarray(measured["violations"], np.float64)
+            n = min(pv.size, mv.size)
+            goal_err = np.abs(mv[:n] - pv[:n])
+            load_err = load_summary_error(pred["load"], measured["load"])
+            rec = {
+                "predicted": {
+                    "objective": round(pred["objective"], 6),
+                    "violations": [round(float(v), 6) for v in pv],
+                    "balancedness": round(pred["balancedness"], 3),
+                    "load": pred["load"],
+                },
+                "measured": measured,
+                "error": {
+                    "goalAbs": [round(float(e), 6) for e in goal_err],
+                    "goalMaxAbs": round(float(goal_err.max() if n else 0.0), 6),
+                    "objectiveAbs": round(
+                        abs(measured["objective"] - pred["objective"]), 6
+                    ),
+                    "load": load_err,
+                },
+            }
+            try:
+                self.ledger.record_calibration(did, rec)
+            except Exception:  # noqa: BLE001
+                self.sensors.counter("analyzer.ledger.errors").inc()
+                continue
+            self._calibration_samples += 1
+            self._last_calibration = rec["error"]
+            self.sensors.counter("analyzer.calibration.samples").inc()
+            self.sensors.histogram("analyzer.calibration.goal-error").observe(
+                rec["error"]["goalMaxAbs"]
+            )
+            self.sensors.histogram("analyzer.calibration.load-error").observe(
+                rec["error"]["load"].get("maxAbsAvgError", 0.0)
+            )
+            with self._ledger_lock:
+                self._calibration_errors.append((
+                    rec["error"]["goalMaxAbs"],
+                    rec["error"]["load"].get("maxAbsAvgError", 0.0),
+                ))
+            out.append(rec)
+        with self._ledger_lock:
+            done = {e["id"] for e in due}
+            self._pending_calibrations = [
+                e for e in self._pending_calibrations if e["id"] not in done
+            ]
+        return out
+
+    def _detect_model_drift(self):
+        """Detector-loop hook: run due calibrations, then watch for
+        SUSTAINED prediction error.  MODEL_DRIFT fires EXACTLY once per
+        episode (alert-only, like OPTIMIZER_DEGRADED); the episode
+        re-arms once the mean error falls back under the threshold."""
+        try:
+            self._run_calibration_once()
+        except Exception:  # noqa: BLE001 — calibration must not kill the loop
+            self.sensors.counter("analyzer.calibration.failures").inc()
+            log.warning("calibration cycle failed", exc_info=True)
+        min_samples = self.config.get("analyzer.calibration.drift.min.samples")
+        threshold = self.config.get("analyzer.calibration.drift.threshold")
+        with self._ledger_lock:
+            errs = list(self._calibration_errors)[-min_samples:]
+        if len(errs) < min_samples:
+            return None
+        mean_goal = float(np.mean([g for g, _l in errs]))
+        mean_load = float(np.mean([l for _g, l in errs]))
+        if mean_goal <= threshold:
+            self._drift_active = False  # episode re-arms on recovery
+            return None
+        if self._drift_active:
+            return None  # once per episode
+        self._drift_active = True
+        self._drift_episodes += 1
+        from cruise_control_tpu.detector.anomalies import ModelDrift
+
+        return ModelDrift(
+            cluster_id=self.cluster_id or "",
+            samples=len(errs),
+            mean_goal_error=round(mean_goal, 6),
+            mean_load_error=round(mean_load, 6),
+            threshold=threshold,
+            episode=self._drift_episodes,
+        )
+
+    def calibration_state(self) -> dict:
+        """The /fleet //state calibration block: sample counts, last
+        prediction error, drift-episode state."""
+        with self._ledger_lock:
+            pending = len(self._pending_calibrations)
+        return {
+            "samples": self._calibration_samples,
+            "pending": pending,
+            "lastError": self._last_calibration,
+            "driftActive": self._drift_active,
+            "driftEpisodes": self._drift_episodes,
+        }
+
+    def ledger_entries(self, *, limit: int = 50) -> list[dict]:
+        """Joined decision→outcome→calibration episodes, newest first
+        (GET /ledger raw passthrough)."""
+        if self.ledger is None:
+            return []
+        return self.ledger.entries(limit=limit)
+
+    def explain(
+        self, *, trace_id: str | None = None, decision_id: str | None = None
+    ) -> dict:
+        """Replay one ledger episode as a structured explanation (GET
+        /explain?trace_id=|proposal=): goal deltas, top moves by
+        objective contribution, the convergence curve, and — when the
+        episode progressed that far — its outcome and calibration.
+        Raises KeyError when nothing matches (the server's 404),
+        ValueError when the ledger is disabled (400)."""
+        if self.ledger is None:
+            raise ValueError(
+                "decision ledger disabled (analyzer.ledger.enabled, "
+                "analyzer.ledger.dir)"
+            )
+        if not trace_id and not decision_id:
+            raise ValueError("explain needs trace_id= or proposal=")
+        entry = self.ledger.find(decision_id=decision_id, trace_id=trace_id)
+        if entry is None:
+            raise KeyError(
+                f"no ledger episode for "
+                f"{'proposal ' + decision_id if decision_id else 'trace ' + (trace_id or '')}"
+            )
+        d = entry["decision"]
+        goals = d.get("goals", {})
+        names = goals.get("names", [])
+        before = goals.get("violationsBefore", [])
+        after = goals.get("violationsAfter", [])
+        out = {
+            "decisionId": d.get("id"),
+            "traceId": d.get("trace_id", ""),
+            "cluster": d.get("cluster", ""),
+            "source": d.get("source", ""),
+            "workClass": d.get("workClass", ""),
+            "computedMs": d.get("ms"),
+            "generation": d.get("generation"),
+            "bucket": d.get("bucket"),
+            "degraded": bool(d.get("degraded")),
+            "goalDeltas": [
+                {
+                    "goal": n,
+                    "before": b,
+                    "after": a,
+                    "delta": round(float(a) - float(b), 6),
+                }
+                for n, b, a in zip(names, before, after)
+            ],
+            "objective": {
+                "before": goals.get("objectiveBefore"),
+                "after": goals.get("objectiveAfter"),
+            },
+            "balancedness": {
+                "before": goals.get("balancednessBefore"),
+                "after": goals.get("balancednessAfter"),
+            },
+            "numReplicaMovements": d.get("numReplicaMovements"),
+            "numLeaderMovements": d.get("numLeaderMovements"),
+            "dataToMoveMB": d.get("dataToMoveMB"),
+            "topMoves": d.get("moves", []),
+            "convergence": d.get("convergence"),
+            "predictedLoad": d.get("predictedLoad"),
+            "outcome": entry.get("outcome"),
+            "calibration": entry.get("calibration"),
+        }
+        return out
 
     def _valid_cache(self) -> OptimizerResult | None:
         with self._cache_lock:
@@ -1255,11 +1675,58 @@ class CruiseControl:
                 ov["replica_movement_strategies"], allowed=self.allowed_strategies
             )
         self.executor.catalog = self.monitor.last_catalog
-        out = self.executor.execute_proposals(
-            proposals, self._exec_options(ov),
-            removed_brokers=removed, demoted_brokers=demoted,
-            strategy=strategy,
-        )
+        did = None
+        claimed = False
+        if self.ledger is not None:
+            # the decision about to be acted on: published results carry
+            # their ledger id already; a custom (never-published) result
+            # is recorded now so its outcome still has a join target
+            did = self._ledger_decision_id(result)
+            if did is None:
+                did = self._record_decision(
+                    result, source="request",
+                    generation=self.monitor.model_generation(),
+                    work_class="interactive",
+                )
+            if did is not None:
+                # CLAIM, never overwrite: a concurrent second execution
+                # attempt (about to be rejected with OngoingExecutionError)
+                # must not clobber the in-flight execution's join slot —
+                # that would orphan its real outcome forever and wedge
+                # ledger rotation behind the stranded pending id
+                with self._ledger_lock:
+                    if self._executing_decision is None:
+                        self._executing_decision = did
+                        claimed = True
+                if claimed:
+                    self.ledger.begin_outcome(did)
+        try:
+            out = self.executor.execute_proposals(
+                proposals, self._exec_options(ov),
+                removed_brokers=removed, demoted_brokers=demoted,
+                strategy=strategy,
+            )
+        except BaseException as e:
+            # the executor's finish hook did not fire (setup failure or
+            # mid-batch exception outside the fenced path): the episode's
+            # outcome is the error — never leave a pending join forever.
+            # Only the attempt that CLAIMED the slot may write it.
+            still = False
+            if claimed:
+                with self._ledger_lock:
+                    still = self._executing_decision == did
+                    if still:
+                        self._executing_decision = None
+            if still and self.ledger is not None:
+                try:
+                    self.ledger.record_outcome(did, {
+                        "error": repr(e), "completed": 0, "aborted": 0,
+                        "dead": 0, "stopped": False, "fencedAbort": False,
+                        "reaped": 0,
+                    })
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         if self.controller is not None:
             # executed proposals are the strongest signal the learned
             # move-acceptance prior gets (controller/prior.py)
@@ -1863,6 +2330,11 @@ class CruiseControl:
             }
             if self.supervisor is not None:
                 out["AnalyzerState"]["supervisor"] = self.supervisor.state_json()
+            if self.ledger is not None:
+                # decision ledger + predicted-vs-measured calibration
+                # (analyzer/ledger.py; full episodes on GET /ledger)
+                out["AnalyzerState"]["ledger"] = self.ledger.state_json()
+                out["AnalyzerState"]["calibration"] = self.calibration_state()
         if "controller" in substates and self.controller is not None:
             out["ControllerState"] = self.controller.state_json()
         if "anomaly_detector" in substates:

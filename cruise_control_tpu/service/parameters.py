@@ -228,6 +228,17 @@ _RAW_PARAMETERS: dict[str, tuple] = {
                           "with per-bucket trace-id exemplars (also "
                           "negotiated via the Accept header)"),),
         "slo": (),
+        # --- decision ledger (analyzer/ledger.py) ---
+        "explain": (Param("trace_id", str,
+                          "flight-recorder trace id of the decision to "
+                          "explain (the _traceId of the async response "
+                          "that computed it)"),
+                    Param("proposal", str,
+                          "ledger decision id to explain (from GET "
+                          "/ledger or a decision record)")),
+        "ledger": (Param("limit", _min1_int,
+                         "max joined decision→outcome→calibration "
+                         "episodes returned, newest first (default 50)"),),
         # --- fleet controller (whole-instance rollup) ---
         "fleet": (Param("score", _bool,
                         "also batch-score every cluster's current placement "

@@ -260,6 +260,47 @@ RESPONSE_SCHEMAS: dict[str, Schema] = {
         Field("numClusters", NUM),
         Field("clusters", DICT),
     )),
+    # --- decision ledger (analyzer/ledger.py) ---
+    # GET /explain: one ledger episode replayed as a structured
+    # explanation — goal deltas, top moves, convergence curve, plus the
+    # outcome and calibration records when the episode progressed that far
+    "explain": Schema((
+        Field("decisionId", STR),
+        Field("traceId", STR),
+        Field("cluster", STR),
+        Field("source", STR),
+        Field("workClass", STR),
+        Field("computedMs", NUM),
+        Field("generation", DICT, required=False),
+        Field("bucket", DICT, required=False),
+        Field("degraded", BOOL),
+        Field("goalDeltas", LIST, item_schema=Schema((
+            Field("goal", STR),
+            Field("before", NUM),
+            Field("after", NUM),
+            Field("delta", NUM),
+        ))),
+        Field("objective", DICT),
+        Field("balancedness", DICT),
+        Field("numReplicaMovements", NUM),
+        Field("numLeaderMovements", NUM),
+        Field("dataToMoveMB", NUM),
+        Field("topMoves", LIST),
+        # engine convergence diagnostics (null when the decision was
+        # computed with analyzer.diagnostics.enabled=false)
+        Field("convergence", DICT, required=False),
+        Field("predictedLoad", DICT, required=False),
+        # execution outcome / predicted-vs-measured calibration: null
+        # until the episode reaches that stage
+        Field("outcome", DICT, required=False),
+        Field("calibration", DICT, required=False),
+    )),
+    # GET /ledger: the raw joined episode stream + the store's state
+    "ledger": Schema((
+        Field("enabled", BOOL),
+        Field("entries", LIST),
+        Field("state", DICT, required=False),
+    )),
 }
 
 #: non-200 body shapes (shared by every endpoint)

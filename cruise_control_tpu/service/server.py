@@ -870,6 +870,35 @@ class CruiseControlApp:
             clusters = {cid: block(self.fleet.facade(cid)) for cid in ids}
         return 200, {"numClusters": len(clusters), "clusters": clusters}
 
+    def _ep_explain(self, params) -> tuple[int, dict]:
+        """GET /explain?trace_id=|proposal= — replay one decision-ledger
+        episode as a structured explanation: goal deltas, top moves,
+        convergence curve, outcome + calibration when present
+        (analyzer/ledger.py; cluster-scoped — each cluster owns its own
+        ledger)."""
+        trace_id = params.get("trace_id", [None])[0]
+        proposal = params.get("proposal", [None])[0]
+        try:
+            out = self.cc.explain(trace_id=trace_id, decision_id=proposal)
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        # KeyError (unknown trace/proposal) rides to the dispatcher's 404
+        return 200, out
+
+    def _ep_ledger(self, params) -> tuple[int, dict]:
+        """GET /ledger — the raw joined decision→outcome→calibration
+        episode stream, newest first (the flywheel's training-corpus
+        export; `cccli ledger` prints it verbatim)."""
+        limit = int(params.get("limit", ["50"])[0])
+        cc = self.cc
+        if cc.ledger is None:
+            return 200, {"enabled": False, "entries": []}
+        return 200, {
+            "enabled": True,
+            "entries": cc.ledger_entries(limit=limit),
+            "state": cc.ledger.state_json(),
+        }
+
     def _ep_fleet(self, params) -> tuple[int, dict]:
         """GET /fleet — whole-instance rollup: per-cluster summaries + the
         shared core (engine cache, supervisor, admission control).  With

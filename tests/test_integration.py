@@ -130,6 +130,9 @@ def test_full_stack_reporter_to_executor_round_trip():
             topic_name_fn={0: "T0", 1: "T1"}.__getitem__,
             topic_id_fn={"T0": 0, "T1": 1}.__getitem__,
         )
+        import tempfile
+
+        journal_dir = tempfile.mkdtemp(prefix="ledger-integ-")
         config = CruiseControlConfig({
             "num.partition.metrics.windows": "2",
             "partition.metrics.window.ms": str(WINDOW_MS),
@@ -137,6 +140,10 @@ def test_full_stack_reporter_to_executor_round_trip():
             "num.broker.metrics.windows": "2",
             "broker.metrics.window.ms": str(WINDOW_MS),
             "webserver.http.port": "0",
+            # durable surfaces: the execution journal + the decision
+            # ledger (derived beneath it) record this rebalance's episode
+            "executor.journal.dir": journal_dir,
+            "tpu.prewarm.enabled": "false",
         })
         from cruise_control_tpu.kafka import KafkaMetadataProvider
 
@@ -252,6 +259,34 @@ def test_full_stack_reporter_to_executor_round_trip():
         }
         assert completed == ids_seen, "every task must reach COMPLETED"
         assert exc["attributes"]["completed"] == len(ids_seen)
+
+        # --- decision ledger: the executed rebalance is one joined
+        # decision -> outcome episode, calibrated once the next complete
+        # metric window measures the post-move cluster, and GET /explain
+        # replays it (analyzer/ledger.py acceptance story) ---
+        cc = app.cc
+        assert cc.ledger is not None
+        episode = cc.ledger.entries(limit=10)
+        executed = [e for e in episode if e["outcome"] is not None]
+        assert executed, "the executed rebalance must have joined an outcome"
+        entry = executed[0]
+        did = entry["decision"]["id"]
+        assert entry["decision"]["goals"]["names"] == cc.chain.names()
+        assert entry["decision"]["convergence"]["rounds"] >= 1
+        assert entry["outcome"]["completed"] == exc["attributes"]["completed"]
+        # roll the NEXT complete metric window, then calibrate
+        t_mid = 3 * WINDOW_MS + WINDOW_MS // 2
+        for rep in reporters:
+            rep.report_once(now_ms=t_mid)
+        fetcher.fetch_once(entities, 3 * WINDOW_MS, 4 * WINDOW_MS - 1)
+        cc._detect_model_drift()
+        entry = cc.ledger.find(decision_id=did)
+        assert entry["calibration"] is not None
+        assert entry["calibration"]["error"]["goalMaxAbs"] >= 0.0
+        st, explained, _ = req("GET", "explain", proposal=did)
+        assert st == 200 and explained["decisionId"] == did
+        assert explained["outcome"]["completed"] > 0
+        assert explained["calibration"] is not None
 
         # --- Prometheus exposition over the live service ---
         from cruise_control_tpu.common.exposition import parse_exposition

@@ -197,12 +197,13 @@ def test_endpoint_surface_complete():
     """The reference exposes 9 GET + 11 POST endpoints
     (CruiseControlEndPoint.java:16-37) — all must exist here, plus the
     planner's read-only /rightsize (GET) and /simulate (POST), the
-    observability surface /trace + /metrics + /slo (GET), and the fleet
-    controller's /fleet rollup (GET)."""
+    observability surface /trace + /metrics + /slo + the decision
+    ledger's /explain + /ledger (GET), and the fleet controller's /fleet
+    rollup (GET)."""
     assert set(GET_ENDPOINTS) == {
         "bootstrap", "train", "load", "partition_load", "proposals", "state",
         "kafka_cluster_state", "user_tasks", "review_board", "rightsize",
-        "trace", "metrics", "fleet", "slo",
+        "trace", "metrics", "fleet", "slo", "explain", "ledger",
     }
     assert set(POST_ENDPOINTS) == {
         "add_broker", "remove_broker", "fix_offline_replicas", "rebalance",
