@@ -1635,6 +1635,7 @@ def streaming(smoke_mode: bool) -> int:
         parts = sampler.all_partition_entities()
         wms = 1000
         rounds, objectives, violations = [], [], []
+        fused, dispatches = [], []
         last_result = None
         t0 = time.monotonic()
         for w in range(4, 4 + n_windows):
@@ -1645,12 +1646,15 @@ def streaming(smoke_mode: bool) -> int:
             rounds.append(info["rounds"])
             objectives.append(info["objective"])
             violations.append(float(np.max(info["result"].violations_after)))
+            fused.append(bool(info.get("fused")))
+            dispatches.append(sum(info.get("dispatches", {}).values()))
             last_result = info["result"]
         wall = time.monotonic() - t0
         stats = ctl.state_json()
         app.stop()
         return dict(
             rounds=rounds, objectives=objectives, violations=violations,
+            fused=fused, dispatches=dispatches,
             wall_s=wall, stats=stats, cc=cc, last_result=last_result,
         )
 
@@ -1702,18 +1706,35 @@ def streaming(smoke_mode: bool) -> int:
         and cold["stats"]["fullReflattens"] == n_windows
     )
     # the headline latency metric (ROADMAP item 4): window-roll-to-
-    # published-proposal p50/p99 from the controller's histogram — every
-    # warm window publishes, so the histogram must have n_windows samples
+    # published-proposal p50/p99 from the controller's histogram.  The
+    # first published cycle (XLA cold compile) and the first FUSED cycle
+    # (fused-program compile) are excluded — each reports through its own
+    # one-shot sensor — so the histogram holds n_windows - 2 steady-state
+    # samples and the p99 is an honest steady-state claim
     hist = warm["cc"].sensors.get("controller.window-roll-to-publish-seconds")
     publish_p50 = publish_p99 = None
-    hist_ok = hist is not None and hist.count == n_windows
+    hist_ok = hist is not None and hist.count == n_windows - 2
     if hist is not None and hist.count:
         # None (JSON null), never NaN, when empty: the failing run's
         # record must stay parseable by strict JSON consumers
         publish_p50 = round(hist.quantile(0.5), 4)
         publish_p99 = round(hist.quantile(0.99), 4)
-    ok = parity and rounds_ok and obj_ok and inplace_ok and hist_ok
-    _emit(
+    # the fusion contract (tentpole gate): every steady-state delta
+    # cycle after the fused program compiles runs FUSED, and a fused
+    # cycle costs exactly one program dispatch + one host extraction —
+    # proved by the controller's dispatch meter, not assumed.  Window 0
+    # is the reflatten, window 1 goes staged while the warm engine cache
+    # fills; everything after must fuse.
+    fused_ok = all(warm["fused"][2:]) and not warm["fused"][0]
+    dispatch_ok = all(
+        d <= 2 for d, f in zip(warm["dispatches"], warm["fused"]) if f
+    )
+    sub_second_ok = publish_p99 is not None and publish_p99 < 1.0
+    ok = (
+        parity and rounds_ok and obj_ok and inplace_ok and hist_ok
+        and fused_ok and dispatch_ok and sub_second_ok
+    )
+    rec = dict(
         metric="streaming_warm_vs_cold",
         value=round(warm["wall_s"], 3),
         unit="s",
@@ -1722,6 +1743,18 @@ def streaming(smoke_mode: bool) -> int:
         window_roll_to_publish_p50_s=publish_p50,
         window_roll_to_publish_p99_s=publish_p99,
         publish_histogram_ok=hist_ok,
+        fused_cycles=warm["stats"]["fusedCycles"],
+        fused_ok=fused_ok,
+        dispatches_per_fused_cycle_max=max(
+            (d for d, f in zip(warm["dispatches"], warm["fused"]) if f),
+            default=None,
+        ),
+        dispatch_ok=dispatch_ok,
+        sub_second_ok=sub_second_ok,
+        cold_cycle_s=warm["stats"]["coldCycleSeconds"],
+        fused_cold_cycle_s=warm["stats"]["fusedColdCycleSeconds"],
+        plan_sized_cycles=warm["stats"]["planSizedCycles"],
+        reflattens_by_reason=warm["stats"]["fullReflattensByReason"],
         proposals_per_sec=round(n_windows / max(warm["wall_s"], 1e-9), 3),
         cold_proposals_per_sec=round(n_windows / max(cold["wall_s"], 1e-9), 3),
         warm_rounds_mean=round(warm_mean, 3),
@@ -1740,6 +1773,16 @@ def streaming(smoke_mode: bool) -> int:
         inplace_ok=inplace_ok,
         ok=ok,
     )
+    _emit(**rec)
+    if not smoke_mode:
+        # the committed trajectory record (BENCHLOG.md convention): one
+        # JSON file per full streaming run, beside the BENCH_r*.json
+        # headline records
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_streaming_r01.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
     return 0 if ok else 1
 
 

@@ -83,10 +83,16 @@ from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
 from cruise_control_tpu.common.device_watchdog import device_op
+from cruise_control_tpu.common.dispatch import count_dispatch
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.aggregates import compute_aggregates
-from cruise_control_tpu.models.state import ClusterShape, ClusterState
+from cruise_control_tpu.models.state import (
+    ClusterShape,
+    ClusterState,
+    validate_on_device,
+)
+from cruise_control_tpu.models.stats import compute_stats
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,6 +169,18 @@ class OptimizerConfig:
     #: placements are byte-identical to the off path — pinned by
     #: tests/test_ledger.py across plain, segmented, and mesh runs.
     diagnostics: bool = False
+    #: mixed-precision goal scoring (config analyzer.precision.score.dtype):
+    #: "bfloat16" accumulates the goal-score weighted sums — the
+    #: `_broker_terms` inner loop (inlined ~8x into the step program) and
+    #: the goal chain's objective reduction — in bf16, halving the hot
+    #: loop's accumulation bandwidth.  Parity-safe subset only: threshold
+    #: compares, ceil/floor banding, violation vectors, and RNG arithmetic
+    #: stay f32.  Trace-static: the default "float32" takes the original
+    #: code path so its traced program is byte-identical to the pre-knob
+    #: engine (the fp32 fallback pin); the bf16 objective must track f32
+    #: within analyzer.precision.tolerance (the tolerance gate, pinned by
+    #: tests/test_optimizer.py and the streaming bench).
+    score_dtype: str = "float32"
 
     def __post_init__(self):
         # round-count knobs validated in ONE place: both the in-graph
@@ -183,6 +201,11 @@ class OptimizerConfig:
         if self.num_candidates < 1:
             raise ValueError(
                 f"num_candidates must be >= 1, got {self.num_candidates}"
+            )
+        if self.score_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"score_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.score_dtype!r}"
             )
 
     @property
@@ -371,12 +394,45 @@ def partition_replica_table(
     return table
 
 
+def _prior_fields(prior, T: int, B: int, dest_idx: np.ndarray):
+    """(prior_cdf f32[T, B], prior_mix float) from a duck-typed prior
+    (`.weights` f32[T, B] in broker-id space, `.mix` float) and the REAL
+    destination-position list `dest_idx` — the prior-onto-positions
+    conversion factored out of build_statics so the fused streaming cycle
+    can refresh ONLY these two statics fields per window
+    (Engine.rebind_prior) without build_statics' batched device fetch."""
+    n_dest_int = int(dest_idx.size)
+    prior_cdf = np.ones((T, B), np.float32)
+    w = None if prior is None else getattr(prior, "weights", None)
+    if w is not None:
+        w = np.asarray(w, np.float32)
+        if w.shape != (T, B):
+            raise ValueError(
+                f"prior weights shape {w.shape} != model (T={T}, B={B})"
+            )
+        w_pos = np.maximum(w[:, dest_idx], 0.0)  # [T, n_dest]
+    else:
+        w_pos = np.zeros((T, n_dest_int), np.float32)
+    tot = w_pos.sum(1, keepdims=True)
+    # unseen topics draw uniformly over the real destination list —
+    # still a valid categorical, just a different stream than the
+    # uniform branch (the mix gate decides which branch is taken)
+    uni = np.full((T, n_dest_int), 1.0 / max(1, n_dest_int), np.float32)
+    probs = np.where(tot > 0.0, w_pos / np.maximum(tot, 1e-12), uni)
+    prior_cdf[:, :n_dest_int] = np.cumsum(probs, axis=1)
+    prior_mix = float(getattr(prior, "mix", 0.0)) if prior is not None else 0.0
+    if not 0.0 <= prior_mix <= 1.0:
+        raise ValueError(f"prior mix must be in [0, 1], got {prior_mix}")
+    return prior_cdf, prior_mix
+
+
 def build_statics(
     state: ClusterState,
     options: OptimizationOptions,
     *,
     prior=None,
     prior_full_shape: bool = False,
+    layout_out: dict | None = None,
 ) -> EngineStatics:
     """Host-side (numpy) preprocessing of one model generation.
 
@@ -423,32 +479,16 @@ def build_statics(
     front_packed = bool(h["replica_valid"][:n_valid_int].all())
     n_source = n_valid_int if front_packed else s.R
     n_dest_int = int(dest_idx.size)
+    if layout_out is not None:
+        # host-side destination layout for data-only statics refreshes
+        # (Engine.rebind_prior): the fused cycle path must rebuild the
+        # prior CDF without re-fetching these arrays from device
+        layout_out["dest_idx"] = dest_idx
     if not prior_full_shape:
         prior_cdf = np.zeros((1, 1), np.float32)
         prior_mix = 0.0
     else:
-        T = s.num_topics
-        prior_cdf = np.ones((T, s.B), np.float32)
-        w = None if prior is None else getattr(prior, "weights", None)
-        if w is not None:
-            w = np.asarray(w, np.float32)
-            if w.shape != (T, s.B):
-                raise ValueError(
-                    f"prior weights shape {w.shape} != model (T={T}, B={s.B})"
-                )
-            w_pos = np.maximum(w[:, dest_idx], 0.0)  # [T, n_dest]
-        else:
-            w_pos = np.zeros((T, n_dest_int), np.float32)
-        tot = w_pos.sum(1, keepdims=True)
-        # unseen topics draw uniformly over the real destination list —
-        # still a valid categorical, just a different stream than the
-        # uniform branch (the mix gate decides which branch is taken)
-        uni = np.full((T, n_dest_int), 1.0 / max(1, n_dest_int), np.float32)
-        probs = np.where(tot > 0.0, w_pos / np.maximum(tot, 1e-12), uni)
-        prior_cdf[:, :n_dest_int] = np.cumsum(probs, axis=1)
-        prior_mix = float(getattr(prior, "mix", 0.0)) if prior is not None else 0.0
-        if not 0.0 <= prior_mix <= 1.0:
-            raise ValueError(f"prior mix must be in [0, 1], got {prior_mix}")
+        prior_cdf, prior_mix = _prior_fields(prior, s.num_topics, s.B, dest_idx)
     return EngineStatics(
         state=state,
         part_replicas=jnp.asarray(partition_replica_table(state, host=h)),
@@ -855,8 +895,12 @@ class Engine:
             )
             self.K_r = config.num_candidates - self.K_l - self.K_s
         self.d_thresh = float(constraint.capacity_threshold[int(Resource.DISK)])
+        #: host-side destination layout of the CURRENT statics (filled by
+        #: build_statics) — rebind_prior's no-device-fetch prior refresh
+        self._statics_layout: dict = {}
         self.statics = build_statics(
-            state, options, prior=prior, prior_full_shape=config.prior_enabled
+            state, options, prior=prior, prior_full_shape=config.prior_enabled,
+            layout_out=self._statics_layout,
         )
         self._scan = jax.jit(self._scan_impl)
         self._jit_refresh = jax.jit(self._refresh_impl)
@@ -873,6 +917,14 @@ class Engine:
         # EngineCarry at 500k-replica scale, not one per dispatch
         self._jit_run_fused = jax.jit(self._run_fused_impl, donate_argnums=(1,))
         self._jit_run_fused_verbose = None  # built lazily (adds per-round eval)
+        # the fused STREAMING-CYCLE program (delta scatter + warm re-anneal
+        # + reports + extraction payload as ONE dispatch): the live load
+        # arrays are donated — the scatter rewrites them in place, exactly
+        # like LiveState's standalone scatter program
+        self._jit_run_cycle = jax.jit(self._cycle_impl, donate_argnums=(1, 2))
+        #: cached (statics, cycle-statics, zero-loads placeholder) triple
+        #: backing _cycle_statics
+        self._cycle_sx: tuple | None = None
         #: segmented (preemptible) execution programs, built lazily on the
         #: first scheduler-granted slice run: the init program plus one
         #: slice program per rounds-per-slice length (powers of two)
@@ -1115,11 +1167,34 @@ class Engine:
             raise ValueError(
                 f"shape changed {self.shape} -> {state.shape}; build a new Engine"
             )
+        self._statics_layout = {}
         self.statics = build_statics(
             state, options, prior=prior,
             prior_full_shape=self.config.prior_enabled,
+            layout_out=self._statics_layout,
         )
         return self
+
+    def rebind_prior(self, prior) -> None:
+        """Refresh ONLY the learned-prior statics fields (prior_dst_cdf /
+        prior_mix) from host-side data — the steady-state fused cycle's
+        per-window rebind.  A full rebind() pays build_statics' batched
+        device fetch every cycle; between reflattens the placement,
+        capacity, and option masks those arrays derive from cannot have
+        changed, so the prior (the one statics input that evolves every
+        window) is the only field worth touching.  No device_get, no
+        recompile (same shapes/dtypes)."""
+        if not self.config.prior_enabled or prior is None:
+            return
+        cdf, mix = _prior_fields(
+            prior, self.shape.num_topics, self.shape.B,
+            self._statics_layout["dest_idx"],
+        )
+        self.statics = dataclasses.replace(
+            self.statics,
+            prior_dst_cdf=jnp.asarray(cdf),
+            prior_mix=jnp.asarray(mix, jnp.float32),
+        )
 
     def release(self) -> None:
         """Free this engine's device buffers (engine-cache LRU eviction).
@@ -1249,7 +1324,8 @@ class Engine:
 
     def _objective_impl(self, sx: EngineStatics, carry: EngineCarry):
         obj, _, _ = self.chain.evaluate(
-            self.carry_to_state(carry, sx), constraint=self.constraint
+            self.carry_to_state(carry, sx), constraint=self.constraint,
+            score_dtype=self.config.score_dtype,
         )
         return obj
 
@@ -1328,7 +1404,8 @@ class Engine:
             disk_load=carry.disk_load,
         )
         obj, viol, _ = self.chain.evaluate(
-            self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint
+            self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint,
+            score_dtype=self.config.score_dtype,
         )
         return obj, viol
 
@@ -1441,7 +1518,17 @@ class Engine:
         c = self.constraint
         cap = st.broker_capacity[b]  # [..., 4]
         alive = sx.alive[b]
-        out = jnp.zeros(jnp.shape(b), jnp.float32)
+        # mixed-precision accumulation (config analyzer.precision.score.dtype):
+        # each goal term is still computed in f32 (the reluses against
+        # capacities need the dynamic range), but the running per-broker SUM
+        # of terms — the hottest accumulate in the step program, inlined ~8x —
+        # may ride bf16.  f32 is the default, and `_acc` is the identity
+        # there (same-dtype astype returns the input tracer), so the default
+        # traced graph is byte-identical to the pre-flag one: the fp32 pin.
+        lowp = self.config.score_dtype != "float32"
+        acc_dt = jnp.dtype(self.config.score_dtype)
+        _acc = (lambda x: x.astype(acc_dt)) if lowp else (lambda x: x)
+        out = jnp.zeros(jnp.shape(b), acc_dt if lowp else jnp.float32)
         # per-resource constants as [4] vectors: one vectorized expression
         # instead of a 4-iteration Python loop — this function is inlined
         # ~8x into the step program, so per-resource unrolling multiplies
@@ -1457,16 +1544,16 @@ class Engine:
         single = ~sx.host_multi[st.broker_host[b]]
         excess = _relu(load - cth * cap)  # [..., 4]
         gate = alive[..., None] & (single[..., None] | ~host_res)
-        out += (jnp.where(gate, excess, 0.0) * (w_cap / sx.total_cap)).sum(-1)
+        out += _acc((jnp.where(gate, excess, 0.0) * (w_cap / sx.total_cap)).sum(-1))
 
         # replica capacity
         exc = _relu((rcount - c.max_replicas_per_broker).astype(jnp.float32))
-        out += w.replica_cap * jnp.where(alive, exc, 0.0) / sx.n_valid
+        out += _acc(w.replica_cap * jnp.where(alive, exc, 0.0) / sx.n_valid)
 
         # potential nw out
         r = int(Resource.NW_OUT)
         exc = _relu(pot - c.capacity_threshold[r] * cap[..., r])
-        out += w.pot_nw_out * jnp.where(alive, exc, 0.0) / sx.total_cap[r]
+        out += _acc(w.pot_nw_out * jnp.where(alive, exc, 0.0) / sx.total_cap[r])
 
         # resource distribution bands
         t_bal = np.asarray(c.balance_threshold, np.float32)
@@ -1475,10 +1562,12 @@ class Engine:
         upper = g["avg_pct"] * t_bal * cap
         lower = g["avg_pct"] * t_low * cap
         term = _relu(load - upper) + _relu(lower - load)
-        out += (
-            jnp.where(alive[..., None], term, 0.0)
-            * (w_dist / (g["total_load"] + 1e-12))
-        ).sum(-1)
+        out += _acc(
+            (
+                jnp.where(alive[..., None], term, 0.0)
+                * (w_dist / (g["total_load"] + 1e-12))
+            ).sum(-1)
+        )
 
         # replica count distribution
         t = c.replica_count_balance_threshold
@@ -1486,7 +1575,7 @@ class Engine:
         lower = jnp.floor(g["avg_count"] * max(0.0, 2.0 - t))
         rc = rcount.astype(jnp.float32)
         term = _relu(rc - upper) + _relu(lower - rc)
-        out += w.replica_dist * jnp.where(alive, term, 0.0) / g["total_count"]
+        out += _acc(w.replica_dist * jnp.where(alive, term, 0.0) / g["total_count"])
 
         # leader count distribution
         t = c.leader_replica_count_balance_threshold
@@ -1494,14 +1583,16 @@ class Engine:
         lower = jnp.floor(g["avg_lcount"] * max(0.0, 2.0 - t))
         lc = lcount.astype(jnp.float32)
         term = _relu(lc - upper) + _relu(lower - lc)
-        out += w.leader_dist * jnp.where(alive, term, 0.0) / g["total_lcount"]
+        out += _acc(w.leader_dist * jnp.where(alive, term, 0.0) / g["total_lcount"])
 
         # leader bytes-in distribution (upper band only)
         t = c.balance_threshold[int(Resource.NW_IN)]
         term = _relu(lbin - g["avg_lbin"] * t)
-        out += w.lbin_dist * jnp.where(alive, term, 0.0) / g["total_lbin"]
+        out += _acc(w.lbin_dist * jnp.where(alive, term, 0.0) / g["total_lbin"])
 
-        return out
+        # downstream consumers (plan weights, scalar objective reduction)
+        # expect f32; a no-op when the accumulator already is
+        return out.astype(jnp.float32)
 
     def _host_terms(self, sx, h, hload):
         """Host-granularity capacity terms for multi-broker hosts
@@ -2822,12 +2913,14 @@ class Engine:
                 total_rounds=int(total),
             ) if _bb.enabled else 0
             try:
+                count_dispatch("engine.slice")
                 carry, seg, ys = self._seg_fn(L)(
                     sx, carry, seg, jnp.asarray(base, jnp.int32)
                 )
                 # the slice boundary IS a blocking sync: the device must
                 # be genuinely idle before the scheduler may hand it to
                 # an urgent request (seg[2] is the in-graph `done` flag)
+                count_dispatch("engine.sync")
                 ys_host, done = jax.device_get((ys, seg[2]))
             except BaseException as e:  # noqa: BLE001 — recorded, re-raised
                 _bb.end(bb_seq, ok=False, error=repr(e))
@@ -2909,6 +3002,7 @@ class Engine:
 
     def _init_for_run(self, initial_placement):
         key = jax.random.PRNGKey(self.config.seed)
+        count_dispatch("engine.init")
         if initial_placement is None:
             return self.init_carry(key)
         return self.init_carry_from(key, initial_placement)
@@ -3005,6 +3099,7 @@ class Engine:
                 # the fused program lazily — a fresh trace the cold-start
                 # report must see
                 self._record_fused_trace("fresh")
+        count_dispatch("engine.run")
         carry, ys = fused(sx, carry)
         t_disp = time.monotonic()
         # the run's ONE blocking sync: O(rounds) scalars (completes only
@@ -3016,6 +3111,7 @@ class Engine:
         # program inline, so device compute lands in host_dispatch_s and
         # device_s measures only this drain — compare wall clocks, not the
         # split, on CPU.
+        count_dispatch("engine.sync")
         ys = jax.device_get(ys)
         t_sync = time.monotonic()
 
@@ -3030,6 +3126,146 @@ class Engine:
             timing["convergence"] = conv
         history.append(timing)
         return self.carry_to_state(carry), history
+
+    # ------------------------------------------------------------------
+    # fused streaming-cycle program (delta scatter + re-anneal + extract)
+    # ------------------------------------------------------------------
+
+    def _cycle_statics(self) -> EngineStatics:
+        """Statics variant safe to pass alongside DONATED live load arrays.
+
+        The cycle program donates the live replica_load_leader/follower
+        buffers; if the statics' embedded state still held the same Array
+        objects, XLA would see a donated buffer aliased by a second input
+        (an error).  The cycle statics therefore carry zero-filled
+        placeholder load leaves — `_cycle_impl` overwrites them with the
+        donated (and freshly scattered) arrays before anything reads
+        loads.  Cached per statics generation; the placeholder zeros are
+        reused across rebinds (same shape every generation)."""
+        cached = self._cycle_sx
+        if cached is not None and cached[0] is self.statics:
+            return cached[1]
+        zeros = (
+            cached[2]
+            if cached is not None
+            else jnp.zeros((self.shape.R, NUM_RESOURCES), jnp.float32)
+        )
+        sxc = dataclasses.replace(
+            self.statics,
+            state=dataclasses.replace(
+                self.statics.state,
+                replica_load_leader=zeros,
+                replica_load_follower=zeros,
+            ),
+        )
+        self._cycle_sx = (self.statics, sxc, zeros)
+        return sxc
+
+    def _cycle_impl(self, sx, ll, fl, rows, new_ll, new_fl, rb, il, dk):
+        """The steady-state streaming cycle as ONE XLA program: delta
+        scatter + before-report + warm re-anneal + after-report + device
+        validation + the proposal-extraction payload.
+
+        Inlines exactly the programs the staged path dispatches separately
+        (LiveState's scatter, optimizer's `_report`, `init_carry_from`,
+        the fused anneal, `validate_on_device`), sharing their traced
+        subprograms — so with full-K config the resulting placement is
+        byte-identical to the staged path by construction (pinned by
+        tests/test_controller.py).  `ll`/`fl` are DONATED; the scattered
+        arrays come back as outputs, making the caller (LiveState) the
+        sole owner of one live load copy at 500k-replica scale.
+
+        Reports run in full f32 regardless of `score_dtype` — they are
+        user-facing numbers matching optimizer._report, not search
+        internals."""
+        drop = dict(mode="drop")
+        ll = ll.at[rows].set(new_ll, **drop)
+        fl = fl.at[rows].set(new_fl, **drop)
+        st = dataclasses.replace(
+            sx.state, replica_load_leader=ll, replica_load_follower=fl
+        )
+        sx = dataclasses.replace(sx, state=st)
+        agg_b = compute_aggregates(st)
+        obj_b, viol_b, _ = self.chain.evaluate(
+            st, agg=agg_b, constraint=self.constraint
+        )
+        stats_b = compute_stats(st, agg_b)
+        key = jax.random.PRNGKey(self.config.seed)
+        carry = self._init_from_impl(sx, key, rb, il, dk)
+        carry, ys = self._fused_rounds_body(sx, carry, verbose=False)
+        final = self.carry_to_state(carry, sx)
+        agg_a = compute_aggregates(final)
+        obj_a, viol_a, _ = self.chain.evaluate(
+            final, agg=agg_a, constraint=self.constraint
+        )
+        stats_a = compute_stats(final, agg_a)
+        payload = dict(
+            ys=ys,
+            obj_before=obj_b, viol_before=viol_b, stats_before=stats_b,
+            obj_after=obj_a, viol_after=viol_a, stats_after=stats_a,
+            replica_broker=carry.replica_broker,
+            replica_is_leader=carry.replica_is_leader,
+            replica_disk=carry.replica_disk,
+            replica_offline=final.replica_offline,
+            replica_disk_bytes=ll[:, int(Resource.DISK)],
+            checks=validate_on_device(final),
+        )
+        return ll, fl, payload
+
+    @device_op("engine.cycle")
+    def run_cycle(self, ll, fl, rows, new_ll, new_fl, initial_placement):
+        """Host driver for `_cycle_impl`: ONE dispatch, ONE blocking fetch.
+
+        `ll`/`fl` are the LIVE f32[R, 4] load arrays (donated — the caller
+        must adopt the returned pair as the new live arrays); `rows` /
+        `new_ll` / `new_fl` are the window delta, `initial_placement` the
+        warm-start (rb, il, dk) triple.  Rows are padded to power-of-two
+        buckets with the out-of-range sentinel R (dropped by the scatter)
+        so successive windows of different delta sizes reuse one compiled
+        cycle program — same bucketing as LiveState's standalone scatter.
+
+        Returns (new_ll, new_fl, payload, history): payload is the fetched
+        host dict (reports, final placement, checks, disk bytes), history
+        the same per-round record list `run()` produces.  No copies of
+        `initial_placement` are needed: the cycle program does not donate
+        rb/il/dk, unlike the standalone fused run."""
+        R = self.shape.R
+        n = int(len(rows))
+        width = max(64, 1 << (max(n, 1) - 1).bit_length())
+        pad = width - n
+        rows = np.concatenate(
+            [np.asarray(rows, np.int32), np.full(pad, R, np.int32)]
+        )
+        pad_z = np.zeros((pad, NUM_RESOURCES), np.float32)
+        new_ll = np.concatenate([np.asarray(new_ll, np.float32), pad_z])
+        new_fl = np.concatenate([np.asarray(new_fl, np.float32), pad_z])
+        rb, il, dk = initial_placement
+        sxc = self._cycle_statics()
+        t_start = time.monotonic()
+        count_dispatch("engine.cycle")
+        out_ll, out_fl, payload = self._jit_run_cycle(
+            sxc, ll, fl,
+            jnp.asarray(rows), jnp.asarray(new_ll), jnp.asarray(new_fl),
+            jnp.asarray(rb, jnp.int32), jnp.asarray(il, bool),
+            jnp.asarray(dk, jnp.int32),
+        )
+        t_disp = time.monotonic()
+        # the cycle's ONE blocking sync: reports + placement + per-round ys
+        count_dispatch("engine.extract")
+        host = jax.device_get(payload)
+        t_sync = time.monotonic()
+        history = self._fused_history(host["ys"], verbose=False)
+        timing = dict(
+            timing=True, fused=True, fused_cycle=True, blocking_syncs=1,
+            scatter_width=width,
+            host_dispatch_s=round(t_disp - t_start, 6),
+            device_s=round(t_sync - t_disp, 6),
+        )
+        conv = self._convergence_summary(host["ys"])
+        if conv is not None:
+            timing["convergence"] = conv
+        history.append(timing)
+        return out_ll, out_fl, host, history
 
     def _run_legacy(self, *, verbose: bool = False, initial_placement=None):
         """Legacy Python round loop: one scan dispatch + one blocking sync
@@ -3046,6 +3282,7 @@ class Engine:
         def fetch(x):
             """device_get with the blocking wait metered (timing record)."""
             t0 = time.monotonic()
+            count_dispatch("engine.sync")
             v = jax.device_get(x)
             sync["n"] += 1
             sync["s"] += time.monotonic() - t0

@@ -71,14 +71,33 @@ class GoalChain:
         state: ClusterState,
         agg: BrokerAggregates | None = None,
         constraint: BalancingConstraint = DEFAULT_CONSTRAINT,
+        *,
+        score_dtype: str = "float32",
     ):
-        """Full evaluation: (scalar objective, violations[G], scores[G])."""
+        """Full evaluation: (scalar objective, violations[G], scores[G]).
+
+        `score_dtype` (config analyzer.precision.score.dtype) selects the
+        accumulation precision of the weighted objective sum ONLY: the
+        per-goal violations/scores stay f32 (they feed early-stop compares
+        and user reports), and the mixed-precision branch is taken only
+        for a non-default dtype, so the default traced graph is
+        byte-identical to the always-f32 one — the fp32 fallback pin.
+        """
         if agg is None:
             agg = compute_aggregates(state)
         violations = jnp.stack([g.violation(state, agg, constraint) for g in self.goals])
         scores = jnp.stack([g.score(state, agg, constraint) for g in self.goals])
         w = jnp.asarray(self.weights, jnp.float32)
-        obj = (w * violations).sum() + TIE_WEIGHT * min(self.weights) * scores.sum()
+        if score_dtype != "float32":
+            dt = jnp.dtype(score_dtype)
+            obj = (
+                (w.astype(dt) * violations.astype(dt)).sum().astype(jnp.float32)
+                + TIE_WEIGHT
+                * min(self.weights)
+                * scores.astype(dt).sum().astype(jnp.float32)
+            )
+        else:
+            obj = (w * violations).sum() + TIE_WEIGHT * min(self.weights) * scores.sum()
         return obj, violations, scores
 
     def hard_mask(self) -> np.ndarray:

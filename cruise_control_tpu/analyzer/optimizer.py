@@ -25,6 +25,7 @@ from cruise_control_tpu.common.blackbox import (
     RECORDER as _BLACKBOX,
     blackbox_context,
 )
+from cruise_control_tpu.common.dispatch import count_dispatch
 from cruise_control_tpu.analyzer.proposals import (
     ExecutionProposal,
     ProposalSet,
@@ -719,6 +720,162 @@ class GoalOptimizer:
                 reason=e.failure_class.value, cause=e,
             )
 
+    def optimize_streaming_cycle(
+        self,
+        state: ClusterState,
+        *,
+        rows,
+        leader_loads,
+        follower_loads,
+        initial_placement,
+        options: OptimizationOptions = DEFAULT_OPTIONS,
+        config: OptimizerConfig | None = None,
+        prior=None,
+        before_host: dict | None = None,
+    ):
+        """The steady-state streaming cycle as ONE device dispatch + ONE
+        host extraction (Engine.run_cycle): delta scatter, warm re-anneal,
+        before/after reports, device validation, and the proposal payload
+        all ride a single donated jitted program.
+
+        Returns `(OptimizerResult, (new_ll, new_fl))` — the donated-and-
+        rescattered live load arrays the caller MUST adopt as the new
+        live state (`state`'s own load leaves are dead after this call) —
+        or None when the fast path is unavailable: non-single parallel
+        mode, supervisor breaker open, or no cached engine for
+        (state.shape, config).  On None the caller falls back to the
+        staged scatter+optimize path, which builds and caches the engine
+        so the NEXT cycle goes fused.
+
+        `before_host` is the controller's reflatten-time placement cache
+        (fetch_before_host of the flattened state): placement columns are
+        delta-invariant between reflattens, so only `replica_disk_bytes`
+        — which the scatter just changed — is refreshed, from the cycle
+        payload, with zero extra device traffic.
+
+        The engine call is NOT supervisor-wrapped: supervision exists to
+        classify compile hangs and device faults into a degraded answer,
+        but the cycle requires an already-cached engine whose programs
+        the staged path (which IS supervised) compiled; a post-donation
+        failure here must propagate anyway — the live load buffers were
+        consumed, so only a reflatten can recover, and the controller's
+        loop-failure accounting owns that."""
+        cfg = config or self.config
+        if self.parallel_mode != "single":
+            return None
+        sup = self.supervisor
+        if sup is not None and not sup.available():
+            return None
+        engine = self._cache_get(self._engines, (state.shape, cfg))
+        if engine is None:
+            return None
+        from cruise_control_tpu.models.state import DEVICE_CHECKS
+
+        t0 = time.monotonic()
+        with self.tracer.span("analyzer.optimize", component="analyzer") as sp:
+            try:
+                # data-only statics refresh: the prior's CDF/mix are the
+                # only statics fields a delta cycle changes (placement
+                # metadata is reflatten-invariant; loads are scattered
+                # in-graph)
+                engine.rebind_prior(prior)
+                out_ll, out_fl, host, history = engine.run_cycle(
+                    state.replica_load_leader,
+                    state.replica_load_follower,
+                    rows, leader_loads, follower_loads,
+                    initial_placement,
+                )
+            finally:
+                self._unpin(engine)
+            self._record(True)
+            checks = np.asarray(host["checks"])
+            if checks.any():
+                bad = [n for n, c in zip(DEVICE_CHECKS, checks) if c]
+                raise ValueError(f"optimized state failed sanity checks: {bad}")
+            # the effective BEFORE state: the live state with the freshly
+            # scattered loads (what the staged path's scatter would have
+            # produced); AFTER adds the payload's host placement arrays
+            state_before = dataclasses.replace(
+                state,
+                replica_load_leader=out_ll,
+                replica_load_follower=out_fl,
+            )
+            state_after = dataclasses.replace(
+                state_before,
+                replica_broker=host["replica_broker"],
+                replica_is_leader=host["replica_is_leader"],
+                replica_disk=host["replica_disk"],
+                replica_offline=host["replica_offline"],
+            )
+            t_extract = time.monotonic()
+            if before_host is not None:
+                before_host = dict(
+                    before_host, replica_disk_bytes=host["replica_disk_bytes"]
+                )
+            else:
+                from cruise_control_tpu.analyzer.proposals import fetch_before_host
+
+                before_host = fetch_before_host(state_before)
+            proposals = extract_proposals(
+                state_before, state_after, before_host=before_host
+            )
+            timing = next((h for h in history if h.get("timing")), None)
+            if timing is None:
+                timing = dict(timing=True)
+                history.append(timing)
+            timing["host_extract_s"] = round(time.monotonic() - t_extract, 6)
+            timing["engine_cache_hit"] = True
+            timing["engine_build_s"] = 0.0
+            s = state.shape
+            timing["bucket"] = dict(R=s.R, B=s.B, P=s.P, T=s.num_topics)
+            viol_b = np.asarray(host["viol_before"])
+            viol_a = np.asarray(host["viol_after"])
+            wall = time.monotonic() - t0
+            result = OptimizerResult(
+                proposals=proposals,
+                state_before=state_before,
+                state_after=state_after,
+                stats_before=host["stats_before"],
+                stats_after=host["stats_after"],
+                goal_names=self.chain.names(),
+                violations_before=viol_b,
+                violations_after=viol_a,
+                balancedness_before=balancedness_score(
+                    viol_b,
+                    self.chain,
+                    priority_weight=self.balancedness_weights[0],
+                    strictness_weight=self.balancedness_weights[1],
+                ),
+                balancedness_after=balancedness_score(
+                    viol_a,
+                    self.chain,
+                    priority_weight=self.balancedness_weights[0],
+                    strictness_weight=self.balancedness_weights[1],
+                ),
+                objective_before=float(host["obj_before"]),
+                objective_after=float(host["obj_after"]),
+                wall_seconds=wall,
+                history=history,
+            )
+            sp.set(
+                parallel_mode=self.parallel_mode,
+                fused_cycle=True,
+                degraded=False,
+                wall_s=round(wall, 6),
+                num_proposals=len(result.proposals),
+                objective_after=round(result.objective_after, 6),
+                balancedness_after=round(result.balancedness_after, 3),
+                **{
+                    k: timing.get(k)
+                    for k in (
+                        "device_s", "blocking_syncs", "host_extract_s",
+                        "scatter_width", "bucket", "convergence",
+                    )
+                    if timing.get(k) is not None
+                },
+            )
+            return result, (out_ll, out_fl)
+
     # ------------------------------------------------------------------
     # per-bucket compile-time attribution (device profiling surface)
     # ------------------------------------------------------------------
@@ -802,6 +959,7 @@ class GoalOptimizer:
         # transfers a [5] count vector instead of the model's bulk arrays
         # (the tunneled-TPU transfer costs more than the checks); the host
         # validator re-runs for the detailed message only on failure
+        count_dispatch("analyzer.validate")
         input_checks = np.asarray(validate_on_device(state))
         if input_checks.any():
             validate(state)  # raises with per-invariant detail
@@ -847,6 +1005,7 @@ class GoalOptimizer:
                 )
             ):
                 engine.precompile_async()
+            count_dispatch("analyzer.report")
             (obj_b, viol_b), stats_b = self._report(state)
             # the proposal diff needs bulk BEFORE-state arrays on host;
             # pull them on a side thread while the device anneals — input
@@ -876,7 +1035,9 @@ class GoalOptimizer:
                 self._unpin(engine)
         # dispatch the result report + the on-device sanity check, then do
         # the host-side proposal diff while the device drains them
+        count_dispatch("analyzer.report")
         (obj_a, viol_a), stats_a = self._report(final)
+        count_dispatch("analyzer.validate")
         final_checks = validate_on_device(final)
         t_extract = time.monotonic()
         proposals = extract_proposals(state, final, before_host=before_host)

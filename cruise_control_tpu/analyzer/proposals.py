@@ -67,6 +67,9 @@ def fetch_before_host(state: ClusterState) -> dict:
     leader loads crosses (the full matrix would quadruple the payload)."""
     import jax
 
+    from cruise_control_tpu.common.dispatch import count_dispatch
+
+    count_dispatch("proposals.fetch")
     vals = jax.device_get(
         tuple(getattr(state, k) for k in BEFORE_HOST_KEYS)
         + (state.replica_load_leader[:, int(Resource.DISK)],)
@@ -262,7 +265,13 @@ def extract_proposals(
     disk_bytes = before_host["replica_disk_bytes"]
     part_arr = before_host["replica_partition"]
     pos_arr = before_host["replica_pos"]
-    # only the AFTER placement still lives on device
+    # only the AFTER placement still lives on device — when the fused
+    # cycle already delivered it as host arrays, device_get is a no-op
+    # and no dispatch is charged
+    if isinstance(after.replica_broker, jax.Array):
+        from cruise_control_tpu.common.dispatch import count_dispatch
+
+        count_dispatch("proposals.extract")
     b_new, l_new, d_new = jax.device_get((
         after.replica_broker, after.replica_is_leader, after.replica_disk,
     ))

@@ -1,0 +1,59 @@
+"""Device-dispatch accounting for the streaming control loop.
+
+The fused steady-state cycle's contract (ROADMAP item 4) is O(1)
+host<->device per window roll: ONE program dispatch plus ONE blocking
+host extraction.  That contract is proved by counting, not asserted by
+reading the code: every choke point that launches a device program or
+forces a device->host sync on the controller's cycle path calls
+`count_dispatch(tag)`, and `bench.py --streaming --smoke` wraps each
+steady-state `run_once()` in a `dispatch_meter()` and gates on
+`meter.total <= 2`.
+
+The meter is a contextvar STACK, not a single slot: the controller keeps
+its own per-cycle meter (the `controller.cycle-dispatches` gauge) while
+the bench wraps it in an outer one — every active meter sees every
+count.  No meter active costs one contextvar read per choke point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_METERS: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "cc-dispatch-meters", default=()
+)
+
+
+class DispatchMeter:
+    """Per-tag dispatch counts observed while this meter was active."""
+
+    def __init__(self):
+        self.counts: dict[str, int] = {}
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def count(self, tag: str, n: int = 1) -> None:
+        self.counts[tag] = self.counts.get(tag, 0) + n
+
+    def __repr__(self) -> str:
+        return f"DispatchMeter(total={self.total}, counts={self.counts})"
+
+
+def count_dispatch(tag: str, n: int = 1) -> None:
+    """Record `n` device dispatches/syncs against every active meter."""
+    for m in _METERS.get():
+        m.count(tag, n)
+
+
+@contextlib.contextmanager
+def dispatch_meter():
+    """Activate a DispatchMeter for the enclosed block (nestable)."""
+    m = DispatchMeter()
+    token = _METERS.set(_METERS.get() + (m,))
+    try:
+        yield m
+    finally:
+        _METERS.reset(token)

@@ -84,6 +84,25 @@ def _analyzer_defs() -> ConfigDef:
              ",".join(DEFAULT_INTRA_BROKER_GOAL_ORDER), I.MEDIUM,
              "goal chain for rebalance_disk (JBOD) operations "
              "(reference AnalyzerConfig.java:236)", group=g)
+    # --- mixed-precision goal scoring (new in this framework) ---
+    def _valid_score_dtype(name, value):
+        if str(value) not in ("float32", "bfloat16"):
+            raise ConfigException(
+                f"{name} must be 'float32' or 'bfloat16', got {value!r}"
+            )
+
+    d.define("analyzer.precision.score.dtype", T.STRING, "float32", I.MEDIUM,
+             "accumulation dtype of the goal-score inner loops (per-broker "
+             "term sums and the weighted objective reduction); 'bfloat16' "
+             "halves accumulator bandwidth on the annealer's hot path, "
+             "'float32' (default) pins today's graphs bit-for-bit — "
+             "reports, violations and proposal scoring stay float32 "
+             "either way", _valid_score_dtype, group=g)
+    d.define("analyzer.precision.tolerance", T.DOUBLE, 0.02, I.LOW,
+             "relative objective-quality tolerance the bfloat16 scoring "
+             "path must hold against the float32 reference (the parity "
+             "gate tests/benches assert before the low-precision path is "
+             "trusted)", in_range(lo=0.0), group=g)
     # --- TPU optimizer knobs (new in this framework) ---
     g = "analyzer.tpu"
     d.define("tpu.num.candidates", T.INT, 2048, I.MEDIUM,
@@ -325,6 +344,27 @@ def _controller_defs() -> ConfigDef:
              "place; off forces a full model re-flatten every window roll "
              "(the parity/diagnosis mode the streaming bench gates "
              "against)", group=g)
+    d.define("controller.fusion.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "fuse delta-scatter + warm re-anneal + proposal extraction "
+             "into ONE donated device program per steady-state window "
+             "roll (one dispatch, one host extraction); off pins the "
+             "staged scatter-then-anneal path bit-for-bit — the fusion "
+             "parity/diagnosis mode", group=g)
+    d.define("controller.plan.sizing.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "size each steady-state cycle's candidate plan from the "
+             "delta's changed-partition count (quantized to 1/2, 1/4 or "
+             "1/8 of the configured width — bounded compile count, "
+             "brownout-style); reflatten cycles always run full-K; off "
+             "pins full-K every cycle", group=g)
+    d.define("controller.plan.candidates.per.partition", T.INT, 16, I.LOW,
+             "candidate-plan width budgeted per changed partition when "
+             "delta-sized plans are on; the needed width is "
+             "max(plan.min.candidates, changed x this) before "
+             "quantization", in_range(lo=1), group=g)
+    d.define("controller.plan.min.candidates", T.INT, 256, I.LOW,
+             "floor on the delta-sized candidate need, so tiny deltas "
+             "still explore a meaningful neighborhood",
+             in_range(lo=1), group=g)
     d.define("controller.prior.mix", T.DOUBLE, 0.5, I.MEDIUM,
              "fraction of the annealer's replica-move DESTINATION draws "
              "taken from the learned per-topic-pair move-acceptance "
@@ -571,6 +611,14 @@ def _fleet_defs() -> ConfigDef:
              "(fleet.<id>.fleet.scheduler.freshness.slo.s); the published "
              "proposal age it protects is observable as "
              "analyzer.proposal-age-seconds", in_range(lo=0.1), group=g)
+    d.define("fleet.scheduler.fast.path.enabled", T.BOOLEAN, True, I.LOW,
+             "grant INTERACTIVE work an unsegmented slot when no other "
+             "tenant is waiting at grant time: an idle device gets the "
+             "whole anneal as one dispatch (no between-slice preemption "
+             "checks, no segmentation overhead) — the streaming "
+             "controller's fused sub-second cycles ride this; off "
+             "segments every non-urgent grant as before",
+             group=g)
     d.define("fleet.scheduler.aging.s", T.DOUBLE, 30.0, I.LOW,
              "wait after which a BACKGROUND ticket is ranked with the "
              "INTERACTIVE class (its older deadline then wins the "
@@ -1285,6 +1333,7 @@ class CruiseControlConfig(AbstractConfig):
             leadership_move_cost=g("tpu.leadership.move.cost"),
             importance_fraction=g("tpu.importance.fraction"),
             diagnostics=g("analyzer.diagnostics.enabled"),
+            score_dtype=g("analyzer.precision.score.dtype"),
         )
 
     def compile_cache_dir(self) -> str | None:

@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
+from cruise_control_tpu.common.resources import NUM_RESOURCES
 from cruise_control_tpu.controller.prior import MoveAcceptancePrior
 from cruise_control_tpu.models.whatif import LiveState
 from cruise_control_tpu.monitor import ModelCompletenessRequirements
@@ -105,6 +106,19 @@ class StreamingController:
         self.poll_interval_s = cfg.get("controller.poll.interval.ms") / 1000.0
         self.warm_start = cfg.get("controller.warm.start.enabled")
         self.delta_enabled = cfg.get("controller.delta.enabled")
+        #: fuse delta-scatter + re-anneal + extraction into ONE device
+        #: program on steady-state cycles (controller.fusion.enabled);
+        #: requires warm starts (the fused program seeds from the prior
+        #: placement) and a single-device engine
+        self.fusion_enabled = cfg.get("controller.fusion.enabled")
+        #: size the candidate plan from the delta's changed-entity count
+        #: (controller.plan.*): quantized width steps so compile count
+        #: stays bounded, full-K on reflatten
+        self.plan_sizing = cfg.get("controller.plan.sizing.enabled")
+        self.plan_cands_per_partition = cfg.get(
+            "controller.plan.candidates.per.partition"
+        )
+        self.plan_min_candidates = cfg.get("controller.plan.min.candidates")
         self.prior = MoveAcceptancePrior(
             mix=cfg.get("controller.prior.mix"),
             decay=cfg.get("controller.prior.decay"),
@@ -125,6 +139,7 @@ class StreamingController:
                 )
             self.warm_start = False
             self.prior.mix = 0.0
+            self.fusion_enabled = False
         #: prior sampling is compiled in only when a non-zero mix could
         #: ever apply — mix 0 keeps the engine program (and its cache key)
         #: byte-identical to the request path's
@@ -154,6 +169,11 @@ class StreamingController:
         self._live: LiveState | None = None
         self._index: _ModelIndex | None = None
         self._warm = None  # (shape, replica_broker, replica_is_leader, replica_disk)
+        #: fetch_before_host of the reflattened state — placement columns
+        #: are delta-invariant between reflattens, so the fused cycle
+        #: reuses this dict (only replica_disk_bytes refreshes, from the
+        #: cycle payload) instead of re-fetching bulk arrays every window
+        self._before_host: dict | None = None
         self._last_window: int | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -163,9 +183,16 @@ class StreamingController:
         # as monotonic series; these are the structured view)
         self._stats = dict(
             windowRolls=0, deltaApplies=0, fullReflattens=0,
+            # per-reason breakout of fullReflattens (initial / topology /
+            # delta-disabled / entities) so a p99 regression attributes
+            # to a cause; the aggregate stays for compatibility
+            fullReflattensByReason={},
             incrementalAnneals=0, warmStarts=0, proposalsPublished=0,
+            fusedCycles=0, planSizedCycles=0,
             lastRounds=None, lastObjective=None, lastWallSeconds=None,
             lastWindowIndex=None, lastPublishMs=None, lastError=None,
+            lastCycleDispatches=None, coldCycleSeconds=None,
+            fusedColdCycleSeconds=None,
             loopFailures=0, cyclesShed=0, brownoutCycles=0,
         )
 
@@ -251,32 +278,69 @@ class StreamingController:
             "controller.window-roll", component="controller",
             window_index=int(cur_w),
         ) as sp:
-            if _BLACKBOX.enabled:
-                # the cycle is a dispatch-bearing unit of work: its
-                # begin/end (and any hang between them) belongs in the
-                # durable spool beside the engine records it triggers
-                with _BLACKBOX.record(
-                    "controller-cycle", window=int(cur_w),
-                    cluster=self.cc.cluster_id or "",
-                ):
+            from cruise_control_tpu.common.dispatch import dispatch_meter
+
+            # per-cycle device-dispatch accounting: the fused steady-state
+            # contract is <= 2 (one program dispatch + one host
+            # extraction), proved by counting at the choke points — the
+            # streaming bench's smoke gate reads this same meter
+            with dispatch_meter() as meter:
+                if _BLACKBOX.enabled:
+                    # the cycle is a dispatch-bearing unit of work: its
+                    # begin/end (and any hang between them) belongs in the
+                    # durable spool beside the engine records it triggers
+                    with _BLACKBOX.record(
+                        "controller-cycle", window=int(cur_w),
+                        cluster=self.cc.cluster_id or "",
+                    ):
+                        info = self._cycle(history, sp)
+                else:
                     info = self._cycle(history, sp)
-            else:
-                info = self._cycle(history, sp)
             wall = time.monotonic() - t0
+            self._stats["lastCycleDispatches"] = meter.total
+            self.sensors.gauge("controller.cycle-dispatches").set(meter.total)
+            info["dispatches"] = dict(meter.counts)
             if info.get("published"):
-                # the HEADLINE latency: metric-window roll observed ->
-                # superseding proposal published, with the cycle's trace
-                # id as the OpenMetrics exemplar so a p99 outlier on a
-                # dashboard links straight to its /trace replay
-                self.sensors.histogram(
-                    "controller.window-roll-to-publish-seconds",
-                    buckets=STREAMING_BUCKETS,
-                ).observe(
-                    wall,
-                    exemplar=(
-                        {"trace_id": sp.trace_id} if sp.trace_id else None
-                    ),
+                first_fused = bool(
+                    info.get("fused") and self._stats["fusedCycles"] == 1
                 )
+                if self._stats["proposalsPublished"] > 1 and not first_fused:
+                    # the HEADLINE latency: metric-window roll observed ->
+                    # superseding proposal published, with the cycle's
+                    # trace id as the OpenMetrics exemplar so a p99
+                    # outlier on a dashboard links straight to its /trace
+                    # replay.  The FIRST published cycle is excluded — it
+                    # pays the cold XLA compile, and one restart sample
+                    # would dominate a steady-state p99 (same exclusion
+                    # the calibration sampler and streaming-publish SLO
+                    # apply); it reports through the one-shot cold-compile
+                    # sensor below instead.
+                    self.sensors.histogram(
+                        "controller.window-roll-to-publish-seconds",
+                        buckets=STREAMING_BUCKETS,
+                    ).observe(
+                        wall,
+                        exemplar=(
+                            {"trace_id": sp.trace_id} if sp.trace_id else None
+                        ),
+                    )
+                elif first_fused:
+                    # the first FUSED cycle compiles the fused cycle
+                    # program — its wall is a compile artifact too, so it
+                    # reports through its own one-shot sensor instead of
+                    # skewing the steady-state p99
+                    self._stats["fusedColdCycleSeconds"] = round(wall, 6)
+                    self.sensors.gauge(
+                        "controller.fused-cold-compile-cycle-seconds"
+                    ).set(wall)
+                elif self._stats["coldCycleSeconds"] is None:
+                    # one-shot cold-compile sensor: the first published
+                    # cycle's wall (trace + XLA compile + anneal), kept
+                    # out of the steady-state histogram but never hidden
+                    self._stats["coldCycleSeconds"] = round(wall, 6)
+                    self.sensors.gauge(
+                        "controller.cold-compile-cycle-seconds"
+                    ).set(wall)
                 reg = getattr(self.cc, "slo_registry", None)
                 # the FIRST cycle pays the cold XLA compile and will blow
                 # any sub-second target — that wall is the cold-start
@@ -296,6 +360,7 @@ class StreamingController:
 
     def _cycle(self, history, sp) -> dict:
         info: dict = dict(reflattened=False, delta_partitions=0)
+        delta_rows = None
         topo_gen = self.monitor.metadata.topology().generation
         idx = self._index
         if (
@@ -334,18 +399,21 @@ class StreamingController:
                 info["reflattened"] = True
                 info["reflatten_reason"] = "entities"
             else:
-                t_sc = time.monotonic()
-                info["delta_partitions"] = self._apply_delta(delta)
-                self._stage_observe(
-                    "controller.scatter-seconds", time.monotonic() - t_sc, sp
-                )
+                delta_rows = self._delta_rows(delta)
+                info["delta_partitions"] = delta_rows[3]
+                self._stats["deltaApplies"] += 1
+                self.sensors.counter("controller.delta-applies").inc()
+                if delta_rows[3]:
+                    self.sensors.counter("controller.delta-partitions").inc(
+                        delta_rows[3]
+                    )
                 idx.history = history
                 idx.reduced = delta.reduced
         sp.set(
             reflattened=info["reflattened"],
             delta_partitions=info["delta_partitions"],
         )
-        info.update(self._anneal(sp))
+        info.update(self._anneal(sp, delta=delta_rows))
         return info
 
     def _stage_observe(self, name: str, wall_s: float, sp) -> None:
@@ -411,19 +479,31 @@ class StreamingController:
             # membership may have changed under the old placement — a
             # stale warm start could double-place a partition
             self._warm = None
+        # the fused cycle's BEFORE-placement host cache: placement columns
+        # are delta-invariant until the next reflatten, so one fetch here
+        # (off the steady-state path) serves every fused extraction
+        if self.fusion_enabled:
+            from cruise_control_tpu.analyzer.proposals import fetch_before_host
+
+            self._before_host = fetch_before_host(state)
+        else:
+            self._before_host = None
         self._stats["fullReflattens"] += 1
+        by = self._stats["fullReflattensByReason"]
+        by[reason] = by.get(reason, 0) + 1
         self.sensors.counter("controller.full-reflattens").inc()
         self.sensors.counter(f"controller.reflatten.{reason}").inc()
 
-    def _apply_delta(self, delta) -> int:
-        """Scatter one window's changed partition loads into the live
-        arrays; returns how many partitions were touched."""
+    def _delta_rows(self, delta):
+        """One window delta as a replica-row scatter triple
+        `(rows, ll_rows, fl_rows, n_partitions)` — shared by the staged
+        path (LiveState.set_partition_loads) and the fused cycle (the
+        same scatter, in-graph); `(None, None, None, 0)` when no mapped
+        partition changed."""
         idx = self._index
         changed = delta.changed
         if not changed.any():
-            self._stats["deltaApplies"] += 1
-            self.sensors.counter("controller.delta-applies").inc()
-            return 0
+            return None, None, None, 0
         ents = [e for e, c in zip(delta.entities, changed) if c]
         ll = delta.loads[changed]
         pids = []
@@ -434,9 +514,7 @@ class StreamingController:
                 pids.append(pid)
                 keep.append(i)
         if not pids:
-            self._stats["deltaApplies"] += 1
-            self.sensors.counter("controller.delta-applies").inc()
-            return 0
+            return None, None, None, 0
         ll = ll[keep]
         fl = self.monitor.follower_loads(ll)
         rows_p = idx.part_rows[np.asarray(pids)]  # [n, max_rf], R pads
@@ -446,15 +524,51 @@ class StreamingController:
         rows = rows_p[valid].astype(np.int32)
         ll_rows = np.repeat(ll, counts, axis=0)
         fl_rows = np.repeat(fl, counts, axis=0)
-        self._live.set_partition_loads(rows, ll_rows, fl_rows)
-        self._stats["deltaApplies"] += 1
-        self.sensors.counter("controller.delta-applies").inc()
-        self.sensors.counter("controller.delta-partitions").inc(len(pids))
-        return len(pids)
+        return rows, ll_rows, fl_rows, len(pids)
 
     # -------------------------------------------------------------- anneal
 
-    def _anneal(self, sp) -> dict:
+    def _plan_config(self, cfg, delta_partitions: int):
+        """Delta-sized candidate plan: a 50-partition window roll must not
+        pay the full-K sampling plan.  The width needed is
+        max(plan.min.candidates, delta_partitions x candidates-per-
+        partition), quantized to one of THREE fixed fractions of full K
+        (1/2, 1/4, 1/8) so each base config yields at most three extra
+        engine-cache keys (brownout_config's bounded-compile idiom) —
+        never an exact per-delta width, which would compile per cycle.
+        Full K whenever the need reaches K/2 (and always on reflatten,
+        where there is no delta)."""
+        K = cfg.num_candidates
+        needed = max(
+            int(self.plan_min_candidates),
+            int(delta_partitions) * int(self.plan_cands_per_partition),
+        )
+        if needed * 2 > K:
+            return cfg
+        f = 0.5
+        while f > 0.125 and K * (f / 2) >= needed:
+            f /= 2
+        return dataclasses.replace(
+            cfg,
+            num_candidates=max(64, int(K * f)),
+            leadership_candidates=max(8, int(cfg.leadership_candidates * f)),
+            swap_candidates=max(0, int(cfg.swap_candidates * f)),
+        )
+
+    def _anneal(self, sp, delta=None) -> dict:
+        """One cycle's re-anneal.  `delta` is the window's scatter triple
+        `(rows, ll_rows, fl_rows, n_partitions)` on steady-state cycles
+        (None on reflatten cycles, whose scatter is the flatten itself).
+
+        Steady state prefers the FUSED path: scatter + warm re-anneal +
+        extraction as one donated device program
+        (GoalOptimizer.optimize_streaming_cycle), submitted INTERACTIVE —
+        an operator-facing latency path — and granted unsegmented by the
+        scheduler's fast path when nothing else waits.  The staged path
+        (host scatter, then a supervised BACKGROUND optimize) remains the
+        fallback for: fusion off, no warm placement yet, no cached engine
+        (the staged run builds and caches it, so the NEXT cycle fuses),
+        mesh parallel modes, and supervisor-breaker-open."""
         state = self._live.state
         catalog = self._index.catalog
         warm = None
@@ -474,10 +588,41 @@ class StreamingController:
         # not skipped — under sustained overload
         sched = self.cc.scheduler
         cfg = self._opt_config
+        plan_sized = False
+        if delta is not None and self.plan_sizing:
+            sized = self._plan_config(cfg, delta[3])
+            plan_sized = sized is not cfg
+            cfg = sized
         brownout = False
         if sched is not None and sched.brownout_active:
             cfg = sched.brownout_config(cfg)
             brownout = True
+        # fused eligibility is decided BEFORE submission so the work
+        # class is honest: only a cycle that will actually take the
+        # one-dispatch fast path rides the INTERACTIVE queue.  The
+        # engine-cache check makes the first cycle after a (re)start or a
+        # fresh plan width go staged — which builds and caches the
+        # engine — and every later one fused.
+        fused_ready = (
+            delta is not None
+            and self.fusion_enabled
+            and warm is not None
+            and self.optimizer.parallel_mode == "single"
+            and self.optimizer.has_engine_for(state.shape, config=cfg)
+        )
+        if delta is not None and not fused_ready:
+            # staged scatter, BEFORE submission: a shed cycle must still
+            # leave the live loads current (the window was consumed —
+            # idx.history already advanced)
+            rows, ll_rows, fl_rows, _n = delta
+            t_sc = time.monotonic()
+            if rows is not None:
+                self._live.set_partition_loads(rows, ll_rows, fl_rows)
+            self._stage_observe(
+                "controller.scatter-seconds", time.monotonic() - t_sc, sp
+            )
+            state = self._live.state
+        ran = dict(fused=False)
 
         def _run():
             # the anneal timer lives INSIDE the scheduled body: it must
@@ -486,13 +631,50 @@ class StreamingController:
             # wait separately
             t_an = time.monotonic()
             with self.sensors.timer("controller.anneal-timer").time():
-                r = self.optimizer.optimize(
-                    state,
-                    options=options,
-                    config=cfg,
-                    initial_placement=warm,
-                    prior=prior_table,
-                )
+                r = None
+                if fused_ready:
+                    rows, ll_rows, fl_rows, _n = delta
+                    if rows is None:
+                        # nothing changed this window: an empty scatter
+                        # (all-sentinel rows) still re-anneals fused
+                        rows = np.zeros(0, np.int32)
+                        ll_rows = fl_rows = np.zeros(
+                            (0, NUM_RESOURCES), np.float32
+                        )
+                    out = self.optimizer.optimize_streaming_cycle(
+                        state,
+                        rows=rows,
+                        leader_loads=ll_rows,
+                        follower_loads=fl_rows,
+                        initial_placement=warm,
+                        options=options,
+                        config=cfg,
+                        prior=prior_table,
+                        before_host=self._before_host,
+                    )
+                    if out is not None:
+                        r, (new_ll, new_fl) = out
+                        # ownership hand-back: the cycle donated the live
+                        # load buffers and returned the scattered pair
+                        self._live.adopt_loads(new_ll, new_fl)
+                        ran["fused"] = True
+                if r is None:
+                    if fused_ready:
+                        # lost the engine-cache race between the check
+                        # and the call: the in-graph scatter never ran,
+                        # so scatter staged before annealing
+                        rows, ll_rows, fl_rows, _n = delta
+                        if rows is not None:
+                            self._live.set_partition_loads(
+                                rows, ll_rows, fl_rows
+                            )
+                    r = self.optimizer.optimize(
+                        self._live.state,
+                        options=options,
+                        config=cfg,
+                        initial_placement=warm,
+                        prior=prior_table,
+                    )
             self._stage_observe(
                 "controller.anneal-seconds", time.monotonic() - t_an, sp
             )
@@ -508,7 +690,9 @@ class StreamingController:
 
             try:
                 result = sched.run(
-                    WorkClass.BACKGROUND, _run,
+                    WorkClass.INTERACTIVE if fused_ready
+                    else WorkClass.BACKGROUND,
+                    _run,
                     cluster_id=self.cc.cluster_id or "",
                     op="controller-cycle",
                     freshness_slo_s=self.cc._freshness_slo_s,
@@ -521,6 +705,12 @@ class StreamingController:
                             published=False)
         if brownout:
             self._stats["brownoutCycles"] += 1
+        if ran["fused"]:
+            self._stats["fusedCycles"] += 1
+            self.sensors.counter("controller.fused-cycles").inc()
+        if plan_sized:
+            self._stats["planSizedCycles"] += 1
+            self.sensors.counter("controller.plan-sized-cycles").inc()
         timing = next((h for h in result.history if h.get("timing")), {})
         if timing.get("host_extract_s") is not None:
             # the fused run's one blocking host fetch — the stage the
@@ -570,6 +760,8 @@ class StreamingController:
             prior_mix=(prior_table.mix if prior_table is not None else 0.0),
             published=published,
             objective_after=result.objective_after,
+            fused=ran["fused"],
+            plan_candidates=cfg.num_candidates,
         )
         return dict(
             rounds=rounds,
@@ -577,6 +769,7 @@ class StreamingController:
             objective=result.objective_after,
             prior_observed=observed,
             published=published,
+            fused=ran["fused"],
             result=result,
         )
 

@@ -186,6 +186,7 @@ class DeviceScheduler:
         shed_queue_depth: int = 8,
         brownout_after_s: float = 20.0,
         brownout_factor: float = 0.5,
+        fast_path_enabled: bool = True,
         sensors=None,
         clock=time.monotonic,
         anomaly_sink=None,
@@ -206,6 +207,10 @@ class DeviceScheduler:
         self.shed_queue_depth = shed_queue_depth
         self.brownout_after_s = brownout_after_s
         self.brownout_factor = brownout_factor
+        #: grant INTERACTIVE dispatches unsegmented when no other tenant
+        #: is waiting (config fleet.scheduler.fast.path.enabled) — the
+        #: streaming re-anneal's p99 path
+        self.fast_path_enabled = fast_path_enabled
         self.sensors = sensors
         self.clock = clock
         #: anomaly callable (detector.AnomalyDetector.add_anomaly) the
@@ -236,6 +241,7 @@ class DeviceScheduler:
             preemptions=0,
             overload_episodes=0,
             brownout_cycles=0,
+            fast_path_grants=0,
             dispatches={c.label: 0 for c in WorkClass},
         )
         if sensors is not None:
@@ -493,6 +499,10 @@ class DeviceScheduler:
             self.stats["dispatches"][work_class.label] += 1
             if missed:
                 self.stats["deadline_misses"][work_class.label] += 1
+            # fast-path eligibility is decided UNDER the lock: granted
+            # with nobody else queued means segmentation would buy no
+            # responsiveness — there is no one to preempt for
+            alone = not self._waiting
         cls = work_class.label
         if self.sensors is not None:
             self.sensors.timer(f"fleet.scheduler.wait-timer.{cls}").update(wait)
@@ -515,6 +525,24 @@ class DeviceScheduler:
             )
         if preemptible is None:
             preemptible = work_class is not WorkClass.URGENT
+            if (
+                preemptible
+                and self.fast_path_enabled
+                and work_class is WorkClass.INTERACTIVE
+                and alone
+            ):
+                # fast-path grant: an INTERACTIVE dispatch granted with an
+                # empty queue runs UNSEGMENTED — segmented mode's per-slice
+                # blocking syncs exist to bound URGENT wait, and with no
+                # other tenant waiting they only cut into the streaming
+                # re-anneal's p99.  Callers that pass an explicit
+                # `preemptible` keep exactly what they asked for.
+                preemptible = False
+                self.stats["fast_path_grants"] += 1
+                if self.sensors is not None:
+                    self.sensors.counter(
+                        "fleet.scheduler.fast-path-grants"
+                    ).inc()
         token = _HELD.set(ticket)
         try:
             with blackbox_context(
@@ -658,6 +686,7 @@ class DeviceScheduler:
                 "preemptions": self.stats["preemptions"],
                 "overloadEpisodes": self.stats["overload_episodes"],
                 "brownoutCycles": self.stats["brownout_cycles"],
+                "fastPathGrants": self.stats["fast_path_grants"],
             }
         if self.sensors is not None:
             out["waitSeconds"] = {

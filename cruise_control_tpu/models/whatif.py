@@ -345,6 +345,9 @@ class LiveState:
              np.zeros((pad, NUM_RESOURCES), np.float32)]
         )
         st = self.state
+        from cruise_control_tpu.common.dispatch import count_dispatch
+
+        count_dispatch("livestate.scatter")
         new_ll, new_fl = _scatter_partition_loads(
             st.replica_load_leader, st.replica_load_follower,
             jnp.asarray(rows), jnp.asarray(ll), jnp.asarray(fl),
@@ -356,6 +359,17 @@ class LiveState:
         )
         return width
 
+    def adopt_loads(self, ll, fl) -> None:
+        """Adopt already-scattered load arrays as the live ones — the fused
+        streaming cycle's hand-back: the cycle program DONATED the previous
+        live arrays and returned the rescattered pair, so ownership simply
+        transfers (no device work, no copies)."""
+        import dataclasses as _dc
+
+        self.state = _dc.replace(
+            self.state, replica_load_leader=ll, replica_load_follower=fl
+        )
+
     def set_broker_liveness(self, alive: np.ndarray) -> None:
         """Replace the broker_alive vector in place and re-derive
         replica_offline from it (a broker death/revival between windows is
@@ -366,6 +380,9 @@ class LiveState:
 
         st = self.state
         alive = jnp.asarray(alive, bool)
+        from cruise_control_tpu.common.dispatch import count_dispatch
+
+        count_dispatch("livestate.liveness")
         off = _with_broker_alive(
             st.replica_broker, st.replica_disk, st.replica_offline,
             st.replica_valid, st.disk_alive, alive,

@@ -152,6 +152,9 @@ class AnalyzerCore:
                 brownout_factor=config.get(
                     "fleet.scheduler.brownout.candidate.factor"
                 ),
+                fast_path_enabled=config.get(
+                    "fleet.scheduler.fast.path.enabled"
+                ),
                 sensors=self.sensors,
             )
         #: black-box dispatch spool (common/blackbox.py, config

@@ -60,8 +60,14 @@ echo "== check.sh: bench.py --streaming --smoke (incremental controller replay, 
 # named gate: a multi-window streaming replay must show (a) the COLD
 # controller cycle reproduces today's flatten-and-anneal byte-for-byte,
 # (b) warm-started incremental anneals converge in measurably fewer
-# rounds at equal goal quality, and (c) zero full re-flattens across
-# metric-only windows (the in-place delta contract, asserted via sensors)
+# rounds at equal goal quality, (c) zero full re-flattens across
+# metric-only windows (the in-place delta contract, asserted via
+# sensors), and (d) the fused-cycle latency/dispatch contract: every
+# steady-state delta cycle after the fused program compiles runs FUSED
+# at <= 2 device dispatches (one program launch + one host extraction,
+# proved by the dispatch meter) with a sub-second
+# window-roll-to-publish p99 (cold-compile cycles excluded via their
+# one-shot sensors)
 GRAFT_FORCE_CPU=1 python bench.py --streaming --smoke
 streaming_rc=$?
 

@@ -605,3 +605,187 @@ def test_controller_config_keys_parse_and_gate_construction():
     })
     assert cfg2.compile_cache_dir() == "/tmp/a"
     assert CruiseControlConfig({}).compile_cache_dir() is not None  # legacy default
+
+
+# ------------------------------------------------------------ fused cycle
+
+
+def _replay(ctl, fetcher, sampler, windows, drift=1.05):
+    parts = sampler.all_partition_entities()
+    infos = []
+    for w in windows:
+        sampler.drift(drift)
+        fetcher.fetch_once(parts, w * 1000, (w + 1) * 1000 - 1)
+        info = ctl.run_once()
+        assert info is not None
+        infos.append(info)
+    return infos
+
+
+def test_fused_cycle_matches_staged_path_byte_for_byte():
+    """The tentpole parity pin: the fused delta->re-anneal->extract device
+    program must publish BYTE-IDENTICAL placements to the staged
+    scatter-then-anneal path, every window — fusion is an execution
+    detail, never a numerics change.  Also proves the dispatch contract
+    (<= 2 device dispatches per fused steady-state cycle) and that
+    controller.fusion.enabled=false pins the staged path (zero fused
+    cycles)."""
+    runs = {}
+    for fusion in (True, False):
+        app, fetcher, admin, sampler = _controller_service(
+            {"controller.fusion.enabled": fusion}
+        )
+        try:
+            ctl = app.cc.controller
+            infos = _replay(ctl, fetcher, sampler, range(4, 9))
+            runs[fusion] = (
+                [_placements(i["result"].state_after) for i in infos],
+                [i for i in infos],
+                ctl.state_json(),
+            )
+        finally:
+            app.stop()
+    on_p, on_i, on_s = runs[True]
+    off_p, off_i, off_s = runs[False]
+    assert on_s["fusedCycles"] > 0 and off_s["fusedCycles"] == 0
+    assert not on_i[0].get("fused")  # the reflatten cycle never fuses
+    for a, b in zip(on_p, off_p):
+        for x, y in zip(a, b):
+            assert (x == y).all()
+    for info in on_i:
+        if info.get("fused"):
+            # one program dispatch + one host extraction, metered — the
+            # O(1) host<->device steady-state contract
+            assert sum(info["dispatches"].values()) <= 2
+    assert on_s["lastCycleDispatches"] <= 2
+
+
+def test_cold_cycle_histogram_exclusion_and_one_shot_sensors():
+    """The first published cycle (XLA cold compile) and the first fused
+    cycle (fused-program compile) stay OUT of the steady-state
+    window-roll-to-publish histogram; each reports through its own
+    one-shot sensor instead."""
+    app, fetcher, admin, sampler = _controller_service()
+    try:
+        ctl = app.cc.controller
+        n = 5
+        _replay(ctl, fetcher, sampler, range(4, 4 + n))
+        stats = ctl.state_json()
+        assert stats["proposalsPublished"] == n
+        assert stats["coldCycleSeconds"] is not None
+        assert stats["fusedColdCycleSeconds"] is not None
+        hist = app.cc.sensors.get("controller.window-roll-to-publish-seconds")
+        assert hist is not None and hist.count == n - 2
+        assert (
+            app.cc.sensors.gauge("controller.cold-compile-cycle-seconds").value
+            > 0.0
+        )
+        assert (
+            app.cc.sensors.gauge(
+                "controller.fused-cold-compile-cycle-seconds"
+            ).value
+            > 0.0
+        )
+    finally:
+        app.stop()
+
+
+def test_reflatten_reason_counters():
+    """fullReflattens stays the aggregate; fullReflattensByReason breaks
+    it down so a reflatten storm is attributable (topology churn vs
+    delta-disabled vs mid-stream entity churn) — and the reasons always
+    sum to the aggregate."""
+    app, fetcher, admin, sampler = _controller_service(
+        {"controller.delta.enabled": False}
+    )
+    try:
+        ctl = app.cc.controller
+        _replay(ctl, fetcher, sampler, range(4, 7))
+        stats = ctl.state_json()
+        assert stats["fullReflattens"] == 3
+        assert stats["fullReflattensByReason"] == {
+            "initial": 1, "delta-disabled": 2,
+        }
+        assert sum(stats["fullReflattensByReason"].values()) == stats[
+            "fullReflattens"
+        ]
+    finally:
+        app.stop()
+    app, fetcher, admin, sampler = _controller_service()
+    try:
+        ctl = app.cc.controller
+        _replay(ctl, fetcher, sampler, range(4, 7))
+        assert ctl.state_json()["fullReflattensByReason"] == {"initial": 1}
+    finally:
+        app.stop()
+
+
+# ------------------------------------------------------- delta-sized plans
+
+
+def test_plan_config_quantized_ladder():
+    """Delta-sized candidate plans quantize to 1/2, 1/4 or 1/8 of full K
+    (never an exact per-delta width — bounded compile count, at most
+    three extra engine-cache keys per base config), hold the brownout
+    floors, and return the SAME config object at full K so the engine
+    cache key is stable."""
+    app, fetcher, admin, sampler = _controller_service({
+        "tpu.num.candidates": 1024,
+        "controller.plan.min.candidates": 64,
+        "controller.plan.candidates.per.partition": 4,
+    })
+    try:
+        ctl = app.cc.controller
+        cfg = ctl._opt_config
+        tiny = ctl._plan_config(cfg, 4)  # needed=64 -> 1/8
+        assert tiny.num_candidates == 128
+        mid = ctl._plan_config(cfg, 64)  # needed=256 -> 1/4
+        assert mid.num_candidates == 256
+        # quantized: equal deltas map to EQUAL configs (cache-key stable)
+        assert ctl._plan_config(cfg, 4) == tiny
+        # a big delta needs full K: the identical object comes back
+        assert ctl._plan_config(cfg, 600) is cfg
+        # floors mirror brownout_config's
+        assert tiny.leadership_candidates >= 8
+        assert tiny.swap_candidates >= 0
+    finally:
+        app.stop()
+
+
+def test_delta_sized_plans_hold_goal_quality():
+    """A delta-sized (1/8-width) steady-state plan must land the same
+    goal quality as full-K: equal-or-cleaner violations, objective within
+    a few percent — the width was sized to the delta, not starved."""
+    runs = {}
+    for sizing in (True, False):
+        app, fetcher, admin, sampler = _controller_service({
+            "tpu.num.candidates": 1024,
+            # a realistic round budget: the narrow plan trades width for
+            # steps, so it needs the steps the production config has
+            # (the 2-round harness default starves it into a residual)
+            "tpu.num.rounds": 4,
+            "tpu.steps.per.round": 24,
+            "controller.plan.min.candidates": 64,
+            "controller.plan.candidates.per.partition": 4,
+            "controller.plan.sizing.enabled": sizing,
+            # staged path only: keeps this test to one engine compile per
+            # width (plan sizing is orthogonal to fusion)
+            "controller.fusion.enabled": False,
+        })
+        try:
+            ctl = app.cc.controller
+            infos = _replay(ctl, fetcher, sampler, range(4, 8))
+            runs[sizing] = (infos[-1]["result"], ctl.state_json())
+        finally:
+            app.stop()
+    sized_res, sized_stats = runs[True]
+    full_res, full_stats = runs[False]
+    assert sized_stats["planSizedCycles"] > 0
+    assert full_stats["planSizedCycles"] == 0
+    sized_viol = float(np.max(sized_res.violations_after))
+    full_viol = float(np.max(full_res.violations_after))
+    tol = 1e-6
+    assert sized_viol <= max(full_viol, tol)
+    assert float(sized_res.objective_after) <= float(
+        full_res.objective_after
+    ) * 1.05 + tol

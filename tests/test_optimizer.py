@@ -451,3 +451,72 @@ def test_optimizer_config_validation():
     )
     # both paths compare against the SAME f32-quantized threshold
     assert OptimizerConfig().early_stop_tol == float(np.float32(1e-6))
+
+
+# --------------------------------------------------- mixed-precision scoring
+
+
+def _placement_bits(state):
+    return tuple(
+        np.asarray(getattr(state, f))
+        for f in ("replica_broker", "replica_is_leader", "replica_disk")
+    )
+
+
+def test_score_dtype_validation():
+    with pytest.raises(ValueError):
+        OptimizerConfig(score_dtype="float16")
+    with pytest.raises(ValueError):
+        OptimizerConfig(score_dtype="f32")
+
+
+def test_f32_scoring_pin_is_bit_for_bit():
+    """The fp32 fallback pin (analyzer.precision.score.dtype=float32, the
+    default): the mixed-precision refactor must leave the default graph
+    byte-identical — an explicit float32 config, the implicit default, and
+    a bare chain.evaluate all produce bitwise-equal objectives and
+    placements."""
+    state = small_cluster()
+    default = GoalOptimizer(config=FAST).optimize(state)
+    explicit = GoalOptimizer(
+        config=dataclasses.replace(FAST, score_dtype="float32")
+    ).optimize(state)
+    for a, b in zip(
+        _placement_bits(default.state_after), _placement_bits(explicit.state_after)
+    ):
+        assert (a == b).all()
+    assert np.float32(default.objective_after) == np.float32(
+        explicit.objective_after
+    )
+    # the evaluate() kwarg itself: explicit float32 == no kwarg, bitwise
+    obj_a, viol_a, sc_a = DEFAULT_CHAIN.evaluate(state)
+    obj_b, viol_b, sc_b = DEFAULT_CHAIN.evaluate(state, score_dtype="float32")
+    assert np.asarray(obj_a) == np.asarray(obj_b)
+    assert (np.asarray(viol_a) == np.asarray(viol_b)).all()
+    assert (np.asarray(sc_a) == np.asarray(sc_b)).all()
+
+
+def test_bf16_scoring_holds_tolerance_gate():
+    """bfloat16 goal-score accumulation must stay a numerics detail: the
+    anneal still converges to a valid placement whose final f32-reported
+    objective sits within analyzer.precision.tolerance (relative) of the
+    f32 reference — the gate that must pass before the low-precision path
+    is trusted (violations and reports stay f32 either way)."""
+    from cruise_control_tpu.config.app_config import CruiseControlConfig
+
+    tol = CruiseControlConfig({}).get("analyzer.precision.tolerance")
+    state = small_cluster()
+    f32 = GoalOptimizer(config=FAST).optimize(state)
+    bf16 = GoalOptimizer(
+        config=dataclasses.replace(FAST, score_dtype="bfloat16")
+    ).optimize(state)
+    assert validate(bf16.state_after) == []
+    assert bf16.objective_after < bf16.objective_before
+    ref = float(f32.objective_after)
+    assert abs(float(bf16.objective_after) - ref) <= tol * max(abs(ref), 1e-6)
+    # goal-chain evaluation of the SAME state: bf16 accumulation error on
+    # the weighted sum itself must sit far inside the tolerance band
+    obj_f, _, _ = DEFAULT_CHAIN.evaluate(state)
+    obj_b, viol_b, _ = DEFAULT_CHAIN.evaluate(state, score_dtype="bfloat16")
+    assert viol_b.dtype == jnp.float32  # violations never downcast
+    assert abs(float(obj_b) - float(obj_f)) <= tol * max(abs(float(obj_f)), 1e-6)

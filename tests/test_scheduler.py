@@ -736,7 +736,10 @@ def _scheduler_service_config(**extra):
     return CruiseControlConfig(props)
 
 
-def test_service_proposals_run_segmented_under_scheduler():
+def test_service_proposals_fast_path_unsegmented_when_alone():
+    # A lone INTERACTIVE proposals call takes the scheduler's fast path:
+    # with nobody else queued there is no one to preempt for, so the
+    # grant runs the plain unsegmented fused program.
     from cruise_control_tpu.service.main import build_simulated_service
     from cruise_control_tpu.service.progress import OperationProgress
 
@@ -748,8 +751,9 @@ def test_service_proposals_run_segmented_under_scheduler():
         assert cc.scheduler is not None
         result = cc.proposals(OperationProgress(), ignore_cache=True)
         timing = next(h for h in result.history if h.get("timing"))
-        assert timing.get("segmented") is True
+        assert timing.get("segmented") is not True
         assert cc.scheduler.stats["dispatches"]["interactive"] == 1
+        assert cc.scheduler.stats["fast_path_grants"] == 1
         # published-proposal age surfaces on the gauge and /fleet rollup
         age = cc.sensors.snapshot()["analyzer.proposal-age-seconds"]["value"]
         assert age >= 0.0
@@ -758,6 +762,28 @@ def test_service_proposals_run_segmented_under_scheduler():
         shared = shared_core_rollup(cc.core)
         assert shared["scheduler"]["enabled"] is True
         assert shared["scheduler"]["dispatches"]["interactive"] == 1
+        assert shared["scheduler"]["fastPathGrants"] == 1
+    finally:
+        app.stop()
+
+
+def test_service_proposals_run_segmented_with_fast_path_off():
+    from cruise_control_tpu.service.main import build_simulated_service
+    from cruise_control_tpu.service.progress import OperationProgress
+
+    app, fetcher, admin, sampler = build_simulated_service(
+        _scheduler_service_config(
+            **{"fleet.scheduler.fast.path.enabled": "false"}
+        )
+    )
+    try:
+        cc = app.cc
+        assert cc.scheduler is not None
+        result = cc.proposals(OperationProgress(), ignore_cache=True)
+        timing = next(h for h in result.history if h.get("timing"))
+        assert timing.get("segmented") is True
+        assert cc.scheduler.stats["dispatches"]["interactive"] == 1
+        assert cc.scheduler.stats["fast_path_grants"] == 0
     finally:
         app.stop()
 
@@ -828,3 +854,52 @@ def test_controller_cycle_sheds_counted(monkeypatch):
         assert cc.scheduler.stats["sheds"]["background"] == 1
     finally:
         app.stop()
+
+
+# ----------------------------------------------------- fast-path grants
+
+
+def test_interactive_fast_path_unsegmented_when_alone():
+    """An INTERACTIVE request granted while nothing else waits gets the
+    whole device as ONE unsegmented dispatch (no ambient segment context,
+    no between-slice preemption checks) — the streaming controller's
+    fused cycles ride this.  Explicit preemptible=True and BACKGROUND
+    submissions keep today's segmented grants."""
+    from cruise_control_tpu.analyzer.engine import current_segment_context
+
+    sched = _scheduler()
+    seen = {}
+
+    def body():
+        seen["ctx"] = current_segment_context()
+        return "ok"
+
+    assert sched.run(WorkClass.INTERACTIVE, body, op="fused-cycle") == "ok"
+    assert seen["ctx"] is None
+    assert sched.stats["fast_path_grants"] == 1
+    # the caller's explicit preemptible choice always wins
+    sched.run(WorkClass.INTERACTIVE, body, op="explicit", preemptible=True)
+    assert seen["ctx"] is not None
+    assert sched.stats["fast_path_grants"] == 1
+    # BACKGROUND never fast-paths, alone or not
+    sched.run(WorkClass.BACKGROUND, body, op="bg")
+    assert seen["ctx"] is not None
+    assert sched.stats["fast_path_grants"] == 1
+    assert sched.state_json()["fastPathGrants"] == 1
+
+
+def test_interactive_fast_path_disabled_stays_segmented():
+    """fleet.scheduler.fast.path.enabled=false pins the pre-fast-path
+    grant behavior byte-for-byte: every non-urgent grant is segmented."""
+    from cruise_control_tpu.analyzer.engine import current_segment_context
+
+    sched = _scheduler(fast_path_enabled=False)
+    seen = {}
+
+    def body():
+        seen["ctx"] = current_segment_context()
+
+    sched.run(WorkClass.INTERACTIVE, body, op="solo")
+    assert seen["ctx"] is not None
+    assert sched.stats["fast_path_grants"] == 0
+    assert sched.state_json()["fastPathGrants"] == 0
