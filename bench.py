@@ -741,6 +741,244 @@ def mesh_smoke() -> int:
     return 0 if ok else 1
 
 
+MESH_NORTH_STAR_SPEC = dict(
+    num_brokers=25_000,
+    num_racks=100,
+    num_topics=400,
+    num_partitions=2_000_000,
+    min_replication=2,
+    max_replication=3,
+    skew=0.5,
+    broker_capacity=(100.0, 500_000.0, 500_000.0, 5_000_000.0),
+    mean_cpu=0.15,
+    mean_nw_in=400.0,
+    mean_nw_out=500.0,
+    mean_disk=4000.0,
+)
+
+
+def _per_device_model_bytes(statics) -> dict:
+    """Bytes of the PLACED engine statics resident per device id —
+    replicated leaves bill their full copy to every device, sharded
+    leaves bill each device its own row block."""
+    import jax
+
+    out: dict = {}
+    for leaf in jax.tree_util.tree_leaves(statics):
+        if hasattr(leaf, "addressable_shards"):
+            for sh in leaf.addressable_shards:
+                out[sh.device.id] = out.get(sh.device.id, 0) + int(sh.data.nbytes)
+    return out
+
+
+def mesh(smoke_mode: bool) -> int:
+    """`bench.py --mesh [--smoke]`: the sharded-MODEL mesh mode at the
+    scale-out north star — 25k brokers / 2M partitions on 8 chips
+    (virtual CPU devices under check.sh; real chips on a device host).
+
+    Two gates plus a scaling report, written to BENCH_mesh_r01.json:
+
+      1. PARITY (small geometry): plain engine, replicated mesh and
+         sharded-model mesh runs of one seeded anneal must produce
+         byte-identical placements and equal objectives.  The state is
+         pre-padded to the shard multiple so every mode normalizes by
+         the same padded partition count, and loads are integer-quantized
+         so the sharded mode's psum'd partial sums are exact
+         (parallel/model_shard.py "Byte parity").
+      2. MEMORY (north-star shape): the sharded run's per-device placed
+         model bytes must be <= 1/4 of the replicated footprint (the
+         whole point of sharding the model axis: 8 chips hold ~1/8 each).
+
+    Scaling efficiency = plain 1-device wall / (n * sharded n-device
+    wall) over the warm (post-compile) runs — reported, not gated: on
+    the virtual CPU mesh all 8 "devices" share the host's cores, so CI
+    efficiency is meaningless; the number is the record a device host
+    fills in.  Per-device peak live bytes ride along from
+    common/profiling.per_device_live_bytes (the scraped counterpart is
+    the `tpu.device.peak-live-bytes-by-bucket` collector).
+
+    Smoke mode shrinks the SEARCH (2 steps, 1 round, 256 candidates) but
+    keeps the full 25k/2M geometry — the memory claim is about the model
+    arrays, which exist at full scale either way.
+    """
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu") if os.environ.get(
+        "GRAFT_FORCE_CPU"
+    ) else None
+    if len(jax.devices()) < 8:
+        if os.environ.get("MESH_BENCH_CHILD"):
+            print(
+                "mesh: forced-CPU child still has "
+                f"{len(jax.devices())} devices, need 8",
+                file=sys.stderr,
+            )
+            return 1
+        import subprocess
+
+        env = dict(os.environ)
+        env.update(
+            MESH_BENCH_CHILD="1",
+            GRAFT_FORCE_CPU="1",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        )
+        argv = ["--mesh"] + (["--smoke"] if smoke_mode else [])
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv, env=env
+        ).returncode
+
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import Engine, OptimizerConfig
+    from cruise_control_tpu.analyzer.objective import DEFAULT_CHAIN
+    from cruise_control_tpu.common.profiling import per_device_live_bytes
+    from cruise_control_tpu.models.builder import pad_state
+    from cruise_control_tpu.models.sharding import shard_multiple_shape
+    from cruise_control_tpu.parallel.mesh import MeshEngine, grid_mesh
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+    n_dev = 8
+    devices = jax.devices()[:n_dev]
+    record: dict = dict(
+        metric="mesh_model_sharded_north_star",
+        mode="smoke" if smoke_mode else "full",
+        n_devices=n_dev,
+        platform=devices[0].platform,
+    )
+
+    def timed_run(engine):
+        t0 = time.monotonic()
+        final, history = engine.run()
+        jax.block_until_ready(final.replica_broker)
+        return final, history, round(time.monotonic() - t0, 3)
+
+    # ---- gate 1: 3-way byte parity at small geometry --------------------
+    small = random_cluster_fast(
+        RandomClusterSpec(num_brokers=12, num_partitions=160, skew=1.5), seed=21
+    )
+    # integer-quantized loads: psum partial sums add exactly in f32
+    small = dataclasses.replace(
+        small,
+        replica_load_leader=jnp.round(small.replica_load_leader * 8),
+        replica_load_follower=jnp.round(small.replica_load_follower * 8),
+    )
+    # pre-pad so all three modes normalize by the same padded shape
+    small = pad_state(small, shard_multiple_shape(small.shape, n_dev))
+    small_cfg = OptimizerConfig(
+        num_candidates=60, leadership_candidates=16, swap_candidates=8,
+        steps_per_round=6, num_rounds=3, seed=3,
+    )
+    mesh2d = grid_mesh(1, n_dev, devices)
+    finals = {}
+    for name, eng in (
+        ("plain", Engine(small, DEFAULT_CHAIN, config=small_cfg)),
+        ("replicated", MeshEngine(small, DEFAULT_CHAIN, mesh=mesh2d, config=small_cfg)),
+        ("sharded", MeshEngine(
+            small, DEFAULT_CHAIN, mesh=mesh2d, config=small_cfg,
+            model_shard_min_partitions=1,
+        )),
+    ):
+        if name == "sharded" and not eng.model_sharded:
+            print("mesh: sharded engine fell back to replicated", file=sys.stderr)
+            return 1
+        final, _, _ = timed_run(eng)
+        obj, viol, _ = DEFAULT_CHAIN.evaluate(final)
+        finals[name] = (final, float(obj), np.asarray(viol))
+    parity = True
+    for f in ("replica_broker", "replica_is_leader", "replica_disk"):
+        vals = [np.asarray(getattr(finals[n][0], f)) for n in ("plain", "replicated", "sharded")]
+        parity &= bool((vals[0] == vals[1]).all()) and bool((vals[1] == vals[2]).all())
+    objs = [finals[n][1] for n in ("plain", "replicated", "sharded")]
+    viols = [finals[n][2] for n in ("plain", "replicated", "sharded")]
+    parity &= objs[0] == objs[1] == objs[2]
+    parity &= bool((viols[0] == viols[1]).all()) and bool((viols[1] == viols[2]).all())
+    record["small_geometry_parity"] = dict(
+        byte_identical=bool(parity), objective=objs[0],
+        shape=dict(B=small.shape.B, P=small.shape.P, R=small.shape.R),
+    )
+    del finals
+
+    # ---- gate 2 + scaling: the 25k / 2M north-star shape ----------------
+    t0 = time.monotonic()
+    state = random_cluster_fast(RandomClusterSpec(**MESH_NORTH_STAR_SPEC), seed=11)
+    record["fixture"] = dict(
+        brokers=state.shape.B, partitions=state.shape.P, replicas=state.shape.R,
+        gen_s=round(time.monotonic() - t0, 1),
+    )
+    search = (
+        dict(num_candidates=256, leadership_candidates=64, swap_candidates=32,
+             steps_per_round=2, num_rounds=1, seed=0)
+        if smoke_mode
+        else {**SEARCH, "num_rounds": 4}
+    )
+    cfg = OptimizerConfig(**search)
+
+    sharded = MeshEngine(
+        state, DEFAULT_CHAIN, mesh=grid_mesh(1, n_dev, devices), config=cfg,
+        model_shard_min_partitions=500_000,
+    )
+    if not sharded.model_sharded:
+        print("mesh: north-star engine fell back to replicated", file=sys.stderr)
+        return 1
+    dev_bytes = _per_device_model_bytes(sharded.statics)
+    replicated_bytes = sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(sharded.engine.statics)
+    )
+    max_dev_bytes = max(dev_bytes.values())
+    mem_ok = max_dev_bytes <= replicated_bytes / 4
+    final, hist, cold_wall = timed_run(sharded)
+    _, _, warm_wall = timed_run(sharded)
+    obj0, _, _ = DEFAULT_CHAIN.evaluate(state)
+    obj1, _, _ = DEFAULT_CHAIN.evaluate(final)
+    timing = next((h for h in hist if h.get("timing")), hist[-1] if hist else {})
+    peak = per_device_live_bytes()
+    record["north_star"] = dict(
+        sharded_wall_s=warm_wall,
+        sharded_wall_incl_compile_s=cold_wall,
+        objective_before=round(float(obj0), 6),
+        objective_after=round(float(obj1), 6),
+        improved=bool(float(obj1) < float(obj0)),
+        per_device_model_bytes={str(k): v for k, v in sorted(dev_bytes.items())},
+        replicated_model_bytes=replicated_bytes,
+        max_device_fraction_of_replicated=round(max_dev_bytes / replicated_bytes, 4),
+        model_bytes_quarter_gate=bool(mem_ok),
+        collective_bytes_per_round=int(timing.get("collective_bytes") or 0),
+        model_psum_bytes_per_round=int(timing.get("model_psum_bytes") or 0),
+        per_device_peak_live_bytes={str(k): int(v) for k, v in sorted(peak.items())},
+    )
+    del final, sharded
+
+    plain = Engine(state, DEFAULT_CHAIN, config=cfg)
+    _, _, plain_cold = timed_run(plain)
+    _, _, plain_warm = timed_run(plain)
+    del plain
+    efficiency = plain_warm / (n_dev * max(warm_wall, 1e-9))
+    record["scaling"] = dict(
+        plain_n1_wall_s=plain_warm,
+        plain_n1_wall_incl_compile_s=plain_cold,
+        sharded_n8_wall_s=warm_wall,
+        scaling_efficiency=round(efficiency, 4),
+        note="virtual CPU devices share host cores; efficiency is the "
+             "record a real 8-chip host fills in",
+    )
+    ok = parity and mem_ok
+    record.update(value=warm_wall, unit="s", vs_baseline=round(warm_wall / 10.0, 4), ok=ok)
+    _emit(**record)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_mesh_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    return 0 if ok else 1
+
+
 def trace_overhead() -> int:
     """`bench.py --trace-overhead`: tracing is ON by default on the hot
     proposal path, so its cost is gated, not assumed.  Runs the smoke
@@ -2012,6 +2250,8 @@ def main():
         sys.exit(ha_smoke())
     if "--mesh-smoke" in sys.argv:
         sys.exit(mesh_smoke())
+    if "--mesh" in sys.argv:
+        sys.exit(mesh("--smoke" in sys.argv))
     if "--trace-overhead" in sys.argv:
         sys.exit(trace_overhead())
     if "--blackbox-overhead" in sys.argv:

@@ -81,6 +81,7 @@ import numpy as np
 
 from cruise_control_tpu.analyzer.objective import GoalChain, TIE_WEIGHT
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common import collectives
 from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
 from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.common.dispatch import count_dispatch
@@ -867,6 +868,13 @@ class Engine:
     changed ClusterShape (padded sizes) triggers recompilation.
     """
 
+    #: mesh axis the replica/partition arrays are sharded over, or None
+    #: (replicated model).  A CLASS attribute: the model-sharded twin
+    #: (parallel/model_shard.py) shares this engine's __dict__ and
+    #: overrides it at class level, so the plain engine's traced programs
+    #: never see a collective.
+    _model_axis: str | None = None
+
     def __init__(
         self,
         state: ClusterState,
@@ -1281,7 +1289,7 @@ class Engine:
             broker_potential_nw_out=jnp.zeros(B, jnp.float32),
             broker_leader_bytes_in=jnp.zeros(B, jnp.float32),
             broker_topic_count=jnp.zeros((self.shape.num_topics, B), jnp.int32),
-            part_rack_count=jnp.zeros((self.shape.P, self.shape.num_racks), jnp.int32),
+            part_rack_count=jnp.zeros(self._prc_shape(), jnp.int32),
             disk_load=jnp.zeros((B, self.shape.max_disks_per_broker), jnp.float32),
             host_load=jnp.zeros((self.shape.num_hosts, NUM_RESOURCES), jnp.float32),
             key=key,
@@ -1302,9 +1310,22 @@ class Engine:
             replica_offline=offline & st.replica_valid,
         )
 
+    def _prc_shape(self) -> tuple[int, int]:
+        """Rows x racks of the carry's part_rack_count — the model-sharded
+        twin overrides the row count with its shard-local partition rows."""
+        return (self.shape.P, self.shape.num_racks)
+
+    def _psum_if_sharded(self, x):
+        """Finish a replica/partition-axis reduction: psum over the model
+        axis when the model is sharded, the identity otherwise."""
+        if self._model_axis is None:
+            return x
+        return jax.lax.psum(x, self._model_axis)
+
     def _refresh_impl(self, sx: EngineStatics, carry: EngineCarry) -> EngineCarry:
         state = self.carry_to_state(carry, sx)
-        agg = compute_aggregates(state)
+        with collectives.model_axis_scope(self._model_axis):
+            agg = compute_aggregates(state)
         hseg = jnp.where(state.broker_valid, state.broker_host, self.shape.num_hosts)
         host_load = jax.ops.segment_sum(
             agg.broker_load, hseg, num_segments=self.shape.num_hosts + 1
@@ -1323,10 +1344,11 @@ class Engine:
         )
 
     def _objective_impl(self, sx: EngineStatics, carry: EngineCarry):
-        obj, _, _ = self.chain.evaluate(
-            self.carry_to_state(carry, sx), constraint=self.constraint,
-            score_dtype=self.config.score_dtype,
-        )
+        with collectives.model_axis_scope(self._model_axis):
+            obj, _, _ = self.chain.evaluate(
+                self.carry_to_state(carry, sx), constraint=self.constraint,
+                score_dtype=self.config.score_dtype,
+            )
         return obj
 
     def carry_objective(self, sx: EngineStatics, carry: EngineCarry):
@@ -1347,16 +1369,20 @@ class Engine:
             carry.broker_leader_bytes_in,
             g,
         ).sum()
-        rack = jnp.maximum(carry.part_rack_count - 1, 0).sum().astype(jnp.float32)
+        rack = self._psum_if_sharded(
+            jnp.maximum(carry.part_rack_count - 1, 0).sum()
+        ).astype(jnp.float32)
         terms += self.w.rack * rack / sx.n_valid
         st = sx.state
-        offline = (
-            st.replica_valid
-            & ~(
-                st.broker_alive[carry.replica_broker]
-                & st.disk_alive[carry.replica_broker, carry.replica_disk]
-            )
-        ).sum()
+        offline = self._psum_if_sharded(
+            (
+                st.replica_valid
+                & ~(
+                    st.broker_alive[carry.replica_broker]
+                    & st.disk_alive[carry.replica_broker, carry.replica_disk]
+                )
+            ).sum()
+        )
         terms += self.w.offline * offline.astype(jnp.float32) / sx.n_valid
         terms += self._tie_term(sx, g["pct_sum"], g["pct_sumsq"])
         return terms
@@ -1403,16 +1429,24 @@ class Engine:
             part_rack_count=carry.part_rack_count,
             disk_load=carry.disk_load,
         )
-        obj, viol, _ = self.chain.evaluate(
-            self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint,
-            score_dtype=self.config.score_dtype,
-        )
+        with collectives.model_axis_scope(self._model_axis):
+            obj, viol, _ = self.chain.evaluate(
+                self.carry_to_state(carry, sx), agg=agg, constraint=self.constraint,
+                score_dtype=self.config.score_dtype,
+            )
         return obj, viol
 
-    def _plan_impl(self, sx: EngineStatics, carry: EngineCarry) -> SamplingPlan:
+    def _plan_impl(self, sx: EngineStatics, carry: EngineCarry):
         """Importance-sampling + movement-pricing plan from current aggregates."""
+        probs, unit = self._plan_probs(sx, carry)
+        return self._plan_build(sx, carry, probs, unit)
+
+    def _plan_probs(self, sx: EngineStatics, carry: EngineCarry):
+        """Per-broker sampling probabilities + movement-pricing unit — the
+        O(B + T·B) half of the plan, replicated-broker math shared verbatim
+        by the plain engine and the model-sharded twin."""
         st = sx.state
-        B, R = self.shape.B, self.shape.R
+        B = self.shape.B
         g = self._globals(sx, carry)
         b = jnp.arange(B)
         w = self._broker_terms(
@@ -1448,6 +1482,16 @@ class Engine:
         uni = jnp.where(st.broker_valid, 1.0, 0.0)
         uni = uni / jnp.maximum(uni.sum(), 1.0)
         probs = jnp.where(total > 1e-12, w / jnp.maximum(total, 1e-12), uni)
+        obj = self.carry_objective(sx, carry)
+        unit = obj / sx.n_valid
+        return probs, unit
+
+    def _plan_build(self, sx: EngineStatics, carry: EngineCarry, probs, unit):
+        """The O(R) half of the plan: per-broker replica counts and the
+        broker-grouped replica order.  The model-sharded twin overrides
+        this with shard-local counts/order + the psum'd global counts."""
+        st = sx.state
+        B, R = self.shape.B, self.shape.R
         seg = jnp.where(st.replica_valid, carry.replica_broker, B)
         count = jax.ops.segment_sum(
             jnp.ones(R, jnp.int32), seg, num_segments=B + 1
@@ -1455,8 +1499,6 @@ class Engine:
         start = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.cumsum(count)[:-1].astype(jnp.int32)]
         )
-        obj = self.carry_objective(sx, carry)
-        unit = obj / sx.n_valid
         return SamplingPlan(
             broker_cdf=jnp.cumsum(probs),
             order=jnp.argsort(seg).astype(jnp.int32),
@@ -1705,7 +1747,9 @@ class Engine:
                 return uni, jnp.zeros((n,), bool)
             return uni
         k_m, k_p = jax.random.split(jax.random.fold_in(key, 1))
-        t = sx.state.replica_topic[jnp.minimum(r, self.shape.R - 1)]
+        t = self._take_rows(
+            sx, None, jnp.minimum(r, self.shape.R - 1), ("topic",)
+        )["topic"]
         cdf = sx.prior_dst_cdf[t]  # [n, B] per-topic inclusive CDF
         u = jax.random.uniform(k_p, (n,))
         p_idx = jnp.minimum(
@@ -1743,6 +1787,62 @@ class Engine:
             out.append(jax.lax.dynamic_slice_in_dim(a, idx * size, size))
         return tuple(out) if len(out) > 1 else out[0]
 
+    # ------------------------------------------------------------------
+    # replica-axis row providers (the model-sharding seam)
+    #
+    # Candidate generation reads per-replica columns at sampled ids and
+    # per-partition cells at member/partition ids.  The plain engine (and
+    # the replicated mesh) fancy-index the full arrays directly; the
+    # model-sharded twin (parallel/model_shard.py) overrides these four
+    # methods with ownership-masked local gathers + a psum over MODEL_AXIS
+    # (ids are GLOBAL; exactly one shard owns each row, the rest
+    # contribute zeros).  Everything above these seams is kind-agnostic
+    # replicated math, so the candidate functions themselves are shared
+    # verbatim by both modes.
+    # ------------------------------------------------------------------
+
+    #: seam field -> (carry | state, attribute).  "orig_*" read the
+    #: STATICS placement (movement pricing charges strays against the
+    #: pre-optimization cluster, not the evolving carry).
+    _ROW_SOURCES = {
+        "broker": ("carry", "replica_broker"),
+        "is_lead": ("carry", "replica_is_leader"),
+        "disk": ("carry", "replica_disk"),
+        "part": ("state", "replica_partition"),
+        "topic": ("state", "replica_topic"),
+        "pos": ("state", "replica_pos"),
+        "valid": ("state", "replica_valid"),
+        "load_leader": ("state", "replica_load_leader"),
+        "load_follower": ("state", "replica_load_follower"),
+        "orig_broker": ("state", "replica_broker"),
+        "orig_disk": ("state", "replica_disk"),
+        "orig_lead": ("state", "replica_is_leader"),
+    }
+
+    def _row_source(self, sx, carry, field):
+        kind, attr = self._ROW_SOURCES[field]
+        return getattr(carry if kind == "carry" else sx.state, attr)
+
+    def _take_rows(self, sx, carry, ids, fields):
+        """{field: column[ids]} for (global) replica ids `ids`."""
+        return {f: self._row_source(sx, carry, f)[ids] for f in fields}
+
+    def _take_members(self, sx, part):
+        """[K, max_rf] partition->replica member table rows at (global)
+        partition ids (member entries are global replica ids; >= R pads)."""
+        return sx.part_replicas[part]
+
+    def _member_field(self, sx, carry, members, field, fill):
+        """Per-member column gather with the table's >= R padding masked
+        to `fill` (members carry global replica ids)."""
+        src = self._row_source(sx, carry, field)
+        vals = src[jnp.minimum(members, self.shape.R - 1)]
+        return jnp.where(members < self.shape.R, vals, fill)
+
+    def _rack_cell(self, carry, part, rack):
+        """part_rack_count[(global) partition, rack] as f32."""
+        return carry.part_rack_count[part, rack].astype(jnp.float32)
+
     def _replica_candidates(
         self, sx, carry: EngineCarry, key: jax.Array, g, plan=None, slice_=None
     ):
@@ -1761,39 +1861,40 @@ class Engine:
             dst = sx.dest_ids[self._sample_dests(sx, k2, K, r)]
             r, dst = self._slice_draws(slice_, r, dst)
             from_prior = None
-        src = carry.replica_broker[r]
-        part = st.replica_partition[r]
+        fields = ["broker", "part", "disk", "topic", "valid", "is_lead",
+                  "load_leader", "load_follower"]
+        if self.w.pref_leader != 0.0:
+            fields.append("pos")
+        if plan is not None and self.config.replica_move_cost:
+            fields.append("orig_broker")
+        rows = self._take_rows(sx, carry, r, tuple(fields))
+        src = rows["broker"]
+        part = rows["part"]
 
         # feasibility (reference GoalUtils.legitMove:153 + exclusions)
-        offline = ~(
-            st.broker_alive[src] & st.disk_alive[src, carry.replica_disk[r]]
-        )
-        movable = sx.topic_movable[st.replica_topic[r]] | offline
-        feasible = st.replica_valid[r] & movable & (src != dst)
+        offline = ~(st.broker_alive[src] & st.disk_alive[src, rows["disk"]])
+        movable = sx.topic_movable[rows["topic"]] | offline
+        feasible = rows["valid"] & movable & (src != dst)
         # no second replica of the partition on dst (reference
         # ClusterModel.relocateReplica precondition)
-        members = sx.part_replicas[part]  # [K, max_rf]
-        member_broker = jnp.where(
-            members < self.shape.R,
-            carry.replica_broker[jnp.minimum(members, self.shape.R - 1)],
-            -1,
-        )
+        members = self._take_members(sx, part)  # [K, max_rf]
+        member_broker = self._member_field(sx, carry, members, "broker", -1)
         feasible &= ~(member_broker == dst[:, None]).any(axis=1)
 
-        is_lead = carry.replica_is_leader[r]
+        is_lead = rows["is_lead"]
         load = jnp.where(
-            is_lead[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+            is_lead[:, None], rows["load_leader"], rows["load_follower"]
         )  # [K, 4]
-        load = jnp.where(st.replica_valid[r][:, None], load, 0.0)
+        load = jnp.where(rows["valid"][:, None], load, 0.0)
 
         # destination logdir: most-free alive disk on dst
         ddst_pct = carry.disk_load[dst] / (st.disk_capacity[dst] + 1e-12)
         ddst_pct = jnp.where(st.disk_alive[dst], ddst_pct, jnp.inf)
         d_dst = jnp.argmin(ddst_pct, axis=1).astype(jnp.int32)
-        d_src = carry.replica_disk[r]
+        d_src = rows["disk"]
 
-        pot = st.replica_load_leader[r, int(Resource.NW_OUT)]
-        lbin = jnp.where(is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0)
+        pot = rows["load_leader"][:, int(Resource.NW_OUT)]
+        lbin = jnp.where(is_lead, rows["load_leader"][:, int(Resource.NW_IN)], 0.0)
         dcount = jnp.ones(r.shape, jnp.int32)
         dlcount = is_lead.astype(jnp.int32)
 
@@ -1816,14 +1917,14 @@ class Engine:
 
         # rack cells (reference RackAwareGoal)
         rack_s, rack_d = st.broker_rack[src], st.broker_rack[dst]
-        c_s = carry.part_rack_count[part, rack_s].astype(jnp.float32)
-        c_d = carry.part_rack_count[part, rack_d].astype(jnp.float32)
+        c_s = self._rack_cell(carry, part, rack_s)
+        c_d = self._rack_cell(carry, part, rack_d)
         drack = (_relu(c_s - 2.0) - _relu(c_s - 1.0)) + (_relu(c_d) - _relu(c_d - 1.0))
         delta += self.w.rack * jnp.where(rack_s != rack_d, drack, 0.0) / sx.n_valid
 
         # topic cells (reference TopicReplicaDistributionGoal)
         if self.w.topic_dist != 0.0:
-            t = st.replica_topic[r]
+            t = rows["topic"]
             tt = self.constraint.topic_replica_count_balance_threshold
             upper = jnp.ceil(g["topic_avg"][t] * tt)
             lower = jnp.floor(g["topic_avg"][t] * max(0.0, 2.0 - tt))
@@ -1843,7 +1944,7 @@ class Engine:
 
         # preferred-leader eligibility shift (reference PreferredLeaderElectionGoal)
         if self.w.pref_leader != 0.0:
-            pref = (st.replica_pos[r] == 0) & st.replica_valid[r] & ~is_lead
+            pref = (rows["pos"] == 0) & rows["valid"] & ~is_lead
             was = pref & ~offline
             now = pref & dst_ok
             delta += (
@@ -1856,7 +1957,7 @@ class Engine:
         # hold the pre-optimization placement), refunded when moving home —
         # keeps the plan executable (reference ExecutionProposal data-to-move)
         if plan is not None and self.config.replica_move_cost:
-            orig = st.replica_broker[r]
+            orig = rows["orig_broker"]
             stray = (dst != orig).astype(jnp.float32) - (src != orig).astype(jnp.float32)
             delta += plan.replica_cost * stray
 
@@ -1883,9 +1984,14 @@ class Engine:
         K = self.K_r
         D = self.shape.max_disks_per_broker
         r = self._slice_draws(slice_, self._sample_sources(sx, key, K, plan))
-        b = carry.replica_broker[r]
-        d_src = carry.replica_disk[r]
-        part = st.replica_partition[r]
+        fields = ["broker", "part", "disk", "topic", "valid", "is_lead",
+                  "load_leader", "load_follower"]
+        if plan is not None and self.config.replica_move_cost:
+            fields.append("orig_disk")
+        rows = self._take_rows(sx, carry, r, tuple(fields))
+        b = rows["broker"]
+        d_src = rows["disk"]
+        part = rows["part"]
 
         # destination logdir: most-free alive disk on b, excluding the
         # current slot
@@ -1895,17 +2001,17 @@ class Engine:
         d_dst = jnp.argmin(pct, axis=1).astype(jnp.int32)
 
         off_src = ~(st.broker_alive[b] & st.disk_alive[b, d_src])
-        movable = sx.topic_movable[st.replica_topic[r]] | off_src
+        movable = sx.topic_movable[rows["topic"]] | off_src
         dst_ok = st.broker_alive[b] & st.disk_alive[b, d_dst]
         feasible = (
-            st.replica_valid[r] & movable & dst_ok & (d_dst != d_src)
+            rows["valid"] & movable & dst_ok & (d_dst != d_src)
         )
 
-        is_lead = carry.replica_is_leader[r]
+        is_lead = rows["is_lead"]
         load = jnp.where(
-            is_lead[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+            is_lead[:, None], rows["load_leader"], rows["load_follower"]
         )
-        load = jnp.where(st.replica_valid[r][:, None], load, 0.0)
+        load = jnp.where(rows["valid"][:, None], load, 0.0)
         ddisk = load[:, int(Resource.DISK)]
 
         # intra-broker disk terms: one broker, one row reshuffled
@@ -1925,16 +2031,16 @@ class Engine:
         # movement pricing vs the ORIGINAL logdir (alterReplicaLogDirs copies
         # the whole replica; reference ExecutionProposal data-to-move)
         if plan is not None and self.config.replica_move_cost:
-            orig = st.replica_disk[r]
+            orig = rows["orig_disk"]
             stray = (d_dst != orig).astype(jnp.float32) - (d_src != orig).astype(
                 jnp.float32
             )
             delta += plan.replica_cost * stray
 
         payload = dict(r=r, dst=b, d_dst=d_dst, load=load, is_lead=is_lead,
-                       pot=st.replica_load_leader[r, int(Resource.NW_OUT)],
+                       pot=rows["load_leader"][:, int(Resource.NW_OUT)],
                        lbin=jnp.where(
-                           is_lead, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0
+                           is_lead, rows["load_leader"][:, int(Resource.NW_IN)], 0.0
                        ),
                        d_src=d_src)
         if self.config.diagnostics:
@@ -1970,20 +2076,33 @@ class Engine:
         r = self._sample_sources(sx, k1, K, plan)
         q = _uniform_idx(k2, (K,), sx.n_source)
         r, q = self._slice_draws(slice_, r, q)
-        src = carry.replica_broker[r]
-        dst = carry.replica_broker[q]
-        part_r = st.replica_partition[r]
-        part_q = st.replica_partition[q]
+        # ONE row bundle for both draw lanes (gather of a concat == concat
+        # of gathers): the model-sharded twin resolves it with a single
+        # psum round instead of two
+        fields = ["broker", "part", "disk", "topic", "valid", "is_lead",
+                  "load_leader", "load_follower"]
+        if self.w.pref_leader != 0.0:
+            fields.append("pos")
+        if plan is not None and self.config.replica_move_cost:
+            fields.append("orig_broker")
+        n_r = r.shape[0]
+        rows = self._take_rows(sx, carry, jnp.concatenate([r, q]), tuple(fields))
+        rows_r = {f: a[:n_r] for f, a in rows.items()}
+        rows_q = {f: a[n_r:] for f, a in rows.items()}
+        src = rows_r["broker"]
+        dst = rows_q["broker"]
+        part_r = rows_r["part"]
+        part_q = rows_q["part"]
 
-        d_r = carry.replica_disk[r]
-        d_q = carry.replica_disk[q]
+        d_r = rows_r["disk"]
+        d_q = rows_q["disk"]
         off_r = ~(st.broker_alive[src] & st.disk_alive[src, d_r])
         off_q = ~(st.broker_alive[dst] & st.disk_alive[dst, d_q])
-        movable_r = sx.topic_movable[st.replica_topic[r]] | off_r
-        movable_q = sx.topic_movable[st.replica_topic[q]] | off_q
+        movable_r = sx.topic_movable[rows_r["topic"]] | off_r
+        movable_q = sx.topic_movable[rows_q["topic"]] | off_q
         feasible = (
-            st.replica_valid[r]
-            & st.replica_valid[q]
+            rows_r["valid"]
+            & rows_q["valid"]
             & movable_r
             & movable_q
             & (src != dst)
@@ -1998,35 +2117,27 @@ class Engine:
             & st.disk_alive[src, d_r]
         )
         # neither partition may end up duplicated on its new broker
-        mem_r = sx.part_replicas[part_r]  # [K, max_rf]
-        mem_r_broker = jnp.where(
-            mem_r < self.shape.R,
-            carry.replica_broker[jnp.minimum(mem_r, self.shape.R - 1)],
-            -1,
-        )
+        mem_r = self._take_members(sx, part_r)  # [K, max_rf]
+        mem_r_broker = self._member_field(sx, carry, mem_r, "broker", -1)
         feasible &= ~(mem_r_broker == dst[:, None]).any(axis=1)
-        mem_q = sx.part_replicas[part_q]
-        mem_q_broker = jnp.where(
-            mem_q < self.shape.R,
-            carry.replica_broker[jnp.minimum(mem_q, self.shape.R - 1)],
-            -1,
-        )
+        mem_q = self._take_members(sx, part_q)
+        mem_q_broker = self._member_field(sx, carry, mem_q, "broker", -1)
         feasible &= ~(mem_q_broker == src[:, None]).any(axis=1)
 
-        lead_r = carry.replica_is_leader[r]
-        lead_q = carry.replica_is_leader[q]
+        lead_r = rows_r["is_lead"]
+        lead_q = rows_q["is_lead"]
         load_r = jnp.where(
-            lead_r[:, None], st.replica_load_leader[r], st.replica_load_follower[r]
+            lead_r[:, None], rows_r["load_leader"], rows_r["load_follower"]
         )
-        load_r = jnp.where(st.replica_valid[r][:, None], load_r, 0.0)
+        load_r = jnp.where(rows_r["valid"][:, None], load_r, 0.0)
         load_q = jnp.where(
-            lead_q[:, None], st.replica_load_leader[q], st.replica_load_follower[q]
+            lead_q[:, None], rows_q["load_leader"], rows_q["load_follower"]
         )
-        load_q = jnp.where(st.replica_valid[q][:, None], load_q, 0.0)
-        pot_r = st.replica_load_leader[r, int(Resource.NW_OUT)]
-        pot_q = st.replica_load_leader[q, int(Resource.NW_OUT)]
-        lbin_r = jnp.where(lead_r, st.replica_load_leader[r, int(Resource.NW_IN)], 0.0)
-        lbin_q = jnp.where(lead_q, st.replica_load_leader[q, int(Resource.NW_IN)], 0.0)
+        load_q = jnp.where(rows_q["valid"][:, None], load_q, 0.0)
+        pot_r = rows_r["load_leader"][:, int(Resource.NW_OUT)]
+        pot_q = rows_q["load_leader"][:, int(Resource.NW_OUT)]
+        lbin_r = jnp.where(lead_r, rows_r["load_leader"][:, int(Resource.NW_IN)], 0.0)
+        lbin_q = jnp.where(lead_q, rows_q["load_leader"][:, int(Resource.NW_IN)], 0.0)
 
         rdisk = int(Resource.DISK)
         # r -> (dst, q's disk slot), q -> (src, r's disk slot)
@@ -2051,8 +2162,8 @@ class Engine:
         rack_s, rack_d = st.broker_rack[src], st.broker_rack[dst]
 
         def rack_delta(part, rack_from, rack_to):
-            c_f = carry.part_rack_count[part, rack_from].astype(jnp.float32)
-            c_t = carry.part_rack_count[part, rack_to].astype(jnp.float32)
+            c_f = self._rack_cell(carry, part, rack_from)
+            c_t = self._rack_cell(carry, part, rack_to)
             d = (_relu(c_f - 2.0) - _relu(c_f - 1.0)) + (_relu(c_t) - _relu(c_t - 1.0))
             return jnp.where(rack_from != rack_to, d, 0.0)
 
@@ -2076,8 +2187,8 @@ class Engine:
                 return (cell(ct_f - 1.0) - cell(ct_f)) + (cell(ct_t + 1.0) - cell(ct_t))
 
             delta += self.w.topic_dist * (
-                topic_delta(st.replica_topic[r], src, dst)
-                + topic_delta(st.replica_topic[q], dst, src)
+                topic_delta(rows_r["topic"], src, dst)
+                + topic_delta(rows_q["topic"], dst, src)
             ) / g["total_count"]
 
         # offline-replica shifts for both replicas
@@ -2093,22 +2204,25 @@ class Engine:
 
         # preferred-leader eligibility shifts
         if self.w.pref_leader != 0.0:
-            def pref_delta(x, was_off, now_ok, lead):
-                pref = (st.replica_pos[x] == 0) & st.replica_valid[x] & ~lead
+            def pref_delta(rows_x, was_off, now_ok, lead):
+                pref = (rows_x["pos"] == 0) & rows_x["valid"] & ~lead
                 was = pref & ~was_off
                 now = pref & now_ok
                 return now.astype(jnp.float32) - was.astype(jnp.float32)
 
             delta += (
                 self.w.pref_leader
-                * (pref_delta(r, off_r, r_ok, lead_r) + pref_delta(q, off_q, q_ok, lead_q))
+                * (
+                    pref_delta(rows_r, off_r, r_ok, lead_r)
+                    + pref_delta(rows_q, off_q, q_ok, lead_q)
+                )
                 / max(1, self.shape.P)
             )
 
         # movement pricing for both strays
         if plan is not None and self.config.replica_move_cost:
-            orig_r = st.replica_broker[r]
-            orig_q = st.replica_broker[q]
+            orig_r = rows_r["orig_broker"]
+            orig_q = rows_q["orig_broker"]
             stray = (
                 (dst != orig_r).astype(jnp.float32)
                 - (src != orig_r).astype(jnp.float32)
@@ -2138,27 +2252,37 @@ class Engine:
             payload = dict(rf=zi, rt=zi, dl_f=zl, dl_t=zl, dlbin_src=z, dlbin_dst=z)
             return z, zb, zi, zi, zi, payload
         rt = self._slice_draws(slice_, _uniform_idx(key, (K,), sx.n_source))
-        part = st.replica_partition[rt]
-        members = sx.part_replicas[part]  # [K, max_rf]
-        m_valid = members < R
+        fields = ["broker", "part", "disk", "valid", "is_lead",
+                  "load_leader", "load_follower"]
+        if self.w.pref_leader != 0.0:
+            fields.append("pos")
+        if plan is not None and self.config.leadership_move_cost:
+            fields.append("orig_lead")
+        rows_t = self._take_rows(sx, carry, rt, tuple(fields))
+        part = rows_t["part"]
+        members = self._take_members(sx, part)  # [K, max_rf]
         m_idx = jnp.minimum(members, R - 1)
-        m_lead = carry.replica_is_leader[m_idx] & m_valid
+        m_lead = self._member_field(sx, carry, members, "is_lead", False)
         rf = m_idx[jnp.arange(rt.shape[0]), jnp.argmax(m_lead, axis=1)]
+        rows_f = self._take_rows(
+            sx, carry, rf,
+            tuple(f for f in fields if f not in ("part", "valid", "is_lead")),
+        )
 
-        src, dst = carry.replica_broker[rf], carry.replica_broker[rt]
-        dst_ok = st.broker_alive[dst] & st.disk_alive[dst, carry.replica_disk[rt]]
+        src, dst = rows_f["broker"], rows_t["broker"]
+        dst_ok = st.broker_alive[dst] & st.disk_alive[dst, rows_t["disk"]]
         feasible = (
-            st.replica_valid[rt]
-            & ~carry.replica_is_leader[rt]
+            rows_t["valid"]
+            & ~rows_t["is_lead"]
             & m_lead.any(axis=1)
             & dst_ok
             & sx.lead_ok[dst]
         )
 
         # load shift: rf leader->follower on src, rt follower->leader on dst
-        dl_f = st.replica_load_follower[rf] - st.replica_load_leader[rf]  # [K, 4]
-        dl_t = st.replica_load_leader[rt] - st.replica_load_follower[rt]
-        dlbin = st.replica_load_leader[rt, int(Resource.NW_IN)]  # gained by dst
+        dl_f = rows_f["load_follower"] - rows_f["load_leader"]  # [K, 4]
+        dl_t = rows_t["load_leader"] - rows_t["load_follower"]
+        dlbin = rows_t["load_leader"][:, int(Resource.NW_IN)]  # gained by dst
         # NOTE: src loses rf's leader NW_IN; handled via asymmetric lbin deltas
         delta = self._move_delta(
             sx,
@@ -2171,18 +2295,18 @@ class Engine:
             dcount=jnp.zeros(rt.shape, jnp.int32),
             dlcount=jnp.ones(rt.shape, jnp.int32),
             dpot=jnp.zeros(rt.shape, jnp.float32),
-            dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
+            dlbin_src=rows_f["load_leader"][:, int(Resource.NW_IN)],
             dlbin=dlbin,
-            d_src=carry.replica_disk[rf],
-            d_dst=carry.replica_disk[rt],
+            d_src=rows_f["disk"],
+            d_dst=rows_t["disk"],
             ddisk_src=dl_f[:, int(Resource.DISK)],
             ddisk=dl_t[:, int(Resource.DISK)],
         )
 
         if self.w.pref_leader != 0.0:
-            src_ok = st.broker_alive[src] & st.disk_alive[src, carry.replica_disk[rf]]
-            pref_f = (st.replica_pos[rf] == 0) & src_ok  # rf becomes violating
-            pref_t = (st.replica_pos[rt] == 0) & dst_ok  # rt stops violating
+            src_ok = st.broker_alive[src] & st.disk_alive[src, rows_f["disk"]]
+            pref_f = (rows_f["pos"] == 0) & src_ok  # rf becomes violating
+            pref_t = (rows_t["pos"] == 0) & dst_ok  # rt stops violating
             delta += (
                 self.w.pref_leader
                 * (pref_f.astype(jnp.float32) - pref_t.astype(jnp.float32))
@@ -2194,14 +2318,13 @@ class Engine:
         # (the executor applies each as a preferred-leader election batch,
         # reference executor/Executor.java:1091)
         if plan is not None and self.config.leadership_move_cost:
-            orig_lead = st.replica_is_leader
-            stray = (~orig_lead[rt]).astype(jnp.float32) - (~orig_lead[rf]).astype(
-                jnp.float32
-            )
+            stray = (~rows_t["orig_lead"]).astype(jnp.float32) - (
+                ~rows_f["orig_lead"]
+            ).astype(jnp.float32)
             delta += plan.lead_cost * stray
 
         payload = dict(rf=rf, rt=rt, dl_f=dl_f, dl_t=dl_t,
-                       dlbin_src=st.replica_load_leader[rf, int(Resource.NW_IN)],
+                       dlbin_src=rows_f["load_leader"][:, int(Resource.NW_IN)],
                        dlbin_dst=dlbin)
         return delta, feasible, src, dst, part, payload
 
@@ -2350,7 +2473,6 @@ class Engine:
         """Concatenate per-kind bundles into the selection/apply bundle
         (shared verbatim by the plain step and the mesh step's post-gather
         path, so the two can never diverge)."""
-        st = sx.state
         R1 = self.shape.R - 1
         dr, fr, sr, tr, pr, payr = raw_r
         ds, fs, ss, ts, ps1, ps2, pays = raw_s
@@ -2382,15 +2504,25 @@ class Engine:
             pot=jnp.concatenate([payr["pot"], pays["pot_r"], pays["pot_q"]]),
             lbin=jnp.concatenate([payr["lbin"], pays["lbin_r"], pays["lbin_q"]]),
             d_src=jnp.concatenate([payr["d_src"], pays["d_r"], pays["d_q"]]),
-            topic=st.replica_topic[jnp.minimum(r_ext, R1)],
+            topic=self._take_rows(
+                sx, carry, jnp.minimum(r_ext, R1), ("topic",)
+            )["topic"],
             part=jnp.concatenate([pr, ps1, ps2]),
         )
+        # rf/rt disk lookups bundled into ONE row fetch (single psum round
+        # on the sharded twin)
+        n_f = payl["rf"].shape[0]
+        d_ft = self._take_rows(
+            sx, carry,
+            jnp.minimum(jnp.concatenate([payl["rf"], payl["rt"]]), R1),
+            ("disk",),
+        )["disk"]
         payl_ext = dict(
             payl,
             src_b=sl,
             dst_b=tl,
-            d_f=carry.replica_disk[jnp.minimum(payl["rf"], R1)],
-            d_t=carry.replica_disk[jnp.minimum(payl["rt"], R1)],
+            d_f=d_ft[:n_f],
+            d_t=d_ft[n_f:],
         )
         out = dict(
             delta=delta, feas=feas, src=src, dst=dst, part1=part1, part2=part2,
@@ -2474,7 +2606,7 @@ class Engine:
 
     def _apply(
         self, sx, carry: EngineCarry, sv_r, payr, sv_l, payl,
-        *, r_offset=None, p_offset=None,
+        *, r_offset=None, p_offset=None, r_size=None, p_size=None,
     ) -> EngineCarry:
         """Scatter surviving candidates into placement + aggregates.
 
@@ -2487,6 +2619,11 @@ class Engine:
         """
         st = sx.state
         B, R, D = self.shape.B, self.shape.R, self.shape.max_disks_per_broker
+        # local extents of the placement arrays: the sharded engine passes
+        # its per-shard row counts so ownership bounds and drop sentinels
+        # track the LOCAL arrays, not the global shape
+        r_size = R if r_size is None else r_size
+        p_size = self.shape.P if p_size is None else p_size
         drop = dict(mode="drop")
         # ownership masks: negative indices would WRAP (python semantics), so
         # rows owned by other shards must be masked to the sentinel explicitly
@@ -2494,15 +2631,15 @@ class Engine:
             r_ids, own_r = payr["r"], True
         else:
             r_ids = payr["r"] - r_offset
-            own_r = (r_ids >= 0) & (r_ids < R)
+            own_r = (r_ids >= 0) & (r_ids < r_size)
         if p_offset is None:
             p_ids, own_p = payr["part"], True
         else:
             p_ids = payr["part"] - p_offset
-            own_p = (p_ids >= 0) & (p_ids < self.shape.P)
+            own_p = (p_ids >= 0) & (p_ids < p_size)
 
         # ---- replica moves ----
-        r = jnp.where(sv_r & own_r, r_ids, R)
+        r = jnp.where(sv_r & own_r, r_ids, r_size)
         dst = payr["dst"]
         load = payr["load"] * sv_r[:, None]
         src = payr["src"]
@@ -2533,7 +2670,7 @@ class Engine:
             carry.broker_topic_count.at[jnp.where(sv_r, t, T), src_idx].add(-ones, **drop)
             .at[jnp.where(sv_r, t, T), dst_idx].add(ones, **drop)
         )
-        p = jnp.where(sv_r & own_p, p_ids, self.shape.P)
+        p = jnp.where(sv_r & own_p, p_ids, p_size)
         rack_s = st.broker_rack[src]
         rack_d = st.broker_rack[dst]
         prc = (
@@ -2559,10 +2696,10 @@ class Engine:
         else:
             rf_ids = payl["rf"] - r_offset
             rt_ids = payl["rt"] - r_offset
-            own_f = (rf_ids >= 0) & (rf_ids < R)
-            own_t = (rt_ids >= 0) & (rt_ids < R)
-        rf = jnp.where(sv_l & own_f, rf_ids, R)
-        rt = jnp.where(sv_l & own_t, rt_ids, R)
+            own_f = (rf_ids >= 0) & (rf_ids < r_size)
+            own_t = (rt_ids >= 0) & (rt_ids < r_size)
+        rf = jnp.where(sv_l & own_f, rf_ids, r_size)
+        rt = jnp.where(sv_l & own_t, rt_ids, r_size)
         is_leader = carry.replica_is_leader.at[rf].set(False, **drop).at[rt].set(True, **drop)
 
         src_l = payl["src_b"]

@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cruise_control_tpu.common.collectives import gsum
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.models.aggregates import BrokerAggregates, host_load
 from cruise_control_tpu.models.state import ClusterState
@@ -77,7 +78,8 @@ class ReplicaCapacityGoal(Goal):
         mask = alive_mask(state)
         count = jnp.where(mask, agg.broker_replica_count, 0)
         excess = relu((count - constraint.max_replicas_per_broker).astype(jnp.float32))
-        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
+        # replica_valid is replica-axis (model-shardable); excess is broker-axis.
+        n_valid = gsum(state.replica_valid).astype(jnp.float32) + 1e-12
         return excess.sum() / n_valid
 
 
@@ -114,5 +116,5 @@ class OfflineReplicaGoal(Goal):
         dead_broker = ~state.broker_alive[state.replica_broker]
         dead_disk = ~state.disk_alive[state.replica_broker, state.replica_disk]
         bad = state.replica_valid & (dead_broker | dead_disk)
-        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
-        return bad.sum().astype(jnp.float32) / n_valid
+        n_valid = gsum(state.replica_valid).astype(jnp.float32) + 1e-12
+        return gsum(bad).astype(jnp.float32) / n_valid

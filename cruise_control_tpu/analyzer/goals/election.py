@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from cruise_control_tpu.common.collectives import gsum
 from cruise_control_tpu.models.aggregates import BrokerAggregates
 from cruise_control_tpu.models.state import ClusterState
 from cruise_control_tpu.analyzer.goals.base import Goal
@@ -24,5 +25,5 @@ class PreferredLeaderElectionGoal(Goal):
         eligible = state.broker_alive[state.replica_broker] & ~state.replica_offline
         # partition is violated if its preferred replica is eligible but not leader
         bad = state.replica_valid & preferred & eligible & ~state.replica_is_leader
-        P = jnp.maximum(state.shape.P, 1)
-        return bad.sum().astype(jnp.float32) / P
+        P = jnp.maximum(state.shape.P, 1)  # global padded P (shape is metadata)
+        return gsum(bad).astype(jnp.float32) / P

@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cruise_control_tpu.common.collectives import gsegment_sum, gsum
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.models.aggregates import BrokerAggregates
 from cruise_control_tpu.models.state import ClusterState
@@ -34,8 +35,8 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
     def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
         # (a) rack-awareness term, identical to RackAwareGoal
         excess = relu((agg.part_rack_count - 1).astype(jnp.float32))
-        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
-        out = excess.sum() / n_valid
+        n_valid = gsum(state.replica_valid).astype(jnp.float32) + 1e-12
+        out = gsum(excess) / n_valid
 
         # (b) per-position evenness: count replicas at position q per broker
         B = state.shape.B
@@ -44,7 +45,7 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
         seg = jnp.where(
             state.replica_valid, pos * B + state.broker_segment_ids(), max_pos * B
         )
-        counts = jax.ops.segment_sum(
+        counts = gsegment_sum(
             state.replica_valid.astype(jnp.int32), seg, num_segments=max_pos * B + 1
         )[: max_pos * B].reshape(max_pos, B)
         mask = alive_mask(state)

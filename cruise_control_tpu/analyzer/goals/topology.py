@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from cruise_control_tpu.common.collectives import gsum
 from cruise_control_tpu.models.aggregates import BrokerAggregates
 from cruise_control_tpu.models.state import ClusterState
 from cruise_control_tpu.analyzer.goals.base import Goal, relu
@@ -27,9 +28,10 @@ class RackAwareGoal(Goal):
     hard = True
 
     def violation(self, state: ClusterState, agg: BrokerAggregates, constraint):
+        # part_rack_count rows are model-shard-local when sharding is on.
         excess = relu((agg.part_rack_count - 1).astype(jnp.float32))
-        n_valid = state.replica_valid.sum().astype(jnp.float32) + 1e-12
-        return excess.sum() / n_valid
+        n_valid = gsum(state.replica_valid).astype(jnp.float32) + 1e-12
+        return gsum(excess) / n_valid
 
 
 class IntraBrokerDiskCapacityGoal(Goal):

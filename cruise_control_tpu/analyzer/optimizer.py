@@ -146,6 +146,7 @@ class GoalOptimizer:
         config: OptimizerConfig = OptimizerConfig(),
         parallel_mode: str = "single",
         mesh_max_devices: int = 0,
+        model_shard_min_partitions: int = 0,
         balancedness_weights: tuple[float, float] = (1.1, 1.5),
         engine_cache_size: int = 8,
         sensors=None,
@@ -155,6 +156,7 @@ class GoalOptimizer:
         tracer=None,
         profiler_dir: str | None = None,
         prewarm_store=None,
+        peak_tracker=None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (candidate axis sharded over the mesh,
@@ -163,6 +165,14 @@ class GoalOptimizer:
         layer (parallel/mesh.py).  mesh_max_devices (config key
         tpu.mesh.max.devices) caps how many visible devices the mesh is
         built from; 0 (default) uses them all.
+
+        model_shard_min_partitions (config key
+        tpu.mesh.model.shard.min.partitions): real partition count at or
+        above which the mesh modes shard the flattened MODEL over the
+        model axis (parallel/model_shard.py) instead of replicating it —
+        per-chip model memory and per-step row FLOPs drop ~1/n with
+        byte-identical placements.  0 (default) keeps the replicated
+        model, which wins on collective volume for small clusters.
 
         balancedness_weights = (priority_weight, strictness_weight) for the
         0-100 balancedness score (reference AnalyzerConfig
@@ -206,7 +216,12 @@ class GoalOptimizer:
         programs through their warm pool, and `start_up()` replays the
         manifest so a restart's active buckets compile before the first
         proposal.  None (offline/test/ad-hoc optimizers) records and
-        loads nothing."""
+        loads nothing.
+
+        peak_tracker (common/profiling.PeakLiveBytesTracker): when bound,
+        every optimize records the post-run per-device live bytes into
+        the run's shape-bucket cell of the
+        `tpu.device.peak-live-bytes-by-bucket` collector."""
         import threading
 
         import jax
@@ -220,6 +235,12 @@ class GoalOptimizer:
                 f"mesh_max_devices must be >= 0, got {mesh_max_devices}"
             )
         self.mesh_max_devices = mesh_max_devices
+        if model_shard_min_partitions < 0:
+            raise ValueError(
+                f"model_shard_min_partitions must be >= 0, got "
+                f"{model_shard_min_partitions}"
+            )
+        self.model_shard_min_partitions = model_shard_min_partitions
         self.balancedness_weights = balancedness_weights
         self._grid_shape = parse_parallel_mode(parallel_mode)
         # device probing stays lazy for the single-device default: only the
@@ -257,6 +278,7 @@ class GoalOptimizer:
         self.supervisor = supervisor
         self.degraded_budget_s = degraded_budget_s
         self.prewarm_store = prewarm_store
+        self.peak_tracker = peak_tracker
         from cruise_control_tpu.common.trace import TRACER
 
         self.tracer = tracer if tracer is not None else TRACER
@@ -572,12 +594,14 @@ class GoalOptimizer:
                 state, self.chain, mesh=model_mesh(devices),
                 constraint=self.constraint, options=options, config=config,
                 bucket=self.shape_bucket,
+                model_shard_min_partitions=self.model_shard_min_partitions,
             )
         r, m = self._grid_shape
         return GridEngine(
             state, self.chain, mesh=grid_mesh(r, m, devices),
             constraint=self.constraint, options=options, config=config,
             bucket=self.shape_bucket,
+            model_shard_min_partitions=self.model_shard_min_partitions,
         )
 
     def optimize(
@@ -1057,6 +1081,8 @@ class GoalOptimizer:
             timing.update(cache_info)
         s = state.shape
         timing["bucket"] = dict(R=s.R, B=s.B, P=s.P, T=s.num_topics)
+        if self.peak_tracker is not None:
+            self.peak_tracker.record(f"R{s.R}-B{s.B}-P{s.P}")
         if self.sensors is not None and timing.get("mesh_shape"):
             # mesh-engine observability (docs/sensors.md "analyzer.mesh-*"):
             # shard count and per-round collective payload are the two
@@ -1068,6 +1094,14 @@ class GoalOptimizer:
             self.sensors.gauge("analyzer.mesh-collective-bytes").set(
                 int(timing.get("collective_bytes") or 0)
             )
+            if timing.get("model_sharded"):
+                # sharded-MODEL runs: the psum payload replacing the
+                # replicated model's gathers is the cost side of the
+                # ~1/n per-chip memory win (parallel/model_shard.py)
+                self.sensors.counter("analyzer.mesh-model-sharded-runs").inc()
+                self.sensors.gauge("analyzer.mesh-model-psum-bytes").set(
+                    int(timing.get("model_psum_bytes") or 0)
+                )
         final_checks = np.asarray(final_checks)
         if final_checks.any():
             bad = [n for n, c in zip(DEVICE_CHECKS, final_checks) if c]

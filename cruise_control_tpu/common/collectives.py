@@ -1,0 +1,88 @@
+"""Context-gated MODEL_AXIS reductions for the sharded-model engine.
+
+The goal chain and `compute_aggregates` reduce over the replica /
+partition axes.  When the flattened model is *sharded* over MODEL_AXIS
+(parallel/model_shard.py) those arrays are shard-local slices, so every
+such reduction must finish with a `psum` over the model axis to recover
+the global value.  When the model is replicated (plain engine, the
+replicated mesh mode) the very same code must lower to the very same
+HLO — the repo's byte-parity pins compare those programs bit-for-bit.
+
+Rather than thread an `axis_name` argument through every goal
+signature, the active model axis rides in a contextvar that is read at
+**trace time**: the engine brackets its `chain.evaluate` /
+`compute_aggregates` call sites with `model_axis_scope(axis)` *inside*
+the traced function, so the set/reset pair is synchronous within
+whichever thread (foreground or warm-pool background compile) is
+tracing.  With no active scope every helper is the identity
+composition — `gsum(x) == x.sum()` produces the identical jaxpr — so
+the unsharded path is untouched by construction.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+import jax
+import jax.numpy as jnp
+
+#: the MODEL_AXIS name active for the current trace, or None (replicated)
+_MODEL_AXIS: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "cruise_model_axis", default=None
+)
+
+
+def model_axis() -> str | None:
+    """The mesh axis name reductions must psum over, or None."""
+    return _MODEL_AXIS.get()
+
+
+@contextlib.contextmanager
+def model_axis_scope(axis: str | None):
+    """Trace-time bracket marking replica/partition arrays as sharded
+    over `axis`.  `axis=None` is a no-op scope (replicated model)."""
+    tok = _MODEL_AXIS.set(axis)
+    try:
+        yield
+    finally:
+        _MODEL_AXIS.reset(tok)
+
+
+def _psum(x, axis: str):
+    # jax.lax.psum rejects bool; route through int32 (exact: exactly one
+    # shard contributes a possibly-nonzero value per element).
+    if x.dtype == jnp.bool_:
+        return jax.lax.psum(x.astype(jnp.int32), axis).astype(jnp.bool_)
+    return jax.lax.psum(x, axis)
+
+
+def gsum(x):
+    """Global `x.sum()` over a (possibly model-sharded) replica/partition
+    array: shard-local sum + psum.  Identity with `.sum()` when no model
+    axis is active."""
+    s = x.sum()
+    axis = _MODEL_AXIS.get()
+    return s if axis is None else _psum(s, axis)
+
+
+def gsegment_sum(data, segment_ids, num_segments: int):
+    """Global `jax.ops.segment_sum` whose *segment ids* are global (e.g.
+    broker ids) but whose *data* rows are model-sharded: each shard
+    seg-sums its local rows, then the per-segment partials psum to the
+    global result.  Exact for ints; for floats the partial-sum order
+    differs from single-device (see parity-test quantization note)."""
+    part = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    axis = _MODEL_AXIS.get()
+    return part if axis is None else _psum(part, axis)
+
+
+def gscatter_rows(full):
+    """Reduce-scatter a `[rows, ...]` partial over the model axis and keep
+    only this shard's `rows / n` slice (used for the partition-indexed
+    `part_rack_count`, which stays sharded in the carry).  Identity when
+    no model axis is active.  `rows` must divide by the axis size."""
+    axis = _MODEL_AXIS.get()
+    if axis is None:
+        return full
+    return jax.lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)

@@ -145,6 +145,15 @@ def _analyzer_defs() -> ConfigDef:
              "from for sharded/grid parallel modes (0 = every visible "
              "device) — lets operators keep chips free for other tenants "
              "or pin a power-of-two shard count", in_range(lo=0), group=g)
+    d.define("tpu.mesh.model.shard.min.partitions", T.INT, 500_000, I.MEDIUM,
+             "partition count at which the mesh engine layer shards the "
+             "flattened model itself over the model axis (contiguous "
+             "replica/partition row blocks per chip, broker aggregates "
+             "psum-assembled) instead of replicating it — per-chip model "
+             "memory and per-step row FLOPs drop ~1/n while placements "
+             "stay byte-identical; below the threshold the replicated "
+             "model wins on collective volume (0 = never shard the model)",
+             in_range(lo=0), group=g)
     d.define("tpu.shape.bucket.enabled", T.BOOLEAN, True, I.MEDIUM,
              "round cluster-model shapes (replicas/brokers/partitions/"
              "topics/racks/hosts) up to geometric buckets so compiled "
@@ -1416,6 +1425,9 @@ class CruiseControlConfig(AbstractConfig):
 
     def mesh_max_devices(self) -> int:
         return self.get("tpu.mesh.max.devices")
+
+    def mesh_model_shard_min_partitions(self) -> int:
+        return self.get("tpu.mesh.model.shard.min.partitions")
 
     def device_supervisor(self, *, sensors=None, probe=None, tracer=None):
         """DeviceSupervisor from the tpu.supervisor.* keys; None when

@@ -15,6 +15,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from cruise_control_tpu.common.collectives import gscatter_rows, gsegment_sum
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.models.state import ClusterState
 
@@ -53,42 +54,51 @@ class BrokerAggregates:
 
 
 def compute_aggregates(state: ClusterState) -> BrokerAggregates:
+    # Replica rows may be a MODEL_AXIS shard-local slice (ids stay
+    # global): gsegment_sum finishes each broker-indexed reduction with
+    # a psum, and part_rack_count — the one partition-indexed output —
+    # reduce-scatters so the carry keeps only this shard's rows.  With
+    # no model axis in scope (common/collectives.py) both helpers are
+    # the identity composition and this function is byte-for-byte the
+    # single-device one.
     s = state.shape
     B, P = s.B, s.P
     seg = state.broker_segment_ids()  # [R], padding -> B overflow bucket
     valid = state.replica_valid
 
     load = state.replica_load  # [R, 4], already masked by valid
-    broker_load = jax.ops.segment_sum(load, seg, num_segments=B + 1)[:B]
+    broker_load = gsegment_sum(load, seg, num_segments=B + 1)[:B]
 
     ones = valid.astype(jnp.int32)
-    broker_replica_count = jax.ops.segment_sum(ones, seg, num_segments=B + 1)[:B]
+    broker_replica_count = gsegment_sum(ones, seg, num_segments=B + 1)[:B]
 
     leaders = (state.replica_is_leader & valid).astype(jnp.int32)
-    broker_leader_count = jax.ops.segment_sum(leaders, seg, num_segments=B + 1)[:B]
+    broker_leader_count = gsegment_sum(leaders, seg, num_segments=B + 1)[:B]
 
     pot = jnp.where(valid, state.replica_load_leader[:, Resource.NW_OUT], 0.0)
-    broker_potential_nw_out = jax.ops.segment_sum(pot, seg, num_segments=B + 1)[:B]
+    broker_potential_nw_out = gsegment_sum(pot, seg, num_segments=B + 1)[:B]
 
     lead_in = jnp.where(
         state.replica_is_leader & valid, state.replica_load_leader[:, Resource.NW_IN], 0.0
     )
-    broker_leader_bytes_in = jax.ops.segment_sum(lead_in, seg, num_segments=B + 1)[:B]
+    broker_leader_bytes_in = gsegment_sum(lead_in, seg, num_segments=B + 1)[:B]
 
     topic_seg = jnp.where(valid, state.replica_topic * B + state.replica_broker, s.num_topics * B)
-    broker_topic_count = jax.ops.segment_sum(
+    broker_topic_count = gsegment_sum(
         ones, topic_seg, num_segments=s.num_topics * B + 1
     )[: s.num_topics * B].reshape(s.num_topics, B)
 
     rack = state.broker_rack[state.replica_broker]  # [R]
     pr_seg = jnp.where(valid, state.replica_partition * s.num_racks + rack, P * s.num_racks)
-    part_rack_count = jax.ops.segment_sum(
-        ones, pr_seg, num_segments=P * s.num_racks + 1
-    )[: P * s.num_racks].reshape(P, s.num_racks)
+    part_rack_count = gscatter_rows(
+        jax.ops.segment_sum(
+            ones, pr_seg, num_segments=P * s.num_racks + 1
+        )[: P * s.num_racks].reshape(P, s.num_racks)
+    )
 
     D = s.max_disks_per_broker
     disk_seg = jnp.where(valid, state.replica_broker * D + state.replica_disk, B * D)
-    disk_load = jax.ops.segment_sum(
+    disk_load = gsegment_sum(
         jnp.where(valid, load[:, Resource.DISK], 0.0), disk_seg, num_segments=B * D + 1
     )[: B * D].reshape(B, D)
 

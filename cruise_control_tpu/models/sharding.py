@@ -1,0 +1,100 @@
+"""Partition-spec rules for sharding the flattened model over MODEL_AXIS.
+
+The flattened model (models/state.ClusterState inside
+analyzer/engine.EngineStatics) has three families of leaves:
+
+  * replica/partition-indexed   — O(R) / O(P) rows: placements, loads,
+    id columns, the partition->replica member table, the per-partition
+    rack census.  These are the memory at north-star scale (25k brokers
+    / 2M partitions => ~5M replica rows) and the arrays the sharded
+    mesh mode splits over MODEL_AXIS.
+  * broker/host/disk-indexed    — O(B) rows, thousands; replicated.
+  * scalars / tiny metadata     — replicated.
+
+`match_partition_rules` is the classic pjit-era helper (SNIPPETS.md
+[1]-[3]): an ordered (regex, PartitionSpec) table matched against the
+"/"-joined key path of every leaf, first match wins.  The tables below
+are the single source of truth consumed by parallel/mesh.py both for
+`jax.device_put` placement (wrapped into NamedSharding) and for the
+`shard_map` in/out specs of the device programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from cruise_control_tpu.models.state import ClusterShape
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        name = getattr(k, "name", None)
+        if name is None:
+            name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Map every leaf of `tree` to the PartitionSpec of the first rule
+    whose regex `search`es its "/"-joined key path; unmatched leaves get
+    the replicated spec `P()`.  Returns a same-structure pytree of
+    specs."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, _leaf in flat:
+        name = _path_str(path)
+        spec = P()
+        for pat, rule_spec in rules:
+            if re.search(pat, name):
+                spec = rule_spec
+                break
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def statics_partition_rules(model_axis: str):
+    """EngineStatics leaf -> spec: replica-row and partition-row leaves
+    shard over the model axis, broker/disk/host/scalar leaves replicate."""
+    return (
+        (r"state/replica_", P(model_axis)),
+        (r"(^|/)part_replicas$", P(model_axis)),
+        (r".", P()),
+    )
+
+
+def carry_partition_rules(restart_axis: str, model_axis: str):
+    """EngineCarry leaf -> spec with the leading per-restart block axis:
+    mutable replica placements and the partition rack census shard over
+    the model axis; broker aggregates and the PRNG key replicate across
+    it (every shard applies every accepted move's broker-side update)."""
+    return (
+        (r"(^|/)replica_(broker|is_leader|disk)$", P(restart_axis, model_axis)),
+        (r"(^|/)part_rack_count$", P(restart_axis, model_axis)),
+        (r".", P(restart_axis)),
+    )
+
+
+def shard_multiple_shape(shape: ClusterShape, n: int) -> ClusterShape:
+    """Round the replica and partition axes of an (already bucketed)
+    shape up to multiples of `n` so every MODEL_AXIS shard holds an
+    equal contiguous block.  Other axes are untouched — broker/topic/
+    rack/host leaves stay replicated."""
+    if n <= 1:
+        return shape
+
+    def up(v: int) -> int:
+        return ((int(v) + n - 1) // n) * n
+
+    return dataclasses.replace(
+        shape,
+        num_replicas=up(shape.num_replicas),
+        num_partitions=up(shape.num_partitions),
+    )

@@ -23,6 +23,7 @@ from cruise_control_tpu.parallel.mesh import (
     normalize_mesh,
     shard_map_compat,
 )
+from cruise_control_tpu.parallel.model_shard import ShardPlan
 from cruise_control_tpu.parallel.portfolio import portfolio_run
 from cruise_control_tpu.parallel.sharded import ShardedEngine
 
@@ -31,6 +32,7 @@ __all__ = [
     "MODEL_AXIS",
     "MeshEngine",
     "RESTART_AXIS",
+    "ShardPlan",
     "ShardedEngine",
     "default_mesh",
     "grid_mesh",

@@ -48,6 +48,7 @@ class GridEngine(MeshEngine):
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
         bucket=None,
+        model_shard_min_partitions: int = 0,
     ):
         if tuple(mesh.axis_names) != (RESTART_AXIS, MODEL_AXIS):
             raise ValueError(
@@ -56,4 +57,5 @@ class GridEngine(MeshEngine):
         super().__init__(
             state, chain, mesh=mesh, constraint=constraint, options=options,
             config=config, bucket=bucket,
+            model_shard_min_partitions=model_shard_min_partitions,
         )

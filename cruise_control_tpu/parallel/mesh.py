@@ -40,13 +40,24 @@ chain, HBM holds ONE placement copy.  At n=1 the traced program IS the
 plain fused program (the slice is the identity and no collective is
 emitted), which is what keeps the sharded n=1 overhead under 10%.
 
-Replaced design (rounds 1-5): ``parallel/sharded.py`` sharded the MODEL
-replica/partition axes with per-shard RNG streams, which made 1-vs-N
-parity impossible, ran ~22% slower than the plain engine at n=1 (VERDICT
-r5 item 4), and wedged the 8-device dryrun.  Replica-axis sharding for
-models exceeding one chip's HBM remains future work (ROADMAP item 1) —
-at north-star scale the model is tens of MB, so candidate throughput,
-not HBM, is the axis that pays.
+MODEL_AXIS additionally has a genuinely SHARDED-MODEL mode
+(`model_shard_min_partitions` > 0 and the real partition count at or
+above it): the replica/partition-indexed leaves of the statics and the
+carry are partitioned over MODEL_AXIS in contiguous row blocks
+(``models/sharding.py`` partition-rule tables drive both `device_put`
+placement and the shard_map in/out specs), candidate row gathers resolve
+by ownership psums, and the goal chain's segment sums run shard-local
+with one psum (``parallel/model_shard._ModelShardEngine``).  Per-chip
+model memory and per-step O(R)/O(P) FLOPs drop ~1/n — the mode that
+carries 25k brokers / 2M partitions on an 8-chip mesh.  Unlike the
+replaced rounds-1-5 ``parallel/sharded.py`` design (per-shard RNG
+streams, no 1-vs-N parity, ~22% slower at n=1 — VERDICT r5 item 4), the
+sharded-model mode keeps every RNG draw replicated, so placements stay
+byte-identical to the replicated mesh whenever the psum'd objective
+partials are exact (integer-quantized loads; float loads track to ulp).
+Below the threshold the replicated candidate-sharding mode remains the
+default — at small scale the model is tens of MB and candidate
+throughput, not HBM, is the axis that pays.
 
 Reference analog: none — the reference optimizer is a single-threaded
 Java loop (analyzer/goals/AbstractGoal.java:66-107).
@@ -73,7 +84,14 @@ from cruise_control_tpu.analyzer.objective import GoalChain
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
 from cruise_control_tpu.common.device_watchdog import device_op
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
+from cruise_control_tpu.models.sharding import (
+    carry_partition_rules,
+    match_partition_rules,
+    shard_multiple_shape,
+    statics_partition_rules,
+)
 from cruise_control_tpu.models.state import ClusterState, ShapeBucketPolicy
+from cruise_control_tpu.parallel.model_shard import _ModelShardEngine
 
 RESTART_AXIS = "restart"
 MODEL_AXIS = "model"
@@ -209,9 +227,18 @@ class MeshEngine:
         options: OptimizationOptions = DEFAULT_OPTIONS,
         config: OptimizerConfig = OptimizerConfig(),
         bucket: ShapeBucketPolicy | None = None,
+        model_shard_min_partitions: int = 0,
     ):
         self.mesh = normalize_mesh(mesh if mesh is not None else model_mesh())
         self._bucket = bucket if bucket is not None and bucket.enabled else None
+        # sharded-model mode gate: opt-in threshold on the REAL partition
+        # count (pre-padding — padding must not flip the mode between
+        # generations of the same cluster) and a model axis to shard over
+        self.model_sharded = (
+            model_shard_min_partitions > 0
+            and int(self.mesh.shape[MODEL_AXIS]) > 1
+            and int(state.shape.P) >= int(model_shard_min_partitions)
+        )
         self.global_state = state
         engine = Engine(
             self._padded(state), chain, constraint, options, config
@@ -225,6 +252,7 @@ class MeshEngine:
         self = object.__new__(cls)
         self.mesh = normalize_mesh(mesh)
         self._bucket = None
+        self.model_sharded = False  # the wrapped engine's shape is as-is
         self.global_state = engine.state
         self._finish_init(engine)
         return self
@@ -241,24 +269,64 @@ class MeshEngine:
                 "OptimizerConfig.fused_rounds=False has no mesh variant; "
                 "the mesh engine always runs the fused schedule"
             )
-        self._twin = _ShardStepEngine(engine, self.n)
+        self._twin = self._make_twin(engine)
         #: diagnostics of the most recent COMPLETED run (None before/during)
         self.last_info: dict | None = None
         self._warm_futures: dict | None = None
         self._coll_bytes: int | None = None
+        self._build_specs()
         self._place_statics()
         self._build_jits()
+
+    def _make_twin(self, engine: Engine):
+        if self.model_sharded:
+            return _ModelShardEngine(engine, self.n)
+        return _ShardStepEngine(engine, self.n)
+
+    def _build_specs(self) -> None:
+        """shard_map in/out spec trees for the statics and the (blocked)
+        carry.  Replicated modes use the pytree-prefix specs (P() statics,
+        P(RESTART_AXIS) carry) — the pre-sharding programs verbatim; the
+        sharded-model mode expands them per-leaf from the models/sharding
+        rule tables (the leading restart block axis does not change the
+        carry's pytree structure, so the rules match unchanged)."""
+        if not self.model_sharded:
+            self._sx_specs = P()
+            self._carry_specs = P(RESTART_AXIS)
+            return
+        self._sx_specs = match_partition_rules(
+            statics_partition_rules(MODEL_AXIS), self.engine.statics
+        )
+        carry_av = jax.eval_shape(
+            self.engine._init_impl,
+            self.engine.statics_avals(),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        )
+        self._carry_specs = match_partition_rules(
+            carry_partition_rules(RESTART_AXIS, MODEL_AXIS), carry_av
+        )
 
     # ------------------------------------------------------------------
     # data binding
     # ------------------------------------------------------------------
 
     def _padded(self, state: ClusterState) -> ClusterState:
-        if self._bucket is None:
+        shape = state.shape
+        if self._bucket is not None:
+            shape = self._bucket.bucket_shape(shape)
+        if self.model_sharded:
+            # equal contiguous row blocks per shard (on TOP of the bucket
+            # shape, so bucketed rebinds stay churn-stable too)
+            shape = shard_multiple_shape(shape, self.n_model)
+        if shape == state.shape:
             return state
         from cruise_control_tpu.models.builder import pad_state
 
-        return pad_state(state, self._bucket.bucket_shape(state.shape))
+        return pad_state(state, shape)
+
+    @property
+    def n_model(self) -> int:
+        return int(self.mesh.shape[MODEL_AXIS])
 
     def _place_statics(self) -> None:
         """Mesh-replicated copies of the engine statics.  Explicit layout:
@@ -266,10 +334,19 @@ class MeshEngine:
         single-device program COMMITTED the arrays to one device (the r4
         `portfolio.py:99` devices-mismatch crash); device_put with the
         mesh sharding is correct for committed and uncommitted inputs
-        alike."""
-        self.statics = jax.device_put(
-            self.engine.statics, NamedSharding(self.mesh, P())
-        )
+        alike.  In sharded-model mode the placement follows the per-leaf
+        partition-rule specs instead of blanket replication."""
+        if self.model_sharded:
+            shardings = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._sx_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self.statics = jax.device_put(self.engine.statics, shardings)
+        else:
+            self.statics = jax.device_put(
+                self.engine.statics, NamedSharding(self.mesh, P())
+            )
 
     def rebind(
         self, state: ClusterState, options: OptimizationOptions = DEFAULT_OPTIONS
@@ -280,11 +357,10 @@ class MeshEngine:
         (the optimizer's signal to build a fresh engine)."""
         self.engine.rebind(self._padded(state), options)
         # the twin snapshot shares the engine's attributes by reference;
-        # re-sync it so it can never pin a previous generation's statics
+        # rebuild it so it can never pin a previous generation's statics
         # (the traced programs read statics from their argument, so this
         # is about buffer lifetime, not numerics)
-        self._twin.__dict__.update(self.engine.__dict__)
-        self._twin._mesh_n = self.n
+        self._twin = self._make_twin(self.engine)
         self.global_state = state
         self._place_statics()
         return self
@@ -319,7 +395,7 @@ class MeshEngine:
         self._jit_init = jax.jit(
             shard_map_compat(
                 self._init_fn, self.mesh,
-                in_specs=(P(), spec_r), out_specs=spec_r,
+                in_specs=(self._sx_specs, spec_r), out_specs=self._carry_specs,
             )
         )
         # the fused whole-anneal program; the carry is DONATED so each
@@ -327,7 +403,8 @@ class MeshEngine:
         self._jit_run = jax.jit(
             shard_map_compat(
                 self._run_fn, self.mesh,
-                in_specs=(P(), spec_r), out_specs=(spec_r, spec_r, spec_r),
+                in_specs=(self._sx_specs, self._carry_specs),
+                out_specs=(self._carry_specs, spec_r, spec_r),
             ),
             donate_argnums=(1,),
         )
@@ -421,13 +498,21 @@ class MeshEngine:
         """Bytes of candidate columns each device holds after the per-step
         gather (the run's ONLY collective): sum over exchanged leaves of
         n*ceil(K/n) padded rows.  0 on a 1-shard mesh (no collective is
-        emitted).  Computed abstractly (eval_shape) — no device work."""
+        emitted).  Computed abstractly (eval_shape) — no device work.
+        In sharded-model mode this is instead the twin's analytic
+        ownership-psum byte count (there is no candidate gather)."""
         if self._coll_bytes is None:
-            self._coll_bytes = self._compute_collective_bytes()
+            self._coll_bytes = (
+                self._twin.psum_bytes_per_step()
+                if self.model_sharded
+                else self._compute_collective_bytes()
+            )
         return self._coll_bytes
 
     @property
     def collective_bytes_per_round(self) -> int:
+        if self.model_sharded:
+            return self._twin.psum_bytes_per_round()
         return self.collective_bytes_per_step * self.engine.config.steps_per_round
 
     def _compute_collective_bytes(self) -> int:
@@ -482,8 +567,10 @@ class MeshEngine:
                 self._jit_run_verbose = jax.jit(
                     shard_map_compat(
                         self._run_verbose_fn, self.mesh,
-                        in_specs=(P(), P(RESTART_AXIS)),
-                        out_specs=(P(RESTART_AXIS),) * 3,
+                        in_specs=(self._sx_specs, self._carry_specs),
+                        out_specs=(
+                            self._carry_specs, P(RESTART_AXIS), P(RESTART_AXIS)
+                        ),
                     ),
                     donate_argnums=(1,),
                 )
@@ -508,6 +595,11 @@ class MeshEngine:
             mesh_shape=[self.n_restarts, self.n],
             collective_bytes=self.collective_bytes_per_round,
         )
+        if self.model_sharded:
+            # only present when sharded — replicated-mode history records
+            # (and everything downstream that hashes them) stay unchanged
+            timing["model_sharded"] = True
+            timing["model_psum_bytes"] = int(self._twin.psum_bytes_per_round())
         if cfg.diagnostics:
             # convergence summary with the SAME aggregation as the
             # per-round history records above: COUNT fields sum over all
@@ -589,8 +681,10 @@ class MeshEngine:
             self._jit_schedule = jax.jit(
                 shard_map_compat(
                     self._schedule_fn, self.mesh,
-                    in_specs=(P(), P(RESTART_AXIS), P()),
-                    out_specs=(P(RESTART_AXIS),) * 3,
+                    in_specs=(self._sx_specs, self._carry_specs, P()),
+                    out_specs=(
+                        self._carry_specs, P(RESTART_AXIS), P(RESTART_AXIS)
+                    ),
                 ),
                 donate_argnums=(1,),
             )

@@ -14,8 +14,11 @@ parallel/mesh.py, shared verbatim with grid.py and portfolio.py.  The
 pre-round-6 replica/partition-axis sharding implementation that used to
 live here (per-shard RNG streams, psum'd aggregate refresh) was replaced —
 it made 1-vs-N parity impossible and ran ~22% slower than the plain engine
-at n=1 (VERDICT r5 item 4); replica-axis sharding for models exceeding one
-chip's HBM remains future work (ROADMAP item 1).
+at n=1 (VERDICT r5 item 4).  Replica/partition-axis sharding now exists as
+the mesh engine's sharded-MODEL mode (parallel/model_shard.py +
+``MeshEngine(model_shard_min_partitions=...)``), which keeps every RNG
+draw replicated and resolves row gathers by ownership psums — parity
+preserved, per-chip model memory ~1/n.
 
 Reference analog: none — the reference optimizer is a single-threaded Java
 loop (analyzer/goals/AbstractGoal.java:66-107).

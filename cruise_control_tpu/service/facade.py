@@ -108,7 +108,7 @@ class AnalyzerCore:
         # + per-device labeled collector, scrapeable via GET /metrics
         from cruise_control_tpu.common.profiling import register_device_gauges
 
-        register_device_gauges(self.sensors)
+        self.peak_tracker = register_device_gauges(self.sensors)
         #: opt-in jax.profiler dump dir (config tpu.profiler.*)
         self.profiler_dir = (
             config.get("tpu.profiler.dump.dir")
@@ -226,6 +226,7 @@ class AnalyzerCore:
             config=config.optimizer_config(),
             parallel_mode=config.parallel_mode(),
             mesh_max_devices=config.mesh_max_devices(),
+            model_shard_min_partitions=config.mesh_model_shard_min_partitions(),
             balancedness_weights=self.balancedness_weights,
             engine_cache_size=config.get("tpu.engine.cache.size"),
             sensors=self.sensors,
@@ -235,6 +236,7 @@ class AnalyzerCore:
             tracer=self.tracer,
             profiler_dir=self.profiler_dir,
             prewarm_store=self.prewarm_store,
+            peak_tracker=self.peak_tracker,
         )
         # per-bucket cold-start attribution as labeled /metrics series
         # (only the core's long-lived default optimizer feeds it; ad-hoc

@@ -403,9 +403,44 @@ def test_child_failure_fields_structured(tmp_path):
     ]
     assert fields["in_flight"][0]["slice"] == 7
     assert "wall_age_s" in fields["in_flight"][0]
+    assert fields["spool_configured"] is True
     # unreadable spool dir: empty diagnosis, no exception
     empty = g._child_failure_fields(None, None, str(tmp_path / "absent"))
     assert empty["blackbox_tail"] == [] and empty["in_flight"] == []
+    assert empty["spool_configured"] is False
+
+
+def test_child_failure_fields_empty_spool_vs_never_started(tmp_path):
+    """'No data' must be distinguishable from 'recorder never started':
+    a spool FILE with zero records (the child configured the recorder,
+    then hung before the first dispatch) reads spool_configured=True with
+    structured in_flight=[]; a spool DIR with no spool files (the child
+    died before RECORDER.configure — import/platform-init hang) reads
+    spool_configured=False, in_flight still structurally []."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.remove(REPO)
+    # recorder configured, zero records written
+    configured = tmp_path / "configured"
+    configured.mkdir()
+    (configured / "spool-123.jsonl").write_text("")
+    fields = g._child_failure_fields(None, None, str(configured))
+    assert fields["spool_configured"] is True
+    assert fields["blackbox_tail"] == []
+    assert fields["in_flight"] == []
+    # spool dir minted by the parent, child never reached configure
+    never = tmp_path / "never"
+    never.mkdir()
+    fields = g._child_failure_fields(None, None, str(never))
+    assert fields["spool_configured"] is False
+    assert fields["blackbox_tail"] == []
+    assert fields["in_flight"] == []
+    # no spool dir at all (recorder disabled by configuration)
+    fields = g._child_failure_fields(None, None, None)
+    assert fields["spool_configured"] is False
+    assert fields["in_flight"] == []
 
 
 @pytest.mark.slow
@@ -429,5 +464,12 @@ def test_dryrun_timeout_verdict_embeds_spool(monkeypatch, capsys):
         [l for l in out.splitlines() if '"dryrun_multichip"' in l][-1]
     )
     assert verdict["value"] == -1.0
-    for key in ("stdout_tail", "stderr_tail", "blackbox_tail", "in_flight"):
+    for key in ("stdout_tail", "stderr_tail", "blackbox_tail", "in_flight",
+                "spool_configured"):
         assert key in verdict, f"timeout verdict missing {key}"
+    # the structured fields are typed even when the 3 s budget killed the
+    # child before anything was recorded — "no data" stays machine-readable
+    assert isinstance(verdict["in_flight"], list)
+    assert isinstance(verdict["spool_configured"], bool)
+    if not verdict["blackbox_tail"]:
+        assert verdict["in_flight"] == []
