@@ -741,6 +741,252 @@ def mesh_smoke() -> int:
     return 0 if ok else 1
 
 
+def mesh_chaos(smoke_mode: bool = False) -> int:
+    """`bench.py --mesh-chaos [--smoke]`: the mesh fault-tolerance gate —
+    device loss injected MID-ANNEAL on a virtual 8-device CPU mesh.
+
+    Exercises the full degrade-and-resume ladder (analyzer/optimizer.py
+    `_optimize_mesh_ft` + parallel/ft.py): a DEVICE_LOST-shaped failure
+    surfaces at a slice boundary two slices into a supervised sharded
+    anneal, the per-device probe fan-out pins it on the injected chip,
+    and the run resumes on the 4 survivors from the last slice-boundary
+    carry checkpoint.  Gates:
+
+      * the chaos run completes NON-degraded at reduced width, resumed
+        (not restarted) from the checkpointed round, with the lost chip
+        named in the result's mesh_ft history record;
+      * its placements are byte-identical to a clean full-width run —
+        the replicated mesh's width-independence (full-K draws before
+        slicing) makes reduced-width resume exact, so this one equality
+        subsumes "byte-equal a clean reduced-width run from that
+        checkpoint";
+      * exactly ONE MESH_DEGRADED event per degrade episode (drained via
+        poll_event; the episode stays open at reduced width);
+      * the checkpoint-OFF path (tpu.mesh.ft.checkpoint.every.slices=0)
+        is byte-for-byte the pre-FT behavior with an IDENTICAL dispatch
+        stream — zero snapshot dispatches, zero extra anything.
+
+    Checkpoint overhead (snapshot wall vs anneal wall) is reported, not
+    gated — CPU CI timing is noise; the correctness gates above are not.
+    Self-provisions 8 virtual devices exactly like `--mesh-smoke`.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 8:
+        if os.environ.get("MESH_CHAOS_CHILD"):
+            print(
+                "mesh-chaos: forced-CPU child still has "
+                f"{len(jax.devices())} devices, need 8",
+                file=sys.stderr,
+            )
+            return 1
+        import subprocess
+
+        env = dict(os.environ)
+        env.update(
+            MESH_CHAOS_CHILD="1",
+            GRAFT_FORCE_CPU="1",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        )
+        argv = ["--mesh-chaos"] + (["--smoke"] if smoke_mode else [])
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv, env=env
+        ).returncode
+
+    import threading
+
+    from cruise_control_tpu.analyzer import GoalOptimizer, OptimizerConfig
+    from cruise_control_tpu.analyzer.engine import SegmentContext, segmented_execution
+    from cruise_control_tpu.common.device_watchdog import DeviceSupervisor
+    from cruise_control_tpu.common.dispatch import dispatch_meter
+    from cruise_control_tpu.common.sensors import SensorRegistry
+    from cruise_control_tpu.parallel.ft import MeshFtController
+    from cruise_control_tpu.testing import faults
+    from cruise_control_tpu.testing.fixtures import RandomClusterSpec, random_cluster_fast
+
+    spec = (
+        RandomClusterSpec(
+            num_brokers=24, num_partitions=1500, num_racks=6, num_topics=12, skew=1.0
+        )
+        if smoke_mode
+        else RandomClusterSpec(
+            num_brokers=48, num_partitions=6000, num_racks=6, num_topics=24, skew=1.0
+        )
+    )
+    state = random_cluster_fast(spec, seed=7)
+    cfg = OptimizerConfig(
+        num_candidates=512, leadership_candidates=128, swap_candidates=64,
+        steps_per_round=16, num_rounds=4 if smoke_mode else 6, seed=0,
+    )
+
+    def make_opt(ft, sensors=None):
+        return GoalOptimizer(
+            config=cfg,
+            parallel_mode="sharded",
+            supervisor=DeviceSupervisor(
+                op_timeout_s=600.0, max_retries=0, sensors=sensors
+            ),
+            mesh_ft=ft,
+            sensors=sensors,
+        )
+
+    def timed(opt, run_state):
+        t0 = time.monotonic()
+        res = opt.optimize(run_state)
+        return res, round(time.monotonic() - t0, 3)
+
+    def same_result(a, b) -> bool:
+        return float(a.objective_after) == float(b.objective_after) and all(
+            bool(
+                (
+                    np.asarray(getattr(a.state_after, f))
+                    == np.asarray(getattr(b.state_after, f))
+                ).all()
+            )
+            for f in ("replica_broker", "replica_is_leader", "replica_disk")
+        )
+
+    out: dict = {}
+
+    # -- baseline: FT disabled = the pre-FT supervised mesh path --------
+    opt_pre = make_opt(MeshFtController(enabled=False))
+    with dispatch_meter() as m_pre:
+        base, base_wall = timed(opt_pre, state)
+    out["baseline"] = dict(
+        wall_s=base_wall, objective=float(base.objective_after),
+        dispatches=dict(m_pre.counts),
+    )
+
+    # -- checkpoint-off parity: FT on, snapshots off — byte-for-byte ----
+    opt_off = make_opt(MeshFtController(checkpoint_every_slices=0))
+    with dispatch_meter() as m_off:
+        off, off_wall = timed(opt_off, state)
+    off_parity = same_result(base, off)
+    off_dispatch_parity = m_off.counts == m_pre.counts
+    off_zero_snapshots = (
+        m_off.counts.get("mesh.snapshot", 0) == 0
+        and m_off.counts.get("engine.snapshot", 0) == 0
+    )
+    out["checkpoint_off"] = dict(
+        wall_s=off_wall, byte_parity=off_parity,
+        dispatch_parity=off_dispatch_parity,
+        zero_snapshot_dispatches=off_zero_snapshots,
+        dispatches=dict(m_off.counts),
+    )
+
+    # -- segmented clean run, checkpoints ON: overhead report ----------
+    reg_clean = SensorRegistry()
+    opt_ckpt = make_opt(
+        MeshFtController(checkpoint_every_slices=1, sensors=reg_clean),
+        sensors=reg_clean,
+    )
+    with segmented_execution(SegmentContext(0.0)):
+        ckpt, ckpt_wall = timed(opt_ckpt, state)
+    ckpt_timing = next(
+        (h for h in ckpt.history if h.get("timing") and h.get("segmented")), {}
+    )
+    ckpt_parity = same_result(base, ckpt)
+    snapshots_taken = int(ckpt_timing.get("snapshots", 0))
+    snapshot_s = float(ckpt_timing.get("snapshot_s", 0.0))
+    out["checkpoint_on"] = dict(
+        wall_s=ckpt_wall, byte_parity=ckpt_parity,
+        segments=ckpt_timing.get("segments"),
+        snapshots=snapshots_taken,
+        snapshot_s=snapshot_s,
+        overhead_vs_baseline=round(ckpt_wall / max(base_wall, 1e-9), 4),
+    )
+
+    # -- chaos: device 6 dies at the second slice boundary -------------
+    LOST = 6
+    reg = SensorRegistry()
+    ft = MeshFtController(checkpoint_every_slices=1, sensors=reg)
+    opt = make_opt(ft, sensors=reg)
+    tripped = threading.Event()
+    boundaries = {"n": 0}
+
+    def chk():
+        # the scheduler's between-slice pause callback doubles as the
+        # injection point: two slices in, the next mesh dispatch would
+        # fail — surface the backend's DEVICE_LOST shape right here
+        boundaries["n"] += 1
+        if boundaries["n"] == 2:
+            tripped.set()
+            raise faults.device_lost_error("mesh.run", LOST)
+
+    def probe_effect(op, fn, args, kwargs):
+        # latched like testing.faults.device_loss: once the chip is gone
+        # its attribution probe fails too, every other chip's passes
+        if tripped.is_set() and getattr(args[0], "id", None) == LOST:
+            raise faults.device_lost_error(op, LOST)
+        return fn(*args, **kwargs)
+
+    with faults.device_fault(
+        probe_effect, ops=(faults.DEVICE_PROBE_OP,)
+    ) as plog, segmented_execution(SegmentContext(0.0, chk)):
+        chaos, chaos_wall = timed(opt, state)
+
+    ft_rec = next(
+        (h for h in reversed(chaos.history) if h.get("mesh_ft")), {}
+    )
+    chaos_timing = next(
+        (h for h in chaos.history if h.get("timing") and h.get("segmented")), {}
+    )
+    event = ft.poll_event()
+    event_drained_once = event is not None and ft.poll_event() is None
+    resumes = getattr(reg.get("analyzer.mesh-ft.resumes"), "count", 0)
+    device_lost = getattr(reg.get("analyzer.mesh-ft.device-lost"), "count", 0)
+    chaos_ok = (
+        not chaos.degraded
+        and ft_rec.get("resumed") is True
+        and ft_rec.get("width") == 4
+        and ft_rec.get("full_width") == 8
+        and ft_rec.get("lost_devices") == [LOST]
+        and int(ft_rec.get("resumed_from_round") or 0) >= 1
+        and chaos_timing.get("resumed_from_round") == ft_rec.get("resumed_from_round")
+        and ft.episodes == 1
+        and event_drained_once
+        and event.get("failure_class") == "device_lost"
+        and ft.episode_open  # still at reduced width: not healed yet
+        and resumes == 1
+        and device_lost >= 1
+    )
+    chaos_parity = same_result(base, chaos)
+    out["chaos"] = dict(
+        wall_s=chaos_wall,
+        byte_parity_vs_clean=chaos_parity,
+        resumed_from_round=ft_rec.get("resumed_from_round"),
+        lost_devices=ft_rec.get("lost_devices"),
+        width=ft_rec.get("width"),
+        episodes=ft.episodes,
+        event=event,
+        probes=dict(plog.fired),
+        degrade_contract=chaos_ok,
+        mesh_ft_state=ft.state_json(),
+        sensors=reg.snapshot(),
+    )
+
+    ok = (
+        off_parity and off_dispatch_parity and off_zero_snapshots
+        and ckpt_parity and snapshots_taken >= 1
+        and chaos_ok and chaos_parity
+    )
+    _emit(
+        metric="mesh_chaos",
+        value=chaos_wall,
+        unit="s",
+        vs_baseline=round(chaos_wall / max(base_wall, 1e-9), 4),
+        n_devices=8,
+        **out,
+        ok=ok,
+    )
+    return 0 if ok else 1
+
+
 MESH_NORTH_STAR_SPEC = dict(
     num_brokers=25_000,
     num_racks=100,
@@ -2250,6 +2496,8 @@ def main():
         sys.exit(ha_smoke())
     if "--mesh-smoke" in sys.argv:
         sys.exit(mesh_smoke())
+    if "--mesh-chaos" in sys.argv:
+        sys.exit(mesh_chaos("--smoke" in sys.argv))
     if "--mesh" in sys.argv:
         sys.exit(mesh("--smoke" in sys.argv))
     if "--trace-overhead" in sys.argv:

@@ -72,6 +72,7 @@ import contextlib
 import contextvars
 import dataclasses
 import logging
+import threading
 import time
 from functools import partial
 
@@ -612,6 +613,34 @@ FULL_CHECK_BUDGET = 2
 SEGMENT_MAX_ROUNDS = 64
 
 
+def snapshot_host_tree(tree):
+    """Device->host fetch that OWNS its memory.  `jax.device_get` alone is
+    not a snapshot: on the CPU backend it returns zero-copy numpy views of
+    the device buffers, and the slice programs donate their carry — the
+    next slice dispatch reuses that memory and silently rewrites the
+    "checkpoint" after capture.  np.array(copy=True) pins the bytes."""
+    return jax.tree.map(lambda x: np.array(x, copy=True), jax.device_get(tree))
+
+
+@dataclasses.dataclass
+class CarryCheckpoint:
+    """Host-side snapshot of a segmented anneal at a slice boundary —
+    everything a resume needs to continue the remaining round schedule
+    byte-identically: the next absolute round index, the full scan state
+    (carry + seg tuple) as host numpy trees, and the per-round ys rows
+    already fetched.  Captured while the device is idle (the slice
+    boundary IS a blocking sync), so the copy races nothing; restoring
+    onto a DIFFERENT mesh width is just `device_put` under the new mesh's
+    shardings — the host trees carry no placement."""
+
+    base: int
+    carry: object
+    seg: tuple
+    ys_parts: list
+    n_chains: int = 1
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
 class SegmentContext:
     """Preemptible-execution request for one fused anneal (the device
     scheduler's bounded-wall preemption, fleet/scheduler.py).
@@ -623,13 +652,90 @@ class SegmentContext:
     scheduler uses it to pause this run while an URGENT request takes the
     device, so an urgent anneal never waits on more than ONE slice of
     background work.  The callback may block; when it returns, the run
-    resumes from the carried scan state, byte-identically."""
+    resumes from the carried scan state, byte-identically.
 
-    __slots__ = ("slice_budget_s", "checkpoint")
+    Mesh fault tolerance (`tpu.mesh.ft.*`) rides the same boundaries:
+    with `snapshot_every` > 0 and a `snapshot_sink`, every Nth slice
+    boundary captures a host-side CarryCheckpoint (via the engine-supplied
+    `capture` thunk) and hands it to the sink on a background thread —
+    bounded to ONE in-flight persist (a due snapshot is skipped, not
+    queued, while the previous one is still persisting).  Capture wall
+    feeds `checkpoint_clock` so the supervisor excludes it from the hang
+    budget like pause clocks.  `snapshot_every=0` (the default) is
+    byte-for-byte today's behavior: `offer_snapshot` returns on one
+    predicate with zero extra device work."""
 
-    def __init__(self, slice_budget_s: float, checkpoint=None):
+    __slots__ = (
+        "slice_budget_s", "checkpoint", "snapshot_every", "snapshot_sink",
+        "checkpoint_clock", "snapshots_taken", "snapshots_skipped",
+        "snapshot_seconds", "_snapshot_boundary", "_snapshot_worker",
+        "_snapshot_lock",
+    )
+
+    def __init__(
+        self,
+        slice_budget_s: float,
+        checkpoint=None,
+        *,
+        snapshot_every: int = 0,
+        snapshot_sink=None,
+        checkpoint_clock=None,
+    ):
         self.slice_budget_s = slice_budget_s
         self.checkpoint = checkpoint
+        self.snapshot_every = int(snapshot_every)
+        self.snapshot_sink = snapshot_sink
+        self.checkpoint_clock = checkpoint_clock
+        self.snapshots_taken = 0
+        self.snapshots_skipped = 0
+        self.snapshot_seconds = 0.0
+        self._snapshot_boundary = 0
+        self._snapshot_worker = None
+        self._snapshot_lock = threading.Lock()
+
+    def offer_snapshot(self, capture) -> None:
+        """Engine hook at a slice boundary (device idle): maybe capture a
+        CarryCheckpoint via `capture()` and persist it in the background."""
+        if self.snapshot_every <= 0 or self.snapshot_sink is None:
+            return
+        with self._snapshot_lock:
+            self._snapshot_boundary += 1
+            if self._snapshot_boundary % self.snapshot_every:
+                return
+            worker = self._snapshot_worker
+            if worker is not None and worker.is_alive():
+                # one in-flight snapshot: skip, never queue — a slow sink
+                # must not stack copies of a 500k-replica carry
+                self.snapshots_skipped += 1
+                return
+            t0 = time.monotonic()
+            payload = capture()
+            sink = self.snapshot_sink
+
+            def persist():
+                try:
+                    sink(payload)
+                except Exception:  # noqa: BLE001 — checkpointing must never
+                    # take down the run it protects
+                    log.warning("carry snapshot sink failed", exc_info=True)
+
+            worker = threading.Thread(
+                target=persist, daemon=True, name="carry-snapshot"
+            )
+            self._snapshot_worker = worker
+            worker.start()
+            dt = time.monotonic() - t0
+            self.snapshots_taken += 1
+            self.snapshot_seconds += dt
+            if self.checkpoint_clock is not None:
+                self.checkpoint_clock.add(dt)
+
+    def wait_snapshot(self, timeout_s: float = 10.0) -> None:
+        """Block until any in-flight persist finishes (run teardown /
+        tests) — never raises."""
+        worker = self._snapshot_worker
+        if worker is not None:
+            worker.join(timeout_s)
 
 
 #: ambient segmented-execution request, set by the device scheduler
@@ -650,8 +756,10 @@ def current_segment_context() -> SegmentContext | None:
 @contextlib.contextmanager
 def segmented_execution(ctx: SegmentContext):
     """Run the enclosed dispatches in wall-bounded preemptible slices.
-    Only the single-device fused path honors it (mesh programs cannot be
-    split mid-collective); everything else ignores the context."""
+    The single-device fused path and the mesh layer's fused path
+    (parallel/mesh.py `_run_segmented`) honor it — a mesh slice is a
+    whole shard_map program, never a split collective; everything else
+    ignores the context."""
     token = _SEGMENT_CTX.set(ctx)
     try:
         yield
@@ -3079,6 +3187,22 @@ class Engine:
                 L *= 2
             if seg_ctx.checkpoint is not None:
                 seg_ctx.checkpoint()
+            # fault-tolerance carry snapshot: the device is idle (the
+            # sync above) and carry/seg are not yet donated into the
+            # next slice, so the host copy races nothing.  A no-op
+            # single predicate when tpu.mesh.ft.checkpoint.every.slices
+            # is 0.
+            def _capture(base=base, carry=carry, seg=seg, parts=ys_parts):
+                count_dispatch("engine.snapshot")
+                return CarryCheckpoint(
+                    base=int(base),
+                    carry=snapshot_host_tree(carry),
+                    seg=snapshot_host_tree(seg),
+                    ys_parts=[dict(p) for p in parts],
+                    n_chains=1,
+                )
+
+            seg_ctx.offer_snapshot(_capture)
         ys = {
             k: np.concatenate([p[k] for p in ys_parts]) for k in self._ys_keys()
         }

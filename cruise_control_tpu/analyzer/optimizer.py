@@ -157,6 +157,7 @@ class GoalOptimizer:
         profiler_dir: str | None = None,
         prewarm_store=None,
         peak_tracker=None,
+        mesh_ft=None,
     ):
         """parallel_mode (config key tpu.parallel.mode): "single" (one
         device), "sharded" (candidate axis sharded over the mesh,
@@ -221,7 +222,17 @@ class GoalOptimizer:
         peak_tracker (common/profiling.PeakLiveBytesTracker): when bound,
         every optimize records the post-run per-device live bytes into
         the run's shape-bucket cell of the
-        `tpu.device.peak-live-bytes-by-bucket` collector."""
+        `tpu.device.peak-live-bytes-by-bucket` collector.
+
+        mesh_ft (config keys tpu.mesh.ft.*, parallel/ft.py): the mesh
+        fault-tolerance controller — per-width breakers, degrade
+        episodes, and the slice-boundary checkpoint cadence.  Supervised
+        mesh modes default to a controller of their own (checkpointing
+        off) so a classified mesh failure degrades the WIDTH ladder
+        (narrower mesh -> plain engine -> CPU greedy) instead of opening
+        the single-device breaker; pass an explicit controller to wire
+        config/sensors, or one with enabled=False to restore the pre-FT
+        straight-to-greedy behavior."""
         import threading
 
         import jax
@@ -294,6 +305,18 @@ class GoalOptimizer:
         #: transition (pull-based: no callback registration to leak across
         #: the facade's short-lived per-request optimizers)
         self._breaker_epoch = supervisor.open_epoch if supervisor is not None else 0
+        #: mesh fault tolerance (parallel/ft.py): supervised mesh modes
+        #: get a default controller so device loss degrades the width
+        #: ladder; "single" mode carries None (zero behavior change)
+        if (
+            mesh_ft is None
+            and self.parallel_mode != "single"
+            and supervisor is not None
+        ):
+            from cruise_control_tpu.parallel.ft import MeshFtController
+
+            mesh_ft = MeshFtController(sensors=sensors)
+        self._mesh_ft = mesh_ft if self.parallel_mode != "single" else None
         self._report_cpu = None  # lazy CPU twin of _report (degraded path)
         from cruise_control_tpu.models.state import DEFAULT_BUCKET_POLICY
 
@@ -448,16 +471,32 @@ class GoalOptimizer:
         except Exception:  # noqa: BLE001 — the manifest is best-effort
             pass
 
+    @staticmethod
+    def _parallel_key(shape, config, devices):
+        """Parallel engines cache per (shape, config, device-id set): the
+        mesh fault-tolerance ladder builds engines over SURVIVOR subsets,
+        and a reduced-width engine must never be served as (or evicted
+        by) the full-width one."""
+        return (shape, config, tuple(int(d.id) for d in devices))
+
     def _parallel_engine(
-        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        config: OptimizerConfig,
+        *,
+        devices=None,
     ):
-        """Multi-device engine per parallel_mode, cached per (shape, config)
-        with a data rebind like _engine_for — recompiling the sharded
-        programs per request would cost seconds to minutes.  Shard layouts
-        derive from the (bucketed) global shape, but max_rf remains
-        data-dependent; a rebind that changes the local shapes falls back
-        to building a fresh engine."""
-        key = (state.shape, config)
+        """Multi-device engine per parallel_mode, cached per (shape,
+        config, devices) with a data rebind like _engine_for — recompiling
+        the sharded programs per request would cost seconds to minutes.
+        Shard layouts derive from the (bucketed) global shape, but max_rf
+        remains data-dependent; a rebind that changes the local shapes
+        falls back to building a fresh engine.  `devices` (mesh ft) builds
+        over a survivor subset; None = every mesh device."""
+        if devices is None:
+            devices = self._mesh_devices()
+        key = self._parallel_key(state.shape, config, devices)
         engine = self._cache_get(self._parallel_engines, key)
         t0 = time.monotonic()
         if engine is not None:
@@ -474,7 +513,7 @@ class GoalOptimizer:
             except BaseException:
                 self._unpin(engine)  # pin must not outlive a failed rebind
                 raise
-        engine = self._build_parallel_engine(state, options, config)
+        engine = self._build_parallel_engine(state, options, config, devices=devices)
         self._cache_put(self._parallel_engines, key, engine)
         self._record(False)
         self._note_prewarm(engine, config, parallel_mode=self.parallel_mode)
@@ -488,9 +527,11 @@ class GoalOptimizer:
         """True when a compiled engine for (shape, config) is cached —
         lets the facade's precompute loop skip the padded-model build when
         the next bucket is already warm."""
-        key = (shape, config or self.config)
+        cfg = config or self.config
         with self._cache_lock:
-            return key in self._engines or key in self._parallel_engines
+            return (shape, cfg) in self._engines or any(
+                k[0] == shape and k[1] == cfg for k in self._parallel_engines
+            )
 
     def prewarm(
         self,
@@ -547,8 +588,12 @@ class GoalOptimizer:
         priority: int = 0,
     ) -> None:
         cfg = config or self.config
-        key = (state.shape, cfg)
         parallel = self.parallel_mode != "single"
+        key = (
+            self._parallel_key(state.shape, cfg, self._mesh_devices())
+            if parallel
+            else (state.shape, cfg)
+        )
         cache = self._parallel_engines if parallel else self._engines
         with self._cache_lock:
             if key in cache:
@@ -583,12 +628,24 @@ class GoalOptimizer:
         return devices
 
     def _build_parallel_engine(
-        self, state: ClusterState, options: OptimizationOptions, config: OptimizerConfig
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        config: OptimizerConfig,
+        *,
+        devices=None,
     ):
+        """Mesh engine for the current parallel_mode over `devices` (None
+        = every mesh device, today's exact layout).  A survivor subset
+        (mesh ft) keeps the grid's RESTART axis fixed — checkpointed
+        chains must map 1:1 onto the rebuilt mesh — and shrinks the MODEL
+        axis to what the subset can carry."""
         from cruise_control_tpu.parallel.grid import GridEngine, grid_mesh
         from cruise_control_tpu.parallel.sharded import ShardedEngine, model_mesh
 
-        devices = self._mesh_devices()
+        explicit = devices is not None
+        if devices is None:
+            devices = self._mesh_devices()
         if self.parallel_mode == "sharded":
             return ShardedEngine(
                 state, self.chain, mesh=model_mesh(devices),
@@ -597,6 +654,13 @@ class GoalOptimizer:
                 model_shard_min_partitions=self.model_shard_min_partitions,
             )
         r, m = self._grid_shape
+        if explicit:
+            m = len(devices) // r
+            if m < 1:
+                raise ValueError(
+                    f"{len(devices)} devices cannot carry a "
+                    f"{r}-restart grid"
+                )
         return GridEngine(
             state, self.chain, mesh=grid_mesh(r, m, devices),
             constraint=self.constraint, options=options, config=config,
@@ -729,11 +793,214 @@ class GoalOptimizer:
         self._maybe_purge_after_open()
         if not sup.available():
             return self._optimize_degraded(state, options, cfg, reason="breaker-open")
+        ft = self._mesh_ft
+        if self.parallel_mode != "single" and ft is not None and ft.enabled:
+            return self._optimize_mesh_ft(state, options, verbose, cfg, sup, ft)
         try:
             return sup.call(
                 lambda: self._optimize_on_device(
                     state, options, verbose=verbose, config=cfg,
                     initial_placement=initial_placement, prior=prior,
+                ),
+                op="optimize",
+            )
+        except DeviceDegradedError as e:
+            self._maybe_purge_after_open()
+            return self._optimize_degraded(
+                state, options, cfg,
+                reason=e.failure_class.value, cause=e,
+            )
+
+    # ------------------------------------------------------------------
+    # mesh fault tolerance (degrade-and-resume width ladder)
+    # ------------------------------------------------------------------
+
+    def _reduced_mesh_devices(self, survivors, *, below: int):
+        """The next rung's device list after a failure at width `below`:
+        the widest power-of-two MODEL-axis width the survivors can carry
+        — strictly below the failed width even when attribution named no
+        suspect (a blind halving still excludes a wedged chip half the
+        time).  Grid modes keep the RESTART axis fixed (checkpointed
+        chains must map 1:1 onto the rebuilt mesh) and shrink the model
+        axis.  None = no mesh width survives (fall to the plain rung)."""
+        r = self._grid_shape[0] if self._grid_shape is not None else 1
+        cap = min(len(survivors), below - 1)
+        if cap < max(2, r):
+            return None
+        m = 1
+        while m * 2 * r <= cap:
+            m *= 2
+        return list(survivors[: r * m])
+
+    def _purge_parallel_for_mesh_failure(self, suspect_ids, failed_ids) -> None:
+        """Drop parallel engines whose mesh touches the failed chips: a
+        lost/wedged device owns buffers of unknown integrity, but engines
+        on disjoint survivor subsets — and every single-device engine —
+        stay cached (the scoped-purge contract tests/test_mesh_ft.py
+        pins)."""
+        bad = set(suspect_ids) if suspect_ids else set(failed_ids)
+        released = []
+        with self._cache_lock:
+            for key in [
+                k for k in self._parallel_engines if bad & set(k[2])
+            ]:
+                released.append(self._parallel_engines.pop(key))
+        for e in released:
+            if not getattr(e, "_cc_busy", 0):
+                _release_engine(e)
+        self._record(False, count=False)  # refresh the size gauge
+
+    def _optimize_mesh_ft(
+        self,
+        state: ClusterState,
+        options: OptimizationOptions,
+        verbose: bool,
+        cfg: OptimizerConfig,
+        sup,
+        ft,
+    ) -> OptimizerResult:
+        """The mesh width ladder: attempt the widest usable rung, and on a
+        classified MESH failure (device lost / collective stall) rebuild
+        over the survivors at the next lower power-of-two width, resuming
+        from the last slice-boundary checkpoint when one exists.  Every
+        mesh attempt runs under that WIDTH's breaker (`sup.call(breaker=
+        ...)`) with attribution armed (`mesh_devices=`); non-mesh
+        classified failures keep today's straight-to-greedy behavior.
+        When no width survives: plain engine under the single-device
+        breaker, then CPU greedy — the pre-existing ladder."""
+        import contextlib
+
+        from cruise_control_tpu.analyzer.engine import (
+            SegmentContext,
+            current_segment_context,
+            segmented_execution,
+        )
+        from cruise_control_tpu.common.device_watchdog import (
+            CheckpointClock,
+            DeviceDegradedError,
+            MESH_FAILURE_CLASSES,
+            checkpoint_clock_scope,
+        )
+        from cruise_control_tpu.parallel.ft import CheckpointSlot
+
+        devices = list(self._mesh_devices())
+        full_width = len(devices)
+        slot = CheckpointSlot()
+        clock = CheckpointClock()
+        resume = None
+        lost: list[int] = []
+        last_mesh_error = None
+        while devices is not None:
+            width = len(devices)
+            brk = ft.acquire_width(width)
+            if brk is None:  # this width's breaker is open, probe not due
+                devices = self._reduced_mesh_devices(devices, below=width)
+                continue
+            every = ft.checkpoint_every_slices
+            if every > 0:
+                # install (or augment) the ambient segmented-execution
+                # request so mesh slice boundaries feed carry snapshots
+                # into the slot; the scheduler's budget and pause
+                # callback are preserved.  every=0 installs NOTHING —
+                # the off path is byte-for-byte today's dispatch stream.
+                ambient = current_segment_context()
+                seg_ctx = SegmentContext(
+                    ambient.slice_budget_s if ambient is not None else float("inf"),
+                    ambient.checkpoint if ambient is not None else None,
+                    snapshot_every=every,
+                    snapshot_sink=slot.offer,
+                    checkpoint_clock=clock,
+                )
+                scope = segmented_execution(seg_ctx)
+            else:
+                seg_ctx = None
+                scope = contextlib.nullcontext()
+            this_resume = resume
+            devs = devices
+            try:
+                with checkpoint_clock_scope(clock), scope:
+                    result = sup.call(
+                        lambda: self._optimize_on_device(
+                            state, options, verbose=verbose, config=cfg,
+                            devices=devs, resume=this_resume,
+                        ),
+                        op="optimize", breaker=brk, mesh_devices=devs,
+                    )
+            except DeviceDegradedError as e:
+                ft.note_width_result(width, ok=False)
+                if seg_ctx is not None:
+                    # the last offered snapshot may still be persisting on
+                    # the background thread — land it before reading the
+                    # slot, or a fast failure resumes one boundary stale
+                    seg_ctx.wait_snapshot()
+                    ft.note_checkpoint_seconds(seg_ctx.snapshot_seconds)
+                if e.failure_class not in MESH_FAILURE_CLASSES:
+                    # not attributable to specific chips: today's behavior
+                    return self._optimize_degraded(
+                        state, options, cfg,
+                        reason=e.failure_class.value, cause=e,
+                    )
+                suspects = tuple(int(d) for d in (e.device_ids or ()))
+                lost.extend(suspects)
+                failed_ids = [int(d.id) for d in devices]
+                self._purge_parallel_for_mesh_failure(suspects, failed_ids)
+                survivors = (
+                    [d for d in devices if int(d.id) not in set(suspects)]
+                    if suspects
+                    else devices
+                )
+                nxt = self._reduced_mesh_devices(survivors, below=width)
+                ft.note_degrade(
+                    lost=suspects,
+                    from_width=width,
+                    to_width=len(nxt) if nxt is not None else 1,
+                    failure_class=e.failure_class.value,
+                )
+                resume = slot.latest()
+                last_mesh_error = e
+                devices = nxt
+                continue
+            ft.note_width_result(width, ok=True)
+            if seg_ctx is not None:
+                seg_ctx.wait_snapshot()
+                ft.note_checkpoint_seconds(seg_ctx.snapshot_seconds)
+            ft.note_run_completed(
+                width=width, full_width=full_width,
+                resumed=this_resume is not None,
+            )
+            if lost or width < full_width:
+                # stamp the degrade on the result: consumers (bench gate,
+                # /explain) see which chips were lost and whether the
+                # anneal RESUMED (vs restarted) without digging sensors
+                result.history.append(
+                    dict(
+                        mesh_ft=True,
+                        lost_devices=sorted(set(lost)),
+                        width=width,
+                        full_width=full_width,
+                        resumed=this_resume is not None,
+                        resumed_from_round=(
+                            int(this_resume.base)
+                            if this_resume is not None
+                            else None
+                        ),
+                    )
+                )
+            return result
+        # no mesh width survives: plain engine under the single-device
+        # breaker, then the CPU greedy floor
+        from cruise_control_tpu.common.device_watchdog import DeviceDegradedError
+
+        if not sup.available():
+            return self._optimize_degraded(
+                state, options, cfg, reason="breaker-open",
+                cause=last_mesh_error,
+            )
+        try:
+            return sup.call(
+                lambda: self._optimize_on_device(
+                    state, options, verbose=verbose, config=cfg,
+                    force_single=True,
                 ),
                 op="optimize",
             )
@@ -942,18 +1209,27 @@ class GoalOptimizer:
         ]
 
     def _maybe_purge_after_open(self) -> None:
-        """Drop every cached engine once per breaker-open transition: a
-        device that just wedged/OOMed owns buffers of unknown integrity,
-        and recovery should rebuild engines fresh rather than rebind onto
-        them.  Pinned engines (a hung run still references one from its
+        """Drop cached engines once per breaker-open transition: a device
+        that just wedged/OOMed owns buffers of unknown integrity, and
+        recovery should rebuild engines fresh rather than rebind onto
+        them.  SCOPED to the failing parallel mode: the single-device
+        breaker guards the plain-engine path, so its open drops only
+        `_engines` — mesh engines have their own per-width breakers and
+        are purged at THEIR failure site (_purge_parallel_for_mesh_failure)
+        — except when mesh ft is off and mesh dispatches still ride this
+        breaker.  Pinned engines (a hung run still references one from its
         abandoned thread) are dropped from the cache but left to GC."""
         sup = self.supervisor
         if sup is None or sup.open_epoch == self._breaker_epoch:
             return
         self._breaker_epoch = sup.open_epoch
+        caches = [self._engines]
+        ft = self._mesh_ft
+        if self.parallel_mode != "single" and (ft is None or not ft.enabled):
+            caches.append(self._parallel_engines)
         released = []
         with self._cache_lock:
-            for cache in (self._engines, self._parallel_engines):
+            for cache in caches:
                 released.extend(cache.values())
                 cache.clear()
         for e in released:
@@ -970,7 +1246,15 @@ class GoalOptimizer:
         config: OptimizerConfig | None = None,
         initial_placement=None,
         prior=None,
+        devices=None,
+        resume=None,
+        force_single: bool = False,
     ) -> OptimizerResult:
+        """`devices` / `resume` / `force_single` are the mesh
+        fault-tolerance ladder's knobs (_optimize_mesh_ft): build the mesh
+        engine over a survivor subset, continue a checkpointed anneal from
+        its last slice boundary, or take the plain-engine rung below the
+        mesh.  All three default to today's behavior."""
         from concurrent.futures import ThreadPoolExecutor
 
         from cruise_control_tpu.analyzer.proposals import fetch_before_host
@@ -995,8 +1279,9 @@ class GoalOptimizer:
         # start (engine.precompile_async docstring)
         engine = None
         cache_info = None
+        single = self.parallel_mode == "single" or force_single
         try:
-            if self.parallel_mode == "single":
+            if single:
                 engine, cache_info = self._engine_for(
                     state, options, cfg, prior=prior
                 )
@@ -1006,7 +1291,9 @@ class GoalOptimizer:
                         "warm-start placement / move-acceptance prior are "
                         f"single-device only (tpu.parallel.mode={self.parallel_mode!r})"
                     )
-                engine, cache_info = self._parallel_engine(state, options, cfg)
+                engine, cache_info = self._parallel_engine(
+                    state, options, cfg, devices=devices
+                )
             # only at production scale: tiny test engines compile in
             # hundreds of ms, and eagerly tracing the rarely-used
             # programs (full-chain violations) would cost more than
@@ -1047,6 +1334,14 @@ class GoalOptimizer:
                     if initial_placement is not None
                     else {}
                 )
+                if resume is not None and not single:
+                    if getattr(engine, "model_sharded", False):
+                        # the sharded-model mode has no segmented variant
+                        # (mesh.py run() docstring): a reduced-width
+                        # retry restarts the schedule instead of resuming
+                        resume = None
+                    else:
+                        run_kwargs["resume"] = resume
                 with profiler_trace(self.profiler_dir):
                     final, history = engine.run(verbose=verbose, **run_kwargs)
                 before_host = before_host_f.result()

@@ -473,16 +473,27 @@ def in_flight_from_records(
 
 def spool_verdict(path: str, *, last_n: int = DEFAULT_TAIL_RECORDS) -> dict:
     """The structured post-mortem block diagnostic surfaces embed: the
-    spool tail + the dispatches still in flight when it ends.  Never
-    raises — an unreadable/absent spool is an empty verdict, because this
-    runs inside failure paths."""
+    spool tail + the dispatches still in flight when it ends.  Mesh
+    dispatches record their width (`mesh_shape`/`n_devices`, stamped by
+    the mesh engine's `_blackbox_fields` through the device_op seam), and
+    the verdict surfaces the widest one in flight as `mesh_in_flight` so
+    a timeout kill names the mesh width, not just the op.  Never raises —
+    an unreadable/absent spool is an empty verdict, because this runs
+    inside failure paths."""
     try:
         records = read_spool(path, last_n=None)
     except Exception:  # noqa: BLE001 — diagnosis must not mask the failure
         records = []
-    return {
-        "records": records[-last_n:],
-        "in_flight": in_flight_from_records(
-            records, now_ms=int(time.time() * 1000)
-        ),
-    }
+    in_flight = in_flight_from_records(
+        records, now_ms=int(time.time() * 1000)
+    )
+    verdict = {"records": records[-last_n:], "in_flight": in_flight}
+    mesh = [r for r in in_flight if r.get("n_devices") or r.get("mesh_shape")]
+    if mesh:
+        widest = max(mesh, key=lambda r: int(r.get("n_devices") or 0))
+        verdict["mesh_in_flight"] = {
+            k: widest.get(k)
+            for k in ("kind", "op", "mesh_shape", "n_devices", "in_flight_s")
+            if widest.get(k) is not None
+        }
+    return verdict

@@ -109,6 +109,62 @@ def _current_pause_clock():
     return _pause_clock_var().get()
 
 
+class CheckpointClock:
+    """Cumulative seconds a dispatch has spent capturing fault-tolerance
+    carry checkpoints (engine.SegmentContext snapshots).  Installed by the
+    optimizer around supervised mesh calls via `checkpoint_clock_scope`;
+    `DeviceSupervisor._bounded` adds it to the pause clock so host-side
+    snapshot I/O extends the hang deadline instead of eating it — a run
+    that checkpoints diligently must not look closer to wedged."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0.0
+
+    def add(self, dt: float) -> None:
+        with self._lock:
+            self._total += max(0.0, dt)
+
+    def seconds(self) -> float:
+        with self._lock:
+            return self._total
+
+
+_CKPT_CLOCK_VAR = None
+
+
+def _ckpt_clock_var():
+    global _CKPT_CLOCK_VAR
+    if _CKPT_CLOCK_VAR is None:
+        import contextvars
+
+        _CKPT_CLOCK_VAR = contextvars.ContextVar(
+            "device_op_checkpoint_clock", default=None
+        )
+    return _CKPT_CLOCK_VAR
+
+
+class checkpoint_clock_scope:
+    """Scope a CheckpointClock to the current context — same contextvar
+    ride as `pause_clock_scope`, so the enforcer thread and the copied
+    worker context observe the SAME accumulator object."""
+
+    def __init__(self, clock: CheckpointClock):
+        self._clock = clock
+        self._token = None
+
+    def __enter__(self):
+        self._token = _ckpt_clock_var().set(self._clock)
+        return self._clock
+
+    def __exit__(self, *exc):
+        _ckpt_clock_var().reset(self._token)
+
+
+def current_checkpoint_clock() -> CheckpointClock | None:
+    return _ckpt_clock_var().get()
+
+
 def device_op(name: str):
     """Mark a function/method as a device-dispatching entry point.
 
@@ -131,8 +187,18 @@ def device_op(name: str):
                 # this op in flight in the trail).  Best-effort
                 # per-device memory (OOM post-mortems) rides the End
                 # record instead.  One predicate read on the disabled
-                # path.
-                seq = _BLACKBOX.begin("device-op", op=name)
+                # path.  A mesh-owning receiver (MeshEngine) annotates
+                # its Begin records with mesh shape/width so a kill
+                # verdict names the mesh in flight, not just the op.
+                fields = {"op": name}
+                if args:
+                    extra = getattr(args[0], "_blackbox_fields", None)
+                    if extra is not None:
+                        try:
+                            fields.update(extra())
+                        except Exception:  # noqa: BLE001 — telemetry only
+                            pass
+                seq = _BLACKBOX.begin("device-op", **fields)
                 try:
                     if hook is not None:
                         result = hook(name, fn, args, kwargs)
@@ -205,6 +271,67 @@ def device_watchdog(timeout_s: float = 180.0) -> str | None:
     )
 
 
+def _per_device_probe(device) -> None:
+    """One tiny single-device dispatch pinned to `device` — the unit of
+    the mesh-attribution fan-out.  A module-level `device_op` seam
+    ("device.probe", the device as args[0]) so the fault harness can wedge
+    or kill probes for a SPECIFIC chip by device id."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jax.device_put(jnp.arange(8), device).sum())
+
+
+_device_probe_op = device_op("device.probe")(_per_device_probe)
+
+
+def probe_devices(devices, timeout_s: float = 20.0) -> dict:
+    """Probe each device CONCURRENTLY with its own liveness dispatch.
+
+    Returns {device_id: None | diagnosis string} — None means the chip
+    answered within the shared budget.  Each probe runs on its own daemon
+    thread (a lost chip's probe may never return; it is abandoned like any
+    hung supervised worker), so the whole fan-out costs one budget, not
+    one per device.  This is how a hung MESH dispatch gets attributed to
+    the specific chip: survivors answer, suspects do not.
+    """
+    events: dict[int, threading.Event] = {}
+    results: dict[int, dict] = {}
+
+    def probe_one(dev, did):
+        try:
+            _device_probe_op(dev)
+            results[did]["ok"] = True
+        except BaseException as e:  # noqa: BLE001 — diagnosis, not control flow
+            results[did]["error"] = f"device {did} probe failed: {e!r}"
+        finally:
+            events[did].set()
+
+    for dev in devices:
+        did = int(getattr(dev, "id", dev if isinstance(dev, int) else 0))
+        events[did] = threading.Event()
+        results[did] = {}
+        threading.Thread(
+            target=probe_one,
+            args=(dev, did),
+            daemon=True,
+            name=f"device-probe-{did}",
+        ).start()
+    deadline = time.monotonic() + timeout_s
+    out: dict[int, str | None] = {}
+    for did, ev in events.items():
+        ev.wait(max(0.0, deadline - time.monotonic()))
+        if results[did].get("ok"):
+            out[did] = None
+        else:
+            out[did] = results[did].get(
+                "error",
+                f"device {did} unresponsive: probe did not complete in "
+                f"{timeout_s:.0f}s",
+            )
+    return out
+
+
 # ----------------------------------------------------------------------
 # failure taxonomy
 # ----------------------------------------------------------------------
@@ -217,6 +344,17 @@ class FailureClass(enum.Enum):
     COMPILE = "compile"  # XLA compilation rejected the program
     OOM = "oom"  # RESOURCE_EXHAUSTED / out of device memory
     TRANSIENT = "transient"  # runtime-layer error expected to clear (retried)
+    DEVICE_LOST = "device_lost"  # a specific chip evicted/coredumped mid-run
+    COLLECTIVE_STALL = "collective_stall"  # multi-device dispatch hung on
+    # a subset of its mesh (survivors answer probes, suspects do not)
+
+
+#: failure classes that name specific chips — the optimizer treats these
+#: as MESH failures (degrade width, per-width breaker) rather than
+#: whole-backend failures
+MESH_FAILURE_CLASSES = frozenset(
+    {FailureClass.DEVICE_LOST, FailureClass.COLLECTIVE_STALL}
+)
 
 
 class DeviceHangError(TimeoutError):
@@ -230,15 +368,44 @@ class DeviceHangError(TimeoutError):
         self.timeout_s = timeout_s
 
 
+class DeviceLostError(RuntimeError):
+    """The backend reported a device as gone (evicted, coredumped,
+    disconnected).  `device_ids` names the chips when attribution
+    succeeded; None when the backend only said 'a device'."""
+
+    def __init__(self, msg: str, device_ids: tuple[int, ...] | None = None):
+        super().__init__(msg)
+        self.device_ids = tuple(device_ids) if device_ids else None
+
+
+class CollectiveStallError(RuntimeError):
+    """A multi-device dispatch hung while only a SUBSET of its mesh stopped
+    answering per-device probes — the collective is wedged on the suspect
+    chips, the survivors are healthy."""
+
+    def __init__(self, msg: str, device_ids: tuple[int, ...] | None = None):
+        super().__init__(msg)
+        self.device_ids = tuple(device_ids) if device_ids else None
+
+
 class DeviceDegradedError(RuntimeError):
     """A supervised call failed with a CLASSIFIED device failure (after any
     retries).  Carries the class + original cause so the optimizer can
-    route to the degraded CPU path and report why."""
+    route to the degraded CPU path and report why; for mesh failure
+    classes `device_ids` names the suspect chips so degrade-and-resume
+    can rebuild the mesh around them."""
 
-    def __init__(self, op: str, failure_class: FailureClass, cause: BaseException):
+    def __init__(
+        self,
+        op: str,
+        failure_class: FailureClass,
+        cause: BaseException,
+        device_ids: tuple[int, ...] | None = None,
+    ):
         super().__init__(f"device op {op!r} failed ({failure_class.value}): {cause!r}")
         self.op = op
         self.failure_class = failure_class
+        self.device_ids = tuple(device_ids) if device_ids else None
         self.__cause__ = cause
 
 
@@ -247,6 +414,14 @@ _COMPILE_MARKERS = ("compilation", "Compilation", "UNIMPLEMENTED", "while compil
 _RUNTIME_MARKERS = (
     "XLA", "xla", "jaxlib", "PJRT", "pjrt", "DEADLINE_EXCEEDED", "INTERNAL",
     "UNAVAILABLE", "ABORTED", "device",
+)
+#: backend phrasings for "this chip is gone" (PJRT / TPU driver / the
+#: fault harness's lookalikes) — checked before the generic runtime
+#: markers, which would otherwise swallow these into TRANSIENT retries
+#: that can never succeed on a chip that no longer exists
+_DEVICE_LOST_MARKERS = (
+    "DEVICE_LOST", "device lost", "Device lost", "device is lost",
+    "lost device", "device coredump", "device was removed",
 )
 
 
@@ -265,6 +440,10 @@ def classify_failure(exc: BaseException) -> FailureClass | None:
     """
     if isinstance(exc, DeviceHangError):
         return FailureClass.HANG
+    if isinstance(exc, DeviceLostError):
+        return FailureClass.DEVICE_LOST
+    if isinstance(exc, CollectiveStallError):
+        return FailureClass.COLLECTIVE_STALL
     if isinstance(exc, MemoryError):
         return FailureClass.OOM
     name = type(exc).__name__
@@ -272,6 +451,8 @@ def classify_failure(exc: BaseException) -> FailureClass | None:
     runtime_typed = "XlaRuntimeError" in name or "JaxRuntimeError" in name
     if not runtime_typed and not isinstance(exc, RuntimeError):
         return None
+    if any(m in msg for m in _DEVICE_LOST_MARKERS):
+        return FailureClass.DEVICE_LOST
     if any(m in msg for m in _OOM_MARKERS):
         return FailureClass.OOM
     if any(m in msg for m in _COMPILE_MARKERS):
@@ -485,6 +666,8 @@ class DeviceSupervisor:
 
         self.tracer = tracer if tracer is not None else TRACER
         self._failure_counts: dict[FailureClass, int] = {c: 0 for c in FailureClass}
+        #: latest per-device probe verdicts from mesh attribution fan-outs
+        self._device_health: dict[int, dict] = {}
         self.last_failure: dict | None = None
         self.num_retries = 0
         self.num_probes = 0
@@ -559,8 +742,19 @@ class DeviceSupervisor:
         # deadline extended by scheduler-imposed pause: a segmented
         # dispatch parked at a preemption checkpoint while URGENT work
         # runs is healthy — billing that wait here would turn sustained
-        # urgent load into spurious DeviceHangError breaker failures
+        # urgent load into spurious DeviceHangError breaker failures.
+        # Host-side carry-checkpoint capture (mesh fault tolerance) is
+        # excluded the same way: its CheckpointClock composes into the
+        # effective pause, so snapshot I/O never eats the hang budget.
         pause = _current_pause_clock()
+        ckpt = current_checkpoint_clock()
+        if ckpt is not None:
+            prev = pause
+            pause = (
+                ckpt.seconds
+                if prev is None
+                else (lambda p=prev, c=ckpt.seconds: p() + c())
+            )
         try:
             if pause is None:
                 if not done.wait(timeout_s):
@@ -583,7 +777,15 @@ class DeviceSupervisor:
         _BLACKBOX.end(bb_seq)
         return box.get("result")
 
-    def call(self, fn, *, op: str = "optimize", timeout_s: float | None = None):
+    def call(
+        self,
+        fn,
+        *,
+        op: str = "optimize",
+        timeout_s: float | None = None,
+        breaker: CircuitBreaker | None = None,
+        mesh_devices=None,
+    ):
         """Run fn under the supervision contract.
 
         Success resets the breaker's consecutive count.  Classified
@@ -591,8 +793,17 @@ class DeviceSupervisor:
         backoff; exhausted/unretryable failures count one operation-level
         failure toward the breaker and raise DeviceDegradedError.
         Unclassified exceptions propagate unchanged and touch nothing.
+
+        `breaker` substitutes a caller-owned breaker (the optimizer's
+        per-mesh-width breakers) for the supervisor's single-device one,
+        so a mesh failure degrades the MESH ladder without opening the
+        single-device breaker.  `mesh_devices` (the dispatch's mesh, >1
+        device) arms attribution: a HANG or unattributed device loss
+        triggers a per-device probe fan-out that names the suspect chips,
+        upgrading HANG to COLLECTIVE_STALL when only a subset stalled.
         """
         budget = timeout_s if timeout_s is not None else self.op_timeout_s
+        brk = breaker if breaker is not None else self.breaker
         with self.tracer.span(
             f"device.{op}", component="device", timeout_s=budget
         ) as sp:
@@ -604,6 +815,15 @@ class DeviceSupervisor:
                     cls = classify_failure(e)
                     if cls is None:
                         raise
+                    device_ids = getattr(e, "device_ids", None)
+                    if (
+                        mesh_devices is not None
+                        and len(mesh_devices) > 1
+                        and cls in (FailureClass.HANG, FailureClass.DEVICE_LOST)
+                    ):
+                        cls, device_ids = self._attribute_mesh_failure(
+                            op, cls, device_ids, mesh_devices, sp
+                        )
                     self._count(cls, op, e)
                     sp.event("failure", failure_class=cls.value, error=repr(e))
                     if cls is FailureClass.TRANSIENT and attempt < self.max_retries:
@@ -621,21 +841,75 @@ class DeviceSupervisor:
                         sp.event("retry", attempt=attempt, backoff_s=round(backoff, 4))
                         self._sleep(backoff)
                         continue
-                    if self.breaker.record_failure():
+                    if brk.record_failure():
                         # a breaker flip is THE degradation moment — make
                         # it a first-class trace event, not just a counter
-                        sp.event(
-                            "breaker-opened", open_epoch=self.breaker.open_epoch
-                        )
+                        sp.event("breaker-opened", open_epoch=brk.open_epoch)
                         if self.sensors is not None:
                             self.sensors.counter(
                                 "analyzer.supervisor.breaker-opened"
                             ).inc()
                     sp.set(attempts=attempt + 1, failure_class=cls.value)
-                    raise DeviceDegradedError(op, cls, e) from e
-                self.breaker.record_success()
+                    raise DeviceDegradedError(op, cls, e, device_ids) from e
+                brk.record_success()
                 sp.set(attempts=attempt + 1)
                 return result
+
+    # -- mesh failure attribution ---------------------------------------
+
+    def _attribute_mesh_failure(self, op, cls, device_ids, mesh_devices, sp):
+        """Per-device probe fan-out after a mesh dispatch failed.
+
+        Returns the (possibly upgraded) failure class plus the suspect
+        device ids.  HANG with a strict subset of the mesh unresponsive
+        becomes COLLECTIVE_STALL (the collective wedged on those chips);
+        all-healthy or all-dead stays HANG (nothing to exclude — the
+        whole backend is suspect).  Results land in the per-device health
+        registry (/state) and the black-box spool, so a kill names the
+        chip, not just the slice."""
+        try:
+            results = probe_devices(mesh_devices, self.probe_timeout_s)
+        except BaseException as e:  # noqa: BLE001 — attribution must not mask
+            sp.event("mesh-probe-error", error=repr(e))
+            return cls, device_ids
+        suspects = tuple(sorted(d for d, diag in results.items() if diag))
+        healthy = tuple(sorted(d for d, diag in results.items() if not diag))
+        self.note_device_health(results)
+        sp.event(
+            "mesh-probe", suspects=list(suspects), healthy=list(healthy)
+        )
+        _BLACKBOX.event(
+            "mesh-probe",
+            op=op,
+            failure_class=cls.value,
+            suspects=list(suspects),
+            healthy=list(healthy),
+        )
+        if self.sensors is not None and suspects:
+            self.sensors.counter("analyzer.mesh-ft.device-lost").inc(
+                len(suspects)
+            )
+        if cls is FailureClass.HANG and suspects and healthy:
+            return FailureClass.COLLECTIVE_STALL, suspects
+        if cls is FailureClass.DEVICE_LOST and suspects:
+            return cls, suspects
+        return cls, device_ids or (suspects or None)
+
+    def note_device_health(self, results: dict) -> None:
+        """Record per-device probe outcomes ({id: None | diagnosis})."""
+        now_ms = int(time.time() * 1000)
+        with self._lock:
+            for did, diag in results.items():
+                self._device_health[int(did)] = {
+                    "healthy": diag is None,
+                    "diagnosis": diag,
+                    "ms": now_ms,
+                }
+
+    def device_health(self) -> dict:
+        """Latest per-device probe verdicts, {id: {healthy, diagnosis, ms}}."""
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self._device_health.items())}
 
     # -- availability / half-open probing -------------------------------
 
@@ -711,6 +985,10 @@ class DeviceSupervisor:
             retries, probes, probe_failures = (
                 self.num_retries, self.num_probes, self.num_probe_failures,
             )
+            health = {
+                str(k): dict(v)
+                for k, v in sorted(self._device_health.items())
+            }
         out = self.breaker.snapshot()
         out["breaker"] = out.pop("state")
         out.update(
@@ -721,4 +999,6 @@ class DeviceSupervisor:
             numProbes=probes,
             numProbeFailures=probe_failures,
         )
+        if health:
+            out["deviceHealth"] = health
         return out

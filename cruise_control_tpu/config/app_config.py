@@ -154,6 +154,23 @@ def _analyzer_defs() -> ConfigDef:
              "stay byte-identical; below the threshold the replicated "
              "model wins on collective volume (0 = never shard the model)",
              in_range(lo=0), group=g)
+    d.define("tpu.mesh.ft.enabled", T.BOOLEAN, True, I.MEDIUM,
+             "mesh fault tolerance (parallel/ft.py): on a classified mesh "
+             "failure (device lost / collective stall) the optimizer "
+             "rebuilds the mesh over the surviving devices at the next "
+             "lower power-of-two width and resumes from the last carry "
+             "checkpoint, under per-width breakers that never open the "
+             "single-device breaker; false restores the pre-FT behavior "
+             "(any mesh failure degrades straight to the CPU greedy "
+             "fallback)", group=g)
+    d.define("tpu.mesh.ft.checkpoint.every.slices", T.INT, 0, I.MEDIUM,
+             "capture a host-side carry checkpoint every N slice "
+             "boundaries of a segmented mesh anneal (one in-flight "
+             "snapshot, capture wall excluded from the supervisor's hang "
+             "budget) so a degrade-and-resume continues the round "
+             "schedule instead of restarting it; 0 (default) disables "
+             "checkpointing — byte-for-byte the uncheckpointed dispatch "
+             "stream", in_range(lo=0), group=g)
     d.define("tpu.shape.bucket.enabled", T.BOOLEAN, True, I.MEDIUM,
              "round cluster-model shapes (replicas/brokers/partitions/"
              "topics/racks/hosts) up to geometric buckets so compiled "
@@ -1428,6 +1445,23 @@ class CruiseControlConfig(AbstractConfig):
 
     def mesh_model_shard_min_partitions(self) -> int:
         return self.get("tpu.mesh.model.shard.min.partitions")
+
+    def mesh_ft_controller(self, *, sensors=None):
+        """MeshFtController from the tpu.mesh.ft.* keys (parallel/ft.py);
+        None in single-device mode — there is no mesh to degrade.  The
+        per-width breakers re-probe on the supervisor's probe cadence."""
+        if self.parallel_mode() == "single":
+            return None
+        from cruise_control_tpu.parallel.ft import MeshFtController
+
+        return MeshFtController(
+            enabled=self.get("tpu.mesh.ft.enabled"),
+            checkpoint_every_slices=self.get(
+                "tpu.mesh.ft.checkpoint.every.slices"
+            ),
+            probe_interval_s=self.get("tpu.supervisor.probe.interval.s"),
+            sensors=sensors,
+        )
 
     def device_supervisor(self, *, sensors=None, probe=None, tracer=None):
         """DeviceSupervisor from the tpu.supervisor.* keys; None when

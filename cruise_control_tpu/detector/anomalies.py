@@ -48,6 +48,11 @@ class AnomalyType(enum.Enum):
     #: scores/broker loads the engine predicted for executed proposals
     #: keep diverging from what the cluster actually measured afterwards
     MODEL_DRIFT = 10
+    #: a mesh anneal lost a device (or a collective stalled on one) and
+    #: the optimizer degraded to a narrower mesh width, resuming from the
+    #: last carry checkpoint (parallel/ft.py) — capacity is reduced but
+    #: proposals are still device-served
+    MESH_DEGRADED = 11
 
     @property
     def priority(self) -> int:
@@ -303,6 +308,35 @@ class ModelDrift(Anomaly):
             f"loadErr={self.mean_load_error:.4g} over {self.samples} "
             f"calibrations, threshold={self.threshold:.4g}, "
             f"episode={self.episode})"
+        )
+
+
+@dataclasses.dataclass
+class MeshDegraded(Anomaly):
+    """A mesh anneal lost one or more devices (or a collective stalled on
+    them) and the optimizer's fault-tolerance ladder (parallel/ft.py)
+    rebuilt the mesh over the survivors at a reduced width, resuming from
+    the last slice-boundary checkpoint.
+
+    Fired EXACTLY once per degrade episode by the facade's mesh-ft
+    detector; the episode re-arms when a run completes back at full
+    width.  Not self-healable by this detector — the width ladder IS the
+    mitigation, and recovery to full width is the per-width breaker's
+    half-open probe — so alert-only, like OPTIMIZER_DEGRADED."""
+
+    anomaly_type: AnomalyType = AnomalyType.MESH_DEGRADED
+    lost_devices: list[int] = dataclasses.field(default_factory=list)
+    from_width: int = 0
+    to_width: int = 0
+    failure_class: str = "unknown"  # device_lost / collective_stall
+    episode: int = 0
+    fixable: bool = False
+
+    def description(self) -> str:
+        return (
+            f"MeshDegraded(lost={self.lost_devices}, "
+            f"width={self.from_width}->{self.to_width}, "
+            f"class={self.failure_class}, episode={self.episode})"
         )
 
 

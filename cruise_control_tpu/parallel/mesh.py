@@ -74,15 +74,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from functools import partial
+
 from cruise_control_tpu.analyzer.engine import (
+    CarryCheckpoint,
     Engine,
     OptimizerConfig,
+    SEGMENT_MAX_ROUNDS,
+    SegmentContext,
     _WarmedFn,
+    current_segment_context,
+    snapshot_host_tree,
     start_warm_pool,
 )
 from cruise_control_tpu.analyzer.objective import GoalChain
 from cruise_control_tpu.analyzer.options import DEFAULT_OPTIONS, OptimizationOptions
+from cruise_control_tpu.common.blackbox import RECORDER as _BLACKBOX
 from cruise_control_tpu.common.device_watchdog import device_op
+from cruise_control_tpu.common.dispatch import count_dispatch
 from cruise_control_tpu.config.balancing import BalancingConstraint, DEFAULT_CONSTRAINT
 from cruise_control_tpu.models.sharding import (
     carry_partition_rules,
@@ -274,9 +283,23 @@ class MeshEngine:
         self.last_info: dict | None = None
         self._warm_futures: dict | None = None
         self._coll_bytes: int | None = None
+        #: per-slice-length jitted segmented programs + the lazy
+        #: segmented prelude/objective programs (mesh fault tolerance)
+        self._seg_mesh_fns: dict = {}
+        self._jit_seg_init_mesh = None
+        self._jit_obj = None
         self._build_specs()
         self._place_statics()
         self._build_jits()
+
+    def _blackbox_fields(self) -> dict:
+        """Fields the `device_op` seam merges into this engine's
+        "device-op" Begin records: a killed mesh dispatch's spool verdict
+        names the mesh width in flight, not just the op."""
+        return {
+            "mesh_shape": [self.n_restarts, self.n],
+            "n_devices": self.n_restarts * self.n,
+        }
 
     def _make_twin(self, engine: Engine):
         if self.model_sharded:
@@ -385,6 +408,9 @@ class MeshEngine:
         self._twin = None  # drop the snapshot's statics reference too
         self.global_state = None
         self._warm_futures = None
+        self._seg_mesh_fns = {}
+        self._jit_seg_init_mesh = None
+        self._jit_obj = None
 
     # ------------------------------------------------------------------
     # jitted mesh programs
@@ -542,7 +568,33 @@ class MeshEngine:
     # ------------------------------------------------------------------
 
     @device_op("mesh.run")
-    def run(self, *, verbose: bool = False):
+    def run(self, *, verbose: bool = False, resume: CarryCheckpoint | None = None):
+        """Execute (or RESUME) the fused schedule on the mesh.
+
+        With an ambient SegmentContext (or an explicit `resume`
+        checkpoint) the replicated modes run the schedule in wall-bounded
+        slices — the preemption/fault-tolerance seam: carry snapshots
+        ride the slice boundaries, and `resume` continues the remaining
+        rounds from a CarryCheckpoint captured by ANY mesh width (the
+        host trees carry no placement; restore is a device_put under this
+        mesh's shardings).  The sharded-model mode has no segmented
+        variant (its slice programs would need per-leaf plan specs);
+        it always runs whole-schedule, and a mesh failure there restarts
+        at the reduced width instead of resuming."""
+        seg_ctx = current_segment_context()
+        if not verbose and not self.model_sharded and (
+            seg_ctx is not None or resume is not None
+        ):
+            if seg_ctx is None:
+                # FT resume outside a scheduler grant: slice only for
+                # checkpoint cadence, never for wall bounding
+                seg_ctx = SegmentContext(float("inf"))
+            return self._run_segmented(seg_ctx, resume=resume)
+        if resume is not None:
+            raise ValueError(
+                "mesh resume requires the segmented path (replicated "
+                "modes, non-verbose)"
+            )
         return self._run(verbose=verbose)
 
     def _run(self, *, verbose: bool = False):
@@ -608,6 +660,229 @@ class MeshEngine:
             # metrics (objective trajectory, final per-goal violations,
             # ran/early-stop) are the winner chain's — the trajectory the
             # served placement actually followed
+            win_ys = {k: np.asarray(v)[winner] for k, v in ys.items()}
+            for k in ("accepted", "acc_replica", "acc_swap", "acc_lead",
+                      "prior_cands", "prior_acc"):
+                win_ys[k] = np.asarray(ys[k]).sum(axis=0)
+            timing["convergence"] = self.engine._convergence_summary(win_ys)
+        history.append(timing)
+        self.last_info = dict(
+            objectives=objs, winner=winner,
+            n_chains=self.n_restarts, n_shards=self.n,
+        )
+        return state, history
+
+    # ------------------------------------------------------------------
+    # segmented (preemptible / checkpointable) mesh execution
+    # ------------------------------------------------------------------
+
+    def _seg_init_fn(self, sx, keys_blk):
+        """Per-shard segmented prelude: round-0 carry + scan state."""
+        eng = self._twin
+        carry = eng._init_impl(sx, keys_blk[0])
+        seg = eng._seg_init_impl(sx, carry)
+        stack = lambda t: jax.tree.map(lambda x: x[None], t)  # noqa: E731
+        return stack(carry), stack(seg)
+
+    def _seg_slice_fn(self, L, sx, carry_blk, seg_blk, base):
+        """Rounds [base, base+L) of one restart chain — the plain
+        engine's `_seg_slice_impl` under the mesh twin, so the sliced
+        scan composes to exactly the unsegmented mesh program."""
+        eng = self._twin
+        carry = jax.tree.map(lambda x: x[0], carry_blk)
+        seg = jax.tree.map(lambda x: x[0], seg_blk)
+        carry, seg, ys = eng._seg_slice_impl(L, sx, carry, seg, base)
+        stack = lambda t: jax.tree.map(lambda x: x[None], t)  # noqa: E731
+        return stack(carry), stack(seg), stack(ys)
+
+    def _obj_fn(self, sx, carry_blk):
+        eng = self._twin
+        carry = jax.tree.map(lambda x: x[0], carry_blk)
+        return eng.carry_objective(sx, carry)[None]
+
+    def _seg_mesh_fn(self, L: int):
+        fn = self._seg_mesh_fns.get(L)
+        if fn is None:
+            spec_r = P(RESTART_AXIS)
+            fn = jax.jit(
+                shard_map_compat(
+                    partial(self._seg_slice_fn, L), self.mesh,
+                    in_specs=(self._sx_specs, self._carry_specs, spec_r, P()),
+                    out_specs=(self._carry_specs, spec_r, spec_r),
+                ),
+                donate_argnums=(1, 2),
+            )
+            self._seg_mesh_fns[L] = fn
+        return fn
+
+    def checkpoint_capture(self, carry, seg, base: int, ys_parts) -> CarryCheckpoint:
+        """Host-side CarryCheckpoint of a slice boundary (device idle):
+        global numpy trees — no placement — so a narrower mesh can
+        restore it with a plain device_put under ITS shardings."""
+        count_dispatch("mesh.snapshot")
+        return CarryCheckpoint(
+            base=int(base),
+            carry=snapshot_host_tree(carry),
+            seg=snapshot_host_tree(seg),
+            ys_parts=[dict(p) for p in ys_parts],
+            n_chains=self.n_restarts,
+            meta=dict(
+                seed=int(self.engine.config.seed),
+                mesh_shape=[self.n_restarts, self.n],
+            ),
+        )
+
+    def _restore_checkpoint(self, ckpt: CarryCheckpoint):
+        """device_put a CarryCheckpoint under THIS mesh's shardings.
+
+        The device trees are re-materialized with an eager jnp.copy per
+        leaf: device_put of a host tree can ZERO-COPY alias suitably
+        aligned numpy buffers (observed on the CPU backend for a subset
+        of leaves), and the slice programs donate the carry/seg — a
+        donated alias lets XLA scribble its outputs straight into (or
+        free) the checkpoint's own memory, silently corrupting it for
+        any later resume from the same snapshot (a second degrade in
+        one episode, or a retry at another width).  An eager copy op
+        always allocates fresh XLA-owned output buffers, so what gets
+        donated is never the checkpoint."""
+        if int(ckpt.n_chains) != self.n_restarts:
+            raise ValueError(
+                f"checkpoint has {ckpt.n_chains} chains; this mesh runs "
+                f"{self.n_restarts} — resume requires matching chains"
+            )
+        shard_r = NamedSharding(self.mesh, P(RESTART_AXIS))
+        own = lambda t: jax.tree.map(  # noqa: E731
+            jnp.copy, jax.device_put(t, shard_r)
+        )
+        carry = own(ckpt.carry)
+        seg = own(ckpt.seg)
+        return carry, seg, [dict(p) for p in ckpt.ys_parts], int(ckpt.base)
+
+    def _run_segmented(
+        self,
+        seg_ctx: SegmentContext,
+        *,
+        resume: CarryCheckpoint | None = None,
+    ):
+        """The mesh fused schedule in wall-bounded slices (replicated
+        modes): the plain engine's `_run_segmented` loop with every slice
+        a whole shard_map program — a mesh slice is never a split
+        collective.  Byte parity with the unsegmented mesh run holds by
+        scan composition exactly like the single-device pin
+        (tests/test_mesh_ft.py); slice boundaries are where the
+        fault-tolerance layer captures carry snapshots and where a resume
+        re-enters the remaining round schedule."""
+        cfg = self.engine.config
+        self.last_info = None
+        t_start = time.monotonic()
+        total = cfg.num_rounds + cfg.extra_round_budget
+        budget = max(1e-3, float(seg_ctx.slice_budget_s))
+        if resume is not None:
+            carry, seg, ys_parts, base = self._restore_checkpoint(resume)
+        else:
+            keys = (
+                jax.random.PRNGKey(cfg.seed)[None]
+                if self.n_restarts == 1
+                else jax.random.split(
+                    jax.random.PRNGKey(cfg.seed), self.n_restarts
+                )
+            )
+            if self._jit_seg_init_mesh is None:
+                self._jit_seg_init_mesh = jax.jit(
+                    shard_map_compat(
+                        self._seg_init_fn, self.mesh,
+                        in_specs=(self._sx_specs, P(RESTART_AXIS)),
+                        out_specs=(self._carry_specs, P(RESTART_AXIS)),
+                    )
+                )
+            count_dispatch("mesh.init")
+            carry, seg = self._jit_seg_init_mesh(self.statics, keys)
+            ys_parts = []
+            base = 0
+        device_s = 0.0
+        round_wall = None
+        L = 1
+        slice_i = 0
+        while base < total:
+            first_use = L not in self._seg_mesh_fns
+            t0s = time.monotonic()
+            bb_seq = _BLACKBOX.begin(
+                "engine-slice",
+                slice=slice_i, base_round=int(base), rounds=int(L),
+                total_rounds=int(total),
+                mesh_shape=[self.n_restarts, self.n],
+                n_devices=self.n_restarts * self.n,
+            ) if _BLACKBOX.enabled else 0
+            try:
+                count_dispatch("mesh.slice")
+                carry, seg, ys = self._seg_mesh_fn(L)(
+                    self.statics, carry, seg, jnp.asarray(base, jnp.int32)
+                )
+                count_dispatch("mesh.sync")
+                ys_host, done_host = jax.device_get((ys, seg[2]))
+            except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+                _BLACKBOX.end(bb_seq, ok=False, error=repr(e))
+                raise
+            done = bool(np.all(done_host))
+            _BLACKBOX.end(bb_seq, done=done)
+            wall = time.monotonic() - t0s
+            device_s += wall
+            ys_parts.append(ys_host)
+            base += L
+            slice_i += 1
+            per_round = wall / L
+            if round_wall is None:
+                round_wall = per_round
+            elif not first_use:
+                round_wall = 0.5 * round_wall + 0.5 * per_round
+            if done or base >= total:
+                break
+            L = 1
+            while L * 2 * round_wall <= budget and L * 2 <= SEGMENT_MAX_ROUNDS:
+                L *= 2
+            if seg_ctx.checkpoint is not None:
+                seg_ctx.checkpoint()
+            # FT carry snapshot: device idle (the sync above), carry/seg
+            # not yet donated into the next slice — the copy races
+            # nothing; one predicate when checkpointing is off
+            seg_ctx.offer_snapshot(
+                lambda c=carry, s=seg, b=base, p=ys_parts:
+                    self.checkpoint_capture(c, s, b, p)
+            )
+        if self._jit_obj is None:
+            self._jit_obj = jax.jit(
+                shard_map_compat(
+                    self._obj_fn, self.mesh,
+                    in_specs=(self._sx_specs, self._carry_specs),
+                    out_specs=P(RESTART_AXIS),
+                )
+            )
+        count_dispatch("mesh.sync")
+        objs = np.asarray(jax.device_get(self._jit_obj(self.statics, carry)))
+        winner = int(np.argmin(objs))
+        win_carry = jax.tree.map(lambda x: x[winner], carry)
+        state = self.final_state(win_carry)
+        ys = {
+            k: np.concatenate([np.asarray(p[k]) for p in ys_parts], axis=1)
+            for k in ys_parts[0]
+        }
+        history = self._history(ys, winner, cfg, verbose=False)
+        timing = dict(
+            timing=True, fused=True, segmented=True,
+            segments=len(ys_parts), blocking_syncs=len(ys_parts) + 1,
+            device_s=round(device_s, 6),
+            host_dispatch_s=round(
+                time.monotonic() - t_start - device_s, 6
+            ),
+            mesh_shape=[self.n_restarts, self.n],
+            collective_bytes=self.collective_bytes_per_round,
+        )
+        if resume is not None:
+            timing["resumed_from_round"] = int(resume.base)
+        if seg_ctx.snapshots_taken or seg_ctx.snapshots_skipped:
+            timing["snapshots"] = seg_ctx.snapshots_taken
+            timing["snapshot_s"] = round(seg_ctx.snapshot_seconds, 6)
+        if cfg.diagnostics:
             win_ys = {k: np.asarray(v)[winner] for k, v in ys.items()}
             for k in ("accepted", "acc_replica", "acc_swap", "acc_lead",
                       "prior_cands", "prior_acc"):

@@ -237,6 +237,7 @@ class AnalyzerCore:
             profiler_dir=self.profiler_dir,
             prewarm_store=self.prewarm_store,
             peak_tracker=self.peak_tracker,
+            mesh_ft=config.mesh_ft_controller(sensors=self.sensors),
         )
         # per-bucket cold-start attribution as labeled /metrics series
         # (only the core's long-lived default optimizer feeds it; ad-hoc
@@ -624,6 +625,30 @@ class CruiseControl:
             open_epoch=epoch,
         )
 
+    def _detect_mesh_degraded(self):
+        """MESH_DEGRADED anomaly, once per mesh degrade episode.
+
+        The mesh-ft controller (parallel/ft.py) arms ONE pending event
+        when an episode opens (first width reduction) and re-arms only
+        after a run completes back at full width — so the breaker walking
+        further down the ladder inside the same episode never re-fires
+        (the /state meshFt block carries the live width)."""
+        ft = getattr(self.optimizer, "_mesh_ft", None)
+        if ft is None:
+            return None
+        event = ft.poll_event()
+        if event is None:
+            return None
+        from cruise_control_tpu.detector.anomalies import MeshDegraded
+
+        return MeshDegraded(
+            lost_devices=list(event.get("lost_devices", [])),
+            from_width=int(event.get("from_width", 0)),
+            to_width=int(event.get("to_width", 0)),
+            failure_class=str(event.get("failure_class", "unknown")),
+            episode=int(event.get("episode", 0)),
+        )
+
     def _wire_detectors(self):
         """Reference AnomalyDetector.java:63-68 wiring."""
         from cruise_control_tpu.detector.detectors import SlowBrokerFinder
@@ -788,6 +813,9 @@ class CruiseControl:
         reg(slow_detect, interval_s=_interval("metric.anomaly.detection.interval.ms"))
         # supervisor breaker watch: every round (cheap property reads)
         reg(self._detect_optimizer_degraded)
+        # mesh fault-tolerance watch: drains the once-per-episode
+        # MESH_DEGRADED event the width ladder armed (cheap poll)
+        reg(self._detect_mesh_degraded)
         # calibration loop + MODEL_DRIFT watch (decision ledger): cheap
         # when nothing is due — the measured-state scoring dispatch runs
         # only once an executed decision's next metric window completes
@@ -2334,7 +2362,13 @@ class CruiseControl:
                 "compileAttribution": self.optimizer.compile_attribution(),
             }
             if self.supervisor is not None:
+                # includes deviceHealth: latest per-device probe verdicts
+                # from mesh attribution fan-outs (which chip, not just
+                # which slice)
                 out["AnalyzerState"]["supervisor"] = self.supervisor.state_json()
+            mesh_ft = getattr(self.optimizer, "_mesh_ft", None)
+            if mesh_ft is not None:
+                out["AnalyzerState"]["meshFt"] = mesh_ft.state_json()
             if self.ledger is not None:
                 # decision ledger + predicted-vs-measured calibration
                 # (analyzer/ledger.py; full episodes on GET /ledger)

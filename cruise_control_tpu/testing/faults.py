@@ -47,7 +47,31 @@ ENGINE_OPS = (
     "scenario.batch-eval",
 )
 PROBE_OP = "probe"
+#: the per-device attribution probe (mesh fault tolerance) — one tiny
+#: dispatch per chip, the device object as args[0]
+DEVICE_PROBE_OP = "device.probe"
 ALL_DEVICE_OPS = ENGINE_OPS + (PROBE_OP,)
+
+
+def _dispatch_device_ids(args) -> tuple[int, ...] | None:
+    """Best-effort device ids a dispatch touches, from its receiver:
+    a mesh engine exposes `.mesh` (all its devices), a per-device probe
+    passes the jax Device itself (`.id`).  None when undeterminable —
+    callers treat that as the default device (id 0), where single-device
+    engine work lands."""
+    if not args:
+        return None
+    recv = args[0]
+    mesh = getattr(recv, "mesh", None)
+    if mesh is not None:
+        try:
+            return tuple(int(d.id) for d in mesh.devices.flat)
+        except Exception:  # noqa: BLE001 — attribution only
+            return None
+    did = getattr(recv, "id", None)
+    if isinstance(did, int):
+        return (did,)
+    return None
 
 
 class FaultSchedule:
@@ -159,6 +183,15 @@ def compile_error(op: str = "?") -> InjectedXlaError:
     )
 
 
+def device_lost_error(op: str = "?", device_id: int = 0) -> InjectedXlaError:
+    """The backend's 'this chip is gone' shape (classify_failure →
+    DEVICE_LOST via the _DEVICE_LOST_MARKERS text match)."""
+    return InjectedXlaError(
+        f"INTERNAL: injected fault in {op}: DEVICE_LOST: "
+        f"device {device_id} halted and was removed from the slice"
+    )
+
+
 # ----------------------------------------------------------------------
 # device-op injection (the @device_op seam)
 # ----------------------------------------------------------------------
@@ -264,6 +297,109 @@ def device_wedged(*, ops=ALL_DEVICE_OPS, schedule: FaultSchedule = ALWAYS):
             yield log
         finally:
             release.set()
+
+
+@contextlib.contextmanager
+def device_loss(
+    device_index: int,
+    *,
+    ops=ENGINE_OPS,
+    schedule: FaultSchedule = ALWAYS,
+    probe_ops=(DEVICE_PROBE_OP,),
+):
+    """Chip `device_index` DIES: from the scheduled call index on, every
+    targeted dispatch that involves that device raises a DEVICE_LOST-shaped
+    backend error.  Loss is LATCHED — once the schedule fires, the chip is
+    permanently gone, so its per-device attribution probes (`probe_ops`)
+    fail too regardless of schedule, while every other chip's probe passes:
+    exactly the asymmetry the mesh classifier attributes on.  Dispatches
+    not involving the chip (and all ops before the latch) fall through,
+    nest-safe with per-op accounting like `device_slowdown`."""
+    lost = threading.Event()
+
+    def effect(op, fn, args, kwargs):
+        raise device_lost_error(op, device_index)
+
+    def involved(args) -> bool:
+        ids = _dispatch_device_ids(args)
+        return device_index in (ids if ids is not None else (0,))
+
+    log = InjectionLog()
+    prev = _watchdog_mod._DEVICE_OP_HOOK
+
+    def hook(name, fn, args, kwargs):
+        if name in probe_ops and lost.is_set() and involved(args):
+            log._record(name)
+            log._mark_fired(name)
+            raise device_lost_error(name, device_index)
+        if name in ops and involved(args):
+            n = log._record(name)
+            if schedule.fires(n):
+                log._mark_fired(name)
+                lost.set()
+                return effect(name, fn, args, kwargs)
+        if prev is not None:
+            return prev(name, fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    set_device_op_hook(hook)
+    try:
+        yield log
+    finally:
+        set_device_op_hook(prev)
+
+
+@contextlib.contextmanager
+def collective_stall(
+    *,
+    device_index: int | None = None,
+    ops=ENGINE_OPS,
+    schedule: FaultSchedule = ALWAYS,
+):
+    """Hang ONLY multi-device dispatches: a targeted op whose receiver
+    spans >1 device blocks until the context exits, single-device work
+    keeps completing — the collective-wedge shape, distinct from
+    `device_wedged` (everything hangs).  With `device_index` set, that
+    chip's per-device attribution probe ALSO hangs once a stall has
+    fired (latched), so the supervisor's fan-out pins the stall on it
+    (COLLECTIVE_STALL with suspects) instead of reporting a bare HANG.
+    Blocked threads release at exit; per-op accounting rides the log."""
+    release = threading.Event()
+    stalled = threading.Event()
+    log = InjectionLog()
+    prev = _watchdog_mod._DEVICE_OP_HOOK
+
+    def hook(name, fn, args, kwargs):
+        ids = _dispatch_device_ids(args)
+        if (
+            name == DEVICE_PROBE_OP
+            and device_index is not None
+            and stalled.is_set()
+            and ids == (device_index,)
+        ):
+            log._record(name)
+            log._mark_fired(name)
+            release.wait()
+            return None
+        if name in ops and ids is not None and len(ids) > 1:
+            n = log._record(name)
+            if schedule.fires(n):
+                log._mark_fired(name)
+                stalled.set()
+                # abandoned by the supervisor; completing real work on an
+                # orphaned thread would race interpreter teardown
+                release.wait()
+                return None
+        if prev is not None:
+            return prev(name, fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    set_device_op_hook(hook)
+    try:
+        yield log
+    finally:
+        release.set()
+        set_device_op_hook(prev)
 
 
 # ----------------------------------------------------------------------

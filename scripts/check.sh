@@ -167,6 +167,25 @@ echo "== check.sh: fault supervision gate (degraded mode, breaker, harness) =="
 python -m pytest tests/test_faults.py -q
 faults_rc=$?
 
+echo "== check.sh: mesh fault-tolerance gate (device loss, carry checkpoints, degrade-and-resume) =="
+# named gate: probe fan-out attribution (DEVICE_LOST / COLLECTIVE_STALL
+# naming the suspect chip), segmented-vs-unsegmented mesh byte parity,
+# reduced-width resume from a slice-boundary carry checkpoint, per-width
+# breakers that never open the single-device breaker, scoped parallel
+# purge, and the once-per-episode MESH_DEGRADED surface
+python -m pytest tests/test_mesh_ft.py -q
+mesh_ft_rc=$?
+
+echo "== check.sh: bench.py --mesh-chaos --smoke (mid-anneal device loss, CPU) =="
+# named gate: inject a device loss mid-anneal on an 8-virtual-device
+# mesh — the optimizer must resume at width 4 from the last checkpoint
+# with placements BYTE-EQUAL to a clean uninterrupted run, checkpoint-off
+# must keep the dispatch stream byte-for-byte with zero extra dispatches,
+# and exactly one MESH_DEGRADED event must arm per degrade episode
+GRAFT_FORCE_CPU=1 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python bench.py --mesh-chaos --smoke
+mesh_chaos_rc=$?
+
 echo "== check.sh: crash-safe execution gate (journal recovery, reaper, adaptive) =="
 # named gate: the kill-and-restart matrix (process crash mid-move /
 # mid-leadership / mid-logdir-copy, truncated-journal replay, stuck-move
@@ -263,5 +282,5 @@ python -m pytest tests/test_trace.py -q
 trace_rc=$?
 
 echo
-echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc mesh_model=$mesh_model_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc coldstart=$coldstart_rc prewarm=$prewarm_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc fleet_ha=$fleet_ha_rc ha_smoke=$ha_smoke_rc scheduler=$scheduler_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc blackbox_overhead=$blackbox_overhead_rc ledger_overhead=$ledger_overhead_rc ledger=$ledger_rc blackbox=$blackbox_rc slo=$slo_rc trace=$trace_rc"
-[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$mesh_model_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$coldstart_rc" -eq 0 ] && [ "$prewarm_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$fleet_ha_rc" -eq 0 ] && [ "$ha_smoke_rc" -eq 0 ] && [ "$scheduler_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$blackbox_overhead_rc" -eq 0 ] && [ "$ledger_overhead_rc" -eq 0 ] && [ "$ledger_rc" -eq 0 ] && [ "$blackbox_rc" -eq 0 ] && [ "$slo_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
+echo "check.sh summary: suite=$suite_rc dryrun=$dryrun_rc entry=$entry_rc smoke=$smoke_rc mesh=$mesh_rc mesh_model=$mesh_model_rc churn=$churn_rc streaming=$streaming_rc controller=$controller_rc coldstart=$coldstart_rc prewarm=$prewarm_rc fleet_smoke=$fleet_smoke_rc fleet=$fleet_rc fleet_ha=$fleet_ha_rc ha_smoke=$ha_smoke_rc scheduler=$scheduler_rc scenarios=$scenarios_rc planner=$planner_rc faults=$faults_rc mesh_ft=$mesh_ft_rc mesh_chaos=$mesh_chaos_rc recovery=$recovery_rc metrics=$metrics_rc overhead=$overhead_rc blackbox_overhead=$blackbox_overhead_rc ledger_overhead=$ledger_overhead_rc ledger=$ledger_rc blackbox=$blackbox_rc slo=$slo_rc trace=$trace_rc"
+[ "$suite_rc" -eq 0 ] && [ "$dryrun_rc" -eq 0 ] && [ "$entry_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$mesh_rc" -eq 0 ] && [ "$mesh_model_rc" -eq 0 ] && [ "$churn_rc" -eq 0 ] && [ "$streaming_rc" -eq 0 ] && [ "$controller_rc" -eq 0 ] && [ "$coldstart_rc" -eq 0 ] && [ "$prewarm_rc" -eq 0 ] && [ "$fleet_smoke_rc" -eq 0 ] && [ "$fleet_rc" -eq 0 ] && [ "$fleet_ha_rc" -eq 0 ] && [ "$ha_smoke_rc" -eq 0 ] && [ "$scheduler_rc" -eq 0 ] && [ "$scenarios_rc" -eq 0 ] && [ "$planner_rc" -eq 0 ] && [ "$faults_rc" -eq 0 ] && [ "$mesh_ft_rc" -eq 0 ] && [ "$mesh_chaos_rc" -eq 0 ] && [ "$recovery_rc" -eq 0 ] && [ "$metrics_rc" -eq 0 ] && [ "$overhead_rc" -eq 0 ] && [ "$blackbox_overhead_rc" -eq 0 ] && [ "$ledger_overhead_rc" -eq 0 ] && [ "$ledger_rc" -eq 0 ] && [ "$blackbox_rc" -eq 0 ] && [ "$slo_rc" -eq 0 ] && [ "$trace_rc" -eq 0 ]
